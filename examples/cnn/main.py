@@ -1,0 +1,146 @@
+"""CNN example trainer — capability parity with reference examples/cnn/main.py.
+
+Usage:
+    python main.py --model mlp --dataset CIFAR10 --num-epochs 3 --validate --timing
+    python main.py --model lenet --dataset MNIST --comm-mode AllReduce
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+from time import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+import hetu_tpu as ht
+import models
+
+logging.basicConfig(level=logging.INFO,
+                    format='%(asctime)s - %(name)s - %(levelname)s - %(message)s')
+logger = logging.getLogger(__name__)
+
+
+def print_rank0(msg):
+    if device_id == 0:
+        logger.info(msg)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', type=str, required=True)
+    parser.add_argument('--dataset', type=str, required=True)
+    parser.add_argument('--batch-size', type=int, default=128)
+    parser.add_argument('--learning-rate', type=float, default=0.1)
+    parser.add_argument('--opt', type=str, default='sgd',
+                        help='sgd / momentum / nesterov / adagrad / adam')
+    parser.add_argument('--num-epochs', type=int, default=10)
+    parser.add_argument('--gpu', type=int, default=0,
+                        help='device id; -1 means cpu (accepts tpu ids too)')
+    parser.add_argument('--validate', action='store_true')
+    parser.add_argument('--timing', action='store_true')
+    parser.add_argument('--comm-mode', default=None)
+    args = parser.parse_args()
+
+    global device_id
+    device_id = 0
+    if args.comm_mode in ('AllReduce', 'Hybrid'):
+        comm, device_id = ht.mpi_nccl_init()
+        executor_ctx = ht.tpu(device_id) if args.gpu >= 0 else ht.cpu(0)
+    else:
+        executor_ctx = ht.cpu(0) if args.gpu == -1 else ht.tpu(args.gpu)
+    print_rank0(f"Training {args.model} on hetu_tpu (ctx={executor_ctx})")
+
+    model = getattr(models, args.model)
+    assert args.dataset in ['MNIST', 'CIFAR10', 'CIFAR100']
+
+    opt = {
+        'sgd': lambda: ht.optim.SGDOptimizer(learning_rate=args.learning_rate),
+        'momentum': lambda: ht.optim.MomentumOptimizer(learning_rate=args.learning_rate),
+        'nesterov': lambda: ht.optim.MomentumOptimizer(
+            learning_rate=args.learning_rate, nesterov=True),
+        'adagrad': lambda: ht.optim.AdaGradOptimizer(
+            learning_rate=args.learning_rate, initial_accumulator_value=0.1),
+        'adam': lambda: ht.optim.AdamOptimizer(learning_rate=args.learning_rate),
+    }[args.opt]()
+
+    print_rank0('Loading %s data...' % args.dataset)
+    if args.dataset == 'MNIST':
+        datasets = ht.data.mnist()
+        train_set_x, train_set_y = datasets[0]
+        valid_set_x, valid_set_y = datasets[1]
+        if args.model in ('cnn_3_layers', 'lenet'):
+            train_set_x = train_set_x.reshape(-1, 1, 28, 28)
+            valid_set_x = valid_set_x.reshape(-1, 1, 28, 28)
+        input_dim = 784
+        num_class = 10
+    else:
+        num_class = 10 if args.dataset == 'CIFAR10' else 100
+        train_set_x, train_set_y, valid_set_x, valid_set_y = ht.data.normalize_cifar(
+            num_class=num_class)
+        if args.model == 'mlp':
+            train_set_x = train_set_x.reshape(train_set_x.shape[0], -1)
+            valid_set_x = valid_set_x.reshape(valid_set_x.shape[0], -1)
+        input_dim = 3072
+
+    x = ht.dataloader_op([
+        ht.Dataloader(train_set_x, args.batch_size, 'train'),
+        ht.Dataloader(valid_set_x, args.batch_size, 'validate'),
+    ])
+    y_ = ht.dataloader_op([
+        ht.Dataloader(train_set_y, args.batch_size, 'train'),
+        ht.Dataloader(valid_set_y, args.batch_size, 'validate'),
+    ])
+    if args.model in ('mlp', 'logreg'):
+        loss, y = model(x, y_, num_class, input_dim)
+    else:
+        loss, y = model(x, y_, num_class)
+    train_op = opt.minimize(loss)
+
+    eval_nodes = {'train': [loss, y, y_, train_op], 'validate': [loss, y, y_]}
+    executor = ht.Executor(eval_nodes, ctx=executor_ctx, comm_mode=args.comm_mode)
+    n_train_batches = executor.get_batch_num('train')
+    n_valid_batches = executor.get_batch_num('validate')
+
+    print_rank0("Start training loop...")
+    running_time = 0
+    for i in range(args.num_epochs + 1):
+        print_rank0("Epoch %d" % i)
+        loss_all = 0
+        batch_num = 0
+        if args.timing:
+            start = time()
+        correct_predictions = []
+        for minibatch_index in range(n_train_batches):
+            loss_val, predict_y, y_val, _ = executor.run(
+                'train', eval_node_list=[loss, y, y_, train_op])
+            predict_y = predict_y.asnumpy()
+            y_val = y_val.asnumpy()
+            loss_all += loss_val.asnumpy()
+            batch_num += 1
+            correct_predictions.extend(
+                np.equal(np.argmax(y_val, 1), np.argmax(predict_y, 1)).astype(float))
+        loss_all /= batch_num
+        print_rank0("Train loss = %f" % loss_all)
+        print_rank0("Train accuracy = %f" % np.mean(correct_predictions))
+
+        if args.timing:
+            during_time = time() - start
+            print_rank0("Running time of current epoch = %fs" % during_time)
+            if i != 0:
+                running_time += during_time
+        if args.validate:
+            correct_predictions = []
+            val_loss_all = 0
+            for minibatch_index in range(n_valid_batches):
+                loss_val, valid_y_predicted, y_val = executor.run(
+                    'validate', convert_to_numpy_ret_vals=True)
+                val_loss_all += loss_val
+                correct_predictions.extend(
+                    np.equal(np.argmax(y_val, 1),
+                             np.argmax(valid_y_predicted, 1)).astype(float))
+            print_rank0("Validation loss = %f" % (val_loss_all / n_valid_batches))
+            print_rank0("Validation accuracy = %f" % np.mean(correct_predictions))
+    print_rank0("*" * 50)
+    print_rank0("Running time of total %d epoch = %fs" % (args.num_epochs, running_time))

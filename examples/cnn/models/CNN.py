@@ -1,0 +1,28 @@
+"""3-layer CNN for MNIST (reference examples/cnn/models/CNN.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def conv_relu_avg(x, shape, name):
+    weight = init.random_normal(shape=shape, stddev=0.1, name=name + '_weight')
+    x = ht.conv2d_op(x, weight, padding=2, stride=1)
+    x = ht.relu_op(x)
+    return ht.avg_pool2d_op(x, kernel_H=2, kernel_W=2, padding=0, stride=2)
+
+
+def fc(x, shape, name):
+    weight = init.random_normal(shape=shape, stddev=0.1, name=name + '_weight')
+    bias = init.random_normal(shape=shape[-1:], stddev=0.1, name=name + '_bias')
+    x = ht.array_reshape_op(x, (-1, shape[0]))
+    y = ht.matmul_op(x, weight)
+    return y + ht.broadcastto_op(bias, y)
+
+
+def cnn_3_layers(x, y_, num_class=10):
+    """x expected as (N, 1, 28, 28)."""
+    print('Building CNN-3 model...')
+    x = conv_relu_avg(x, (32, 1, 5, 5), 'cnn3_conv1')
+    x = conv_relu_avg(x, (64, 32, 5, 5), 'cnn3_conv2')
+    y = fc(x, (7 * 7 * 64, num_class), 'cnn3_fc')
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y

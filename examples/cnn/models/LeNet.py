@@ -1,0 +1,34 @@
+"""LeNet-5 (reference examples/cnn/models/LeNet.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def conv_pool(x, in_channel, out_channel, name):
+    weight = init.random_normal(
+        shape=(out_channel, in_channel, 5, 5), stddev=0.1, name=name + '_weight')
+    x = ht.conv2d_op(x, weight, padding=2, stride=1)
+    x = ht.relu_op(x)
+    return ht.max_pool2d_op(x, kernel_H=2, kernel_W=2, padding=0, stride=2)
+
+
+def fc(x, shape, name, with_relu=True):
+    weight = init.random_normal(shape=shape, stddev=0.1, name=name + '_weight')
+    bias = init.random_normal(shape=shape[-1:], stddev=0.1, name=name + '_bias')
+    y = ht.matmul_op(x, weight)
+    y = y + ht.broadcastto_op(bias, y)
+    if with_relu:
+        y = ht.relu_op(y)
+    return y
+
+
+def lenet(x, y_, num_class=10):
+    """x expected as (N, 1, 28, 28)."""
+    print('Building LeNet model...')
+    x = conv_pool(x, 1, 6, 'lenet_conv1')
+    x = conv_pool(x, 6, 16, 'lenet_conv2')
+    x = ht.array_reshape_op(x, (-1, 7 * 7 * 16))
+    x = fc(x, (7 * 7 * 16, 120), 'lenet_fc1')
+    x = fc(x, (120, 84), 'lenet_fc2')
+    y = fc(x, (84, num_class), 'lenet_fc3', with_relu=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y

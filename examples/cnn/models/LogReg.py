@@ -1,0 +1,12 @@
+"""Logistic regression (reference examples/cnn/models/LogReg.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def logreg(x, y_, num_class=10, input_dim=784):
+    print("Building logistic regression model...")
+    weight = init.zeros((input_dim, num_class), name='logreg_weight')
+    bias = init.zeros((num_class,), name='logreg_bias')
+    logit = ht.matmul_op(x, weight) + ht.broadcastto_op(bias, ht.matmul_op(x, weight))
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logit, y_), [0])
+    return loss, logit

@@ -1,0 +1,24 @@
+"""3-layer MLP (capability parity with reference examples/cnn/models/MLP.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def fc(x, shape, name, with_relu=True):
+    weight = init.random_normal(shape=shape, stddev=0.1, name=name + '_weight')
+    bias = init.random_normal(shape=shape[-1:], stddev=0.1, name=name + '_bias')
+    x = ht.matmul_op(x, weight)
+    x = x + ht.broadcastto_op(bias, x)
+    if with_relu:
+        x = ht.relu_op(x)
+    return x
+
+
+def mlp(x, y_, num_class=10, input_dim=3072):
+    """MLP for flattened CIFAR10 (3072) or MNIST (784)."""
+    print("Building MLP model...")
+    x = fc(x, (input_dim, 256), 'mlp_fc1', with_relu=True)
+    x = fc(x, (256, 256), 'mlp_fc2', with_relu=True)
+    y = fc(x, (256, num_class), 'mlp_fc3', with_relu=False)
+    loss = ht.softmaxcrossentropy_op(y, y_)
+    loss = ht.reduce_mean_op(loss, [0])
+    return loss, y

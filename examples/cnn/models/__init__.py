@@ -1,0 +1,4 @@
+from .MLP import mlp
+from .LogReg import logreg
+from .CNN import cnn_3_layers
+from .LeNet import lenet

@@ -1,0 +1,124 @@
+"""Device placement language: DeviceGroup + ``with ht.context(...)`` scoping.
+
+Capability parity with the reference's ``python/hetu/context.py`` (DeviceGroup
+:6, context() :117). On TPU the placement language maps onto a
+``jax.sharding.Mesh``: a flat DeviceGroup of N devices is a data-parallel mesh
+axis; a tuple inside the group (model-parallel subgroup in the reference)
+becomes a model/tensor axis; multiple sequential ``context`` blocks become
+pipeline stages. The graph-rewriting the reference does here (inserting
+PipelineSend/Recv, split/concat combinations, context.py:173-408) is replaced
+by sharding deduction in ``hetu_tpu/parallel``.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+
+from .ndarray import DLContext, cpu, tpu, rcpu, rtpu
+
+_context_stack: list["DeviceGroup"] = []
+
+
+def _parse_ctx_literal(c):
+    """Parse one context literal: DLContext | 'hostname:tpu:N' | 'tpu:N' | 'cpu:0'."""
+    if isinstance(c, DLContext):
+        return c
+    if isinstance(c, str):
+        c = c.lower().strip()
+        m = re.fullmatch(r"(?:(?P<host>[\w\.\-]+):)?(?P<type>cpu|gpu|tpu):?(?P<id>\d+)?", c)
+        if m is None:
+            raise ValueError(f"Cannot parse context {c!r}")
+        host = m.group("host") or "localhost"
+        dtype = m.group("type")
+        dev_id = int(m.group("id") or 0)
+        if dtype == "cpu":
+            return cpu(dev_id) if host == "localhost" else rcpu(host, dev_id)
+        return tpu(dev_id) if host == "localhost" else rtpu(host, dev_id)
+    raise ValueError(f"Cannot parse context {c!r}")
+
+
+class DeviceGroup:
+    """An ordered group of devices a (sub)graph is placed on.
+
+    Reference context.py:6 — accepts a single context, a list, or nested
+    tuples; a tuple denotes a model-parallel worker group (reference
+    context.py:22-35). ``mp_device_num`` counts leaf devices.
+    """
+
+    def __init__(self, ctxs):
+        self._contexts = self._parse_contexts(ctxs)
+        self._is_mp = any(isinstance(c, tuple) for c in self._contexts)
+
+    @staticmethod
+    def _parse_contexts(ctxs):
+        if isinstance(ctxs, DeviceGroup):
+            return ctxs._contexts
+        if isinstance(ctxs, str):
+            ctxs = [s for s in ctxs.split(",") if s.strip()]
+        # a bare tuple is ONE model-parallel subgroup; a list is the group list
+        if not isinstance(ctxs, list):
+            ctxs = [ctxs]
+        result = []
+        for c in ctxs:
+            if isinstance(c, tuple):
+                result.append(tuple(_parse_ctx_literal(x) for x in c))
+            else:
+                result.append(_parse_ctx_literal(c))
+        return result
+
+    @property
+    def worker_num(self) -> int:
+        return len(self._contexts)
+
+    @property
+    def mp_device_num(self) -> int:
+        n = 0
+        for c in self._contexts:
+            n += len(c) if isinstance(c, tuple) else 1
+        return n
+
+    @property
+    def is_mp(self) -> bool:
+        return self._is_mp
+
+    def __getitem__(self, i):
+        return self._contexts[i]
+
+    def __iter__(self):
+        return iter(self._contexts)
+
+    def __len__(self):
+        return len(self._contexts)
+
+    def flat(self):
+        out = []
+        for c in self._contexts:
+            out.extend(c) if isinstance(c, tuple) else out.append(c)
+        return out
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceGroup) and self._contexts == other._contexts
+
+    def __hash__(self):
+        return hash(tuple(tuple(c) if isinstance(c, tuple) else c for c in self._contexts))
+
+    def __repr__(self):
+        return f"DeviceGroup({self._contexts})"
+
+
+@contextlib.contextmanager
+def context(ctx):
+    """``with ht.context('tpu:0')`` — ops built inside get this placement.
+
+    Reference context.py:117-124.
+    """
+    group = ctx if isinstance(ctx, DeviceGroup) else DeviceGroup(ctx)
+    _context_stack.append(group)
+    try:
+        yield group
+    finally:
+        _context_stack.pop()
+
+
+def get_current_context():
+    return _context_stack[-1] if _context_stack else None

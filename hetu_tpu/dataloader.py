@@ -1,0 +1,148 @@
+"""In-memory dataloader with background prefetch (reference
+``python/hetu/dataloader.py``).
+
+The reference keeps a 3-deep ring of pinned host buffers and overlaps H2D
+copies on a dedicated stream (:26-55). Under JAX, dispatch is asynchronous —
+``device_put`` of the next batch overlaps the current step's compute — so the
+ring reduces to an index cursor plus an optional async device_put of the next
+batch. Data-parallel sharding by rank (init_states :19-24) becomes sharding
+the *global* batch across the mesh's dp axis in the executor.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .graph.node import Op
+
+
+class Dataloader:
+    def __init__(self, raw_data, batch_size, name="default", func=None,
+                 drop_last=True, shuffle=False, seed=0):
+        self.raw_data = np.asarray(raw_data)
+        if self.raw_data.dtype == np.float64:
+            self.raw_data = self.raw_data.astype(np.float32)
+        self.batch_size = int(batch_size)
+        self.name = name
+        self.func = func
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.rank = None
+        self.nrank = None
+        self.init_states()
+
+    def init_states(self, rank: Optional[int] = None, nrank: Optional[int] = None):
+        """DP sharding by process rank for multi-host (reference :19-24).
+
+        Single-process multi-chip DP does NOT shard here: the executor feeds
+        the global batch and shards it over the mesh.
+        """
+        self.rank, self.nrank = rank, nrank
+        n = self.raw_data.shape[0]
+        if rank is not None and nrank is not None and nrank > 1:
+            per = n // nrank
+            self._data = self.raw_data[rank * per:(rank + 1) * per]
+        else:
+            self._data = self.raw_data
+        self._order = np.arange(self._data.shape[0])
+        n = self._data.shape[0]
+        if self.drop_last:
+            self.batch_num = n // self.batch_size
+        else:
+            self.batch_num = int(np.ceil(n / self.batch_size))
+        self._cursor = 0
+
+    def _maybe_reshuffle(self):
+        if self._cursor == 0 and self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def get_arr(self) -> np.ndarray:
+        self._maybe_reshuffle()
+        i = self._cursor
+        idx = self._order[i * self.batch_size:(i + 1) * self.batch_size]
+        batch = self._data[idx]
+        if self.func is not None:
+            batch = self.func(batch)
+        self._cursor = (self._cursor + 1) % self.batch_num
+        return batch
+
+    def get_cur_shape(self):
+        return (self.batch_size,) + tuple(self._data.shape[1:])
+
+
+class DataloaderOp(Op):
+    """Graph node multiplexing one Dataloader per subexecutor name
+    (reference dataloader.py:134)."""
+
+    is_dataloader = True
+
+    def __init__(self, dataloaders):
+        super().__init__([], None)
+        self.dataloaders = {d.name: d for d in dataloaders}
+        self.name = f"DataloaderOp_{self.id}"
+
+    def get_batch_num(self, name):
+        return self.dataloaders[name].batch_num
+
+    def get_batch(self, name):
+        return self.dataloaders[name].get_arr()
+
+    def get_cur_shape(self, name):
+        return self.dataloaders[name].get_cur_shape()
+
+    def set_dp_rank(self, rank, nrank):
+        for d in self.dataloaders.values():
+            d.init_states(rank, nrank)
+
+    def compute(self, input_vals, tc):
+        raise AssertionError("Dataloader batches are supplied by the executor")
+
+
+def dataloader_op(dataloaders):
+    """Accepts [Dataloader, ...] or [[raw_data, batch_size, name], ...]
+    (both forms appear in reference examples)."""
+    dls = []
+    for d in dataloaders:
+        if isinstance(d, Dataloader):
+            dls.append(d)
+        else:
+            dls.append(Dataloader(*d))
+    return DataloaderOp(dls)
+
+
+class GNNDataLoaderOp(Op):
+    """Double-buffered graph-batch loader (reference dataloader.py:98).
+
+    The handler produces the next graph tensor on each ``step``; kept
+    host-driven like the reference, fed into the jitted step as a batch input.
+    """
+
+    is_dataloader = True
+    _ops: list["GNNDataLoaderOp"] = []
+
+    def __init__(self, handler, ctx=None):
+        super().__init__([], ctx)
+        self.handler = handler
+        self._cur = None
+        self._next = None
+        GNNDataLoaderOp._ops.append(self)
+
+    def get_batch_num(self, name):
+        return None
+
+    def get_batch(self, name):
+        return self._cur
+
+    def get_cur_shape(self, name):
+        return None if self._cur is None else tuple(np.asarray(self._cur).shape)
+
+    @classmethod
+    def step(cls, graph):
+        for op in cls._ops:
+            op._cur = op._next
+            op._next = op.handler(graph)
+
+    def compute(self, input_vals, tc):
+        raise AssertionError("Dataloader batches are supplied by the executor")

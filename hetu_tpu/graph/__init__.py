@@ -1,0 +1,3 @@
+from .node import Op, PlaceholderOp, Variable, placeholder_op, find_topo_sort
+from .gradients import gradients, GradientOp
+from .executor import Executor, HetuConfig, SubExecutor

@@ -1,0 +1,63 @@
+"""Graph-level autodiff: ``ht.gradients(loss, node_list)``.
+
+Reference: ``gpu_ops/executor.py:1096`` builds the gradient graph by calling
+each op's symbolic ``gradient`` in reverse topo order. The TPU-native design
+instead defers to ``jax.vjp`` *at trace time*: a ``GradientOp`` node is a
+placeholder whose value is produced by differentiating the traced forward
+subgraph. This gives exact gradients for every op (including fused Pallas
+kernels with custom_vjp) with zero per-op gradient code, and XLA's CSE removes
+the duplicated forward trace.
+
+The returned nodes behave exactly like reference gradient nodes: they can be
+evaluated by the executor, wrapped in AllReduce/PS communication ops by the
+optimizer, or composed into further graph computation.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from .node import Op
+
+
+class GradientContext:
+    """Shared bookkeeping for one ``gradients(loss, xs)`` call."""
+
+    def __init__(self, loss: Op, xs: list[Op]):
+        self.loss = loss
+        self.xs = xs
+
+    def downstream_nodes(self, topo: Sequence[Op]) -> list[Op]:
+        """Nodes in ``topo`` reachable from ``xs`` (forward direction) — the
+        sub-graph that must be re-traced inside the vjp closure."""
+        reachable = set(id(x) for x in self.xs)
+        out = []
+        for node in topo:
+            if id(node) in reachable:
+                continue
+            if any(id(i) in reachable for i in node.inputs):
+                reachable.add(id(node))
+                out.append(node)
+        return out
+
+
+class GradientOp(Op):
+    """d(loss)/d(x) for one x. Inputs = [loss, x] so topo ordering places the
+    full forward graph before the gradient is needed."""
+
+    is_gradient = True
+
+    def __init__(self, gctx: GradientContext, x: Op):
+        super().__init__([gctx.loss, x], ctx=x.raw_ctx)
+        self.gctx = gctx
+        self.x = x
+        self.name = f"Gradient({x.name})"
+
+    def compute(self, input_vals, tc):
+        return tc.gradient_of(self.gctx, self.x)
+
+
+def gradients(loss: Op, node_list: Sequence[Op], insert_grad=None) -> list[Op]:
+    """Return gradient nodes of ``loss`` w.r.t. each node in ``node_list``
+    (reference executor.py:1096 signature)."""
+    gctx = GradientContext(loss, list(node_list))
+    return [GradientOp(gctx, x) for x in node_list]

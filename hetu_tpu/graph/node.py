@@ -1,0 +1,232 @@
+"""Graph node (Op) base classes for the define-then-run frontend.
+
+Capability parity with the reference's ``gpu_ops/Node.py`` (Op :9, compute :73,
+gradient :83, infer_shape :95), redesigned for XLA:
+
+- ``compute`` is a *pure jax function* of traced arrays — it is called once per
+  (subexecutor, shape-signature) while tracing the whole subgraph into a single
+  jitted XLA program. The reference's per-node interpreter dispatch, stream
+  assignment, event sync and transfer-op insertion (Node.py:111-163) do not
+  exist here: XLA schedules, fuses and places everything.
+- autodiff is graph-level via ``hetu_tpu.graph.gradients`` (jax.vjp at trace
+  time), so ops do not each carry a symbolic ``gradient`` method; explicit
+  ``*_gradient_op`` constructors are still provided for API parity.
+- stateful ops (BatchNorm running stats, Dropout RNG) declare state through
+  ``stateful``/``state_init`` and are threaded functionally by the executor.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+import jax
+
+from ..context import get_current_context, DeviceGroup
+
+_id_counter = itertools.count()
+
+
+class Op:
+    """Base graph node. Users compose these via the ``*_op`` constructors."""
+
+    # class-level flags the executor dispatches on
+    is_placeholder = False   # fed via feed_dict or a Variable
+    is_dataloader = False
+    is_optimizer = False
+    is_gradient = False
+    stateful = False         # has functional state threaded by the executor
+    needs_rng = False        # wants a PRNGKey during training trace
+
+    def __init__(self, inputs: Sequence["Op"], ctx=None, name: Optional[str] = None):
+        self.id = next(_id_counter)
+        self.inputs = list(inputs)
+        if ctx is None:
+            ctx = get_current_context()
+        self.raw_ctx = ctx if (ctx is None or isinstance(ctx, DeviceGroup)) else DeviceGroup(ctx)
+        self.name = name or f"{type(self).__name__}_{self.id}"
+        self.desc = self.name
+
+    # ------------------------------------------------------------------
+    def compute(self, input_vals, tc):
+        """Pure computation: list of jax arrays -> jax array (traced)."""
+        raise NotImplementedError(type(self).__name__)
+
+    def compute_stateful(self, input_vals, state, tc):
+        """Stateful computation -> (output, new_state)."""
+        raise NotImplementedError(type(self).__name__)
+
+    def state_init(self):
+        """Initial state pytree for stateful ops."""
+        raise NotImplementedError(type(self).__name__)
+
+    def infer_shape(self, input_shapes):
+        """Shape inference via abstract evaluation (reference Node.py:95).
+
+        The executor does not need this (XLA infers shapes); it exists for
+        user introspection and tests.
+        """
+        structs = [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in input_shapes]
+        tc = _AbstractTraceContext()
+        out = jax.eval_shape(lambda *xs: self.compute(list(xs), tc), *structs)
+        return tuple(out.shape)
+
+    # -- operator overloads (reference Node.py:33-71) -------------------
+    def __add__(self, other):
+        from .ops import add_op, addbyconst_op
+        if isinstance(other, Op):
+            return add_op(self, other)
+        return addbyconst_op(self, other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        from .ops import mul_op, mul_byconst_op
+        if isinstance(other, Op):
+            return mul_op(self, other)
+        return mul_byconst_op(self, other)
+
+    __rmul__ = __mul__
+
+    def __sub__(self, other):
+        from .ops import add_op, addbyconst_op, opposite_op
+        if isinstance(other, Op):
+            return add_op(self, opposite_op(other))
+        return addbyconst_op(self, -other)
+
+    def __rsub__(self, other):
+        from .ops import addbyconst_op, opposite_op
+        return addbyconst_op(opposite_op(self), other)
+
+    def __neg__(self):
+        from .ops import opposite_op
+        return opposite_op(self)
+
+    def __truediv__(self, other):
+        from .ops import div_op, div_const_op, mul_byconst_op
+        if isinstance(other, Op):
+            return div_op(self, other)
+        return mul_byconst_op(self, 1.0 / other)
+
+    def __rtruediv__(self, other):
+        from .ops import div_const_op
+        return div_const_op(other, self)
+
+    def __lt__(self, other):  # stable ordering for pytree-dict keys
+        return self.id < other.id
+
+    def __repr__(self):
+        return self.name
+
+
+class _AbstractTraceContext:
+    """Minimal trace context for ``infer_shape`` abstract evaluation."""
+
+    training = False
+
+    def next_rng(self, node):
+        return jax.random.PRNGKey(0)
+
+
+class FunctionalOp(Op):
+    """An op whose compute is a closed-over pure function — the workhorse.
+
+    Most of the reference's 55 ``gpu_ops/*`` classes (each pairing a CUDA
+    kernel with shims) become one of these wrapping a jax/lax composition.
+    """
+
+    def __init__(self, opname: str, fn: Callable, inputs: Sequence[Op], ctx=None,
+                 name: Optional[str] = None, **attrs):
+        super().__init__(inputs, ctx, name or f"{opname}_{next(_id_counter)}")
+        self.opname = opname
+        self.fn = fn
+        self.attrs = attrs
+
+    def compute(self, input_vals, tc):
+        return self.fn(*input_vals, **self.attrs)
+
+
+class PlaceholderOp(Op):
+    """Leaf node: a trainable Variable, a constant, or a fed placeholder.
+
+    Reference ``gpu_ops/Variable.py`` — ``Variable(name, value=...)`` with an
+    initializer produces a parameter; with neither it is fed via feed_dict.
+    """
+
+    is_placeholder = True
+
+    def __init__(self, name, value=None, initializer=None, trainable=None,
+                 dtype=np.float32, ctx=None, **kwargs):
+        super().__init__([], ctx, name)
+        self.initializer = initializer
+        self.dtype = np.dtype(dtype)
+        self.is_embed = bool(kwargs.get("is_embed", False))
+        if value is not None and not isinstance(value, np.ndarray):
+            value = np.asarray(value, dtype=self.dtype)
+        self.value = value
+        has_data = value is not None or initializer is not None
+        if trainable is None:
+            trainable = has_data
+        if trainable and not has_data:
+            raise ValueError(
+                f"Variable {name!r} is trainable=True but has neither a value "
+                "nor an initializer; fed placeholders must be trainable=False")
+        self.trainable = trainable
+        self.shape = None
+        if value is not None:
+            self.shape = tuple(value.shape)
+        elif initializer is not None:
+            self.shape = tuple(initializer.shape)
+
+    @property
+    def is_feed(self) -> bool:
+        return self.value is None and self.initializer is None
+
+    def instantiate(self, rng_key) -> np.ndarray | jax.Array:
+        """Produce the initial parameter value (host-side, executor init)."""
+        if self.value is not None:
+            return np.asarray(self.value, dtype=self.dtype)
+        if self.initializer is not None:
+            return self.initializer.init(rng_key, self.dtype)
+        raise ValueError(f"Placeholder {self.name} has no value; feed it via feed_dict")
+
+    def compute(self, input_vals, tc):
+        raise AssertionError("PlaceholderOp values are supplied by the executor")
+
+
+def Variable(name, value=None, initializer=None, trainable=None, dtype=np.float32,
+             ctx=None, **kwargs):
+    """Create a variable/placeholder node (reference gpu_ops/Variable.py)."""
+    return PlaceholderOp(name, value=value, initializer=initializer,
+                         trainable=trainable, dtype=dtype, ctx=ctx, **kwargs)
+
+
+placeholder_op = Variable
+
+
+def find_topo_sort(node_list: Sequence[Op]) -> list[Op]:
+    """Post-order DFS topological sort (reference executor.py:1175)."""
+    visited: set[int] = set()
+    order: list[Op] = []
+
+    def dfs(node: Op):
+        stack = [(node, iter(node.inputs))]
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        while stack:
+            cur, it = stack[-1]
+            advanced = False
+            for child in it:
+                if id(child) not in visited:
+                    visited.add(id(child))
+                    stack.append((child, iter(child.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(cur)
+                stack.pop()
+
+    for n in node_list:
+        dfs(n)
+    return order

@@ -1,0 +1,126 @@
+"""Elementwise arithmetic + activation ops.
+
+Covers the reference's AddElewise/AddConst/MultiplyElewise/MultiplyConst/
+Division/Opposite/Sqrt/OnesLike/ZerosLike/Where/Relu/LeakyRelu/Sigmoid/Tanh/
+Softmax CUDA kernels (``src/ops/*.cu``) as jax compositions — XLA fuses these
+into surrounding matmuls/reductions on the VPU, so no hand-written kernels are
+needed at this level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import FunctionalOp, Op
+
+
+def add_op(node_A, node_B, ctx=None):
+    return FunctionalOp("AddElewise", jnp.add, [node_A, node_B], ctx)
+
+
+def addbyconst_op(node, const_val, ctx=None):
+    return FunctionalOp("AddConst", lambda x, c=const_val: x + c, [node], ctx)
+
+
+def mul_op(node_A, node_B, ctx=None):
+    return FunctionalOp("MultiplyElewise", jnp.multiply, [node_A, node_B], ctx)
+
+
+def mul_byconst_op(node, const_val, ctx=None):
+    return FunctionalOp("MultiplyConst", lambda x, c=const_val: x * c, [node], ctx)
+
+
+def div_op(node_A, node_B, ctx=None):
+    return FunctionalOp("Division", jnp.divide, [node_A, node_B], ctx)
+
+
+def div_const_op(const_val, node_A, ctx=None):
+    return FunctionalOp("DivConst", lambda x, c=const_val: c / x, [node_A], ctx)
+
+
+def opposite_op(node, ctx=None):
+    return FunctionalOp("Opposite", jnp.negative, [node], ctx)
+
+
+def sqrt_op(node, ctx=None):
+    return FunctionalOp("Sqrt", jnp.sqrt, [node], ctx)
+
+
+def rsqrt_op(node, ctx=None):
+    return FunctionalOp("ReciprocalSqrt", jax.lax.rsqrt, [node], ctx)
+
+
+def oneslike_op(node, ctx=None):
+    return FunctionalOp("OnesLike", jnp.ones_like, [node], ctx)
+
+
+def zeroslike_op(node, ctx=None):
+    return FunctionalOp("ZerosLike", jnp.zeros_like, [node], ctx)
+
+
+def where_op(cond, node_A, node_B, ctx=None):
+    return FunctionalOp("Where", lambda c, a, b: jnp.where(c != 0, a, b),
+                        [cond, node_A, node_B], ctx)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def relu_op(node, ctx=None):
+    return FunctionalOp("Relu", lambda x: jnp.maximum(x, 0), [node], ctx)
+
+
+def relu_gradient_op(node, grad_node, ctx=None):
+    """dL/dx for relu given forward input (reference Relu.py ReluGradientOp)."""
+    return FunctionalOp("ReluGradient", lambda x, g: jnp.where(x > 0, g, 0.0),
+                        [node, grad_node], ctx)
+
+
+def leaky_relu_op(node, alpha, ctx=None):
+    return FunctionalOp("LeakyRelu", lambda x, a=alpha: jnp.where(x > 0, x, a * x),
+                        [node], ctx)
+
+
+def leaky_relu_gradient_op(node_A, node_B, alpha, ctx=None):
+    return FunctionalOp("LeakyReluGradient",
+                        lambda x, g, a=alpha: jnp.where(x > 0, g, a * g),
+                        [node_A, node_B], ctx)
+
+
+def sigmoid_op(node, ctx=None):
+    return FunctionalOp("Sigmoid", jax.nn.sigmoid, [node], ctx)
+
+
+def tanh_op(node, ctx=None):
+    return FunctionalOp("Tanh", jnp.tanh, [node], ctx)
+
+
+def gelu_op(node, ctx=None):
+    return FunctionalOp("Gelu", jax.nn.gelu, [node], ctx)
+
+
+def exp_op(node, ctx=None):
+    return FunctionalOp("Exp", jnp.exp, [node], ctx)
+
+
+def log_op(node, ctx=None):
+    return FunctionalOp("Log", jnp.log, [node], ctx)
+
+
+def softmax_func(y):
+    """Numerically-stable softmax over the last axis (reference Softmax.py)."""
+    return jax.nn.softmax(y, axis=-1)
+
+
+def softmax_op(node, ctx=None):
+    return FunctionalOp("Softmax", softmax_func, [node], ctx)
+
+
+def softmax_gradient_op(node_y, grad, ctx=None):
+    """Backward of softmax given forward *output* y (reference SoftmaxGradient)."""
+
+    def _grad(y, dy):
+        return y * (dy - jnp.sum(dy * y, axis=-1, keepdims=True))
+
+    return FunctionalOp("SoftmaxGradient", _grad, [node_y, grad], ctx)

@@ -1,0 +1,130 @@
+"""Communication / placement ops: AllReduce, group AllReduce, host<->device
+transfer markers, pipeline send/recv, and the ``dispatch`` tensor-parallel
+marker.
+
+The reference backs these with MPI+NCCL (``src/communication/
+mpi_nccl_communication.cu``) driven per-op on dedicated streams. On TPU the
+collectives are *compiled into the XLA program*: an AllReduce node lowers to a
+sharding constraint (GSPMD inserts the psum over ICI), pipeline send/recv
+lower to stage boundaries handled by the pipeline executor, and ``dispatch``
+lowers to a PartitionSpec constraint. None of these move bytes from Python.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import Op, FunctionalOp
+
+
+class AllReduceCommunicateOp(Op):
+    """Gradient all-reduce marker (reference AllReduceCommunicate.py:8).
+
+    Under GSPMD data parallelism the psum is inserted by XLA when the
+    (batch-sharded) gradient meets the (replicated) parameter update; this op
+    pins that contract with an explicit replication constraint.
+    """
+
+    def __init__(self, node, comm=None, ctx=None):
+        super().__init__([node], ctx)
+        self.comm = comm
+
+    def compute(self, input_vals, tc):
+        return tc.allreduce(input_vals[0])
+
+
+def allreduceCommunicate_op(node, comm=None, ctx=None):
+    return AllReduceCommunicateOp(node, comm, ctx)
+
+
+class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
+    """Sub-group allreduce used by pipeline+DP (reference :73). The group is a
+    mesh-axis subset; under GSPMD it reduces over the 'dp' axis only."""
+
+    def __init__(self, node, group=None, ctx=None):
+        super().__init__(node, None, ctx)
+        self.group = group
+
+
+def groupallreduceCommunicate_op(node, group=None, ctx=None):
+    return GroupAllReduceCommunicateOp(node, group, ctx)
+
+
+def datah2d_op(node, ctx=None):
+    """Host->device transfer marker (reference DataTransfer.py). XLA owns
+    placement; this is an identity that documents the boundary."""
+    return FunctionalOp("DataH2D", lambda x: x, [node], ctx)
+
+
+def datad2h_op(node, ctx=None):
+    return FunctionalOp("DataD2H", lambda x: x, [node], ctx)
+
+
+class PipelineSendOp(Op):
+    """Stage-boundary send (reference PipelineSend.py). The pipeline executor
+    cuts the graph here; within a fused pipeline step it lowers to a
+    ``lax.ppermute`` to the next stage."""
+
+    def __init__(self, node, destination=None, comm=None, stream=None, ctx=None):
+        super().__init__([node], ctx)
+        self.destination = destination
+
+    def compute(self, input_vals, tc):
+        return tc.pipeline_send(self, input_vals[0])
+
+
+def pipeline_send_op(node, destination=None, comm=None, stream=None, ctx=None):
+    return PipelineSendOp(node, destination, comm, stream, ctx)
+
+
+class PipelineReceiveOp(Op):
+    """Stage-boundary receive (reference PipelineReceive.py). Shapes are
+    resolved at placement time — no dynamic shape handshake (the reference
+    ships shapes as a padded length-3 tensor at runtime; XLA needs static
+    shapes, and placement already knows them)."""
+
+    def __init__(self, source=None, comm=None, stream=None, ctx=None):
+        super().__init__([], ctx)
+        self.source = source
+
+    def compute(self, input_vals, tc):
+        return tc.pipeline_recv(self)
+
+
+def pipeline_receive_op(source=None, comm=None, stream=None, ctx=None):
+    return PipelineReceiveOp(source, comm, stream, ctx)
+
+
+class DispatchOp(Op):
+    """Declarative tensor-partition marker: ``ht.dispatch(node, parts,
+    duplicate)`` (reference Dispatch.py:5).
+
+    The reference replaces these during placement with split/concat + P2P
+    (context.py:184-274). Here the partition tuple maps directly onto a
+    PartitionSpec over the mesh's model axes, and GSPMD materializes the
+    (much cheaper) collectives.
+    """
+
+    def __init__(self, node, parts, duplicate=1, ctx=None):
+        super().__init__([node], ctx)
+        self.parts = tuple(int(p) for p in parts)
+        self.duplicate = int(duplicate)
+
+    def compute(self, input_vals, tc):
+        return tc.apply_dispatch(self, input_vals[0])
+
+
+def dispatch(node, parts, duplicate=1):
+    return DispatchOp(node, parts, duplicate)
+
+
+class DispatchGradientOp(Op):
+    def __init__(self, node, forward_input, ctx=None):
+        super().__init__([node, forward_input], ctx)
+
+    def compute(self, input_vals, tc):
+        return input_vals[0]
+
+
+def dispatch_gradient(node, forward_input):
+    return DispatchGradientOp(node, forward_input)

@@ -1,0 +1,126 @@
+"""Conv2d + pooling ops (NCHW, matching the reference's layout).
+
+Replaces the reference's im2col/cuDNN conv kernels (``src/ops/Conv2d.cu``,
+``CudnnConv2d.cu``) and pooling kernels with ``lax.conv_general_dilated`` /
+``lax.reduce_window`` — XLA tiles these directly onto the MXU; explicit
+gradient ops are provided for API parity (reference conv2d_gradient_of_data/
+filter, pool gradient ops) via jax.vjp of the forward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import FunctionalOp
+
+_DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def _conv2d(x, w, padding, stride):
+    p, s = int(padding), int(stride)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(s, s), padding=[(p, p), (p, p)],
+        dimension_numbers=_DIMNUMS, preferred_element_type=jnp.float32)
+
+
+def conv2d_op(node_A, node_B, padding=0, stride=1, ctx=None):
+    return FunctionalOp("Conv2d", lambda x, w: _conv2d(x, w, padding, stride),
+                        [node_A, node_B], ctx)
+
+
+def conv2d_gradient_of_data_op(node_filter, node_grad_y, padding=0, stride=1, ctx=None):
+    """d(conv)/d(input) given (filter, dY) — reference Conv2d_Gradient_of_DataOp.
+
+    Needs the input spatial size; recovered from dY/filter/stride/padding
+    (valid for the shapes the reference supports: H_in = (H_out-1)*s + kH - 2p).
+    """
+
+    def _grad(w, dy, p=int(padding), s=int(stride)):
+        kh, kw = w.shape[2], w.shape[3]
+        hin = (dy.shape[2] - 1) * s + kh - 2 * p
+        win = (dy.shape[3] - 1) * s + kw - 2 * p
+        n, cin = dy.shape[0], w.shape[1]
+        x_shape = (n, cin, hin, win)
+        _, vjp = jax.vjp(lambda x: _conv2d(x, w, p, s), jnp.zeros(x_shape, dy.dtype))
+        return vjp(dy)[0]
+
+    return FunctionalOp("Conv2dGradientOfData", _grad, [node_filter, node_grad_y], ctx)
+
+
+def conv2d_gradient_of_filter_op(input_X, gradient_Y, padding=0, stride=1, ctx=None):
+    def _grad(x, dy, p=int(padding), s=int(stride)):
+        cout, cin = dy.shape[1], x.shape[1]
+        kh = x.shape[2] + 2 * p - (dy.shape[2] - 1) * s
+        kw = x.shape[3] + 2 * p - (dy.shape[3] - 1) * s
+        w_shape = (cout, cin, kh, kw)
+        _, vjp = jax.vjp(lambda w: _conv2d(x, w, p, s), jnp.zeros(w_shape, dy.dtype))
+        return vjp(dy)[0]
+
+    return FunctionalOp("Conv2dGradientOfFilter", _grad, [input_X, gradient_Y], ctx)
+
+
+def conv2d_broadcastto_op(node_A, node_B, ctx=None):
+    """Broadcast per-channel bias (C,) over (N,C,H,W) (reference Conv2dBroadcast)."""
+    return FunctionalOp("Conv2dBroadcastTo",
+                        lambda b, x: jnp.broadcast_to(b[None, :, None, None], x.shape),
+                        [node_A, node_B], ctx)
+
+
+def conv2d_reducesum_op(node_A, ctx=None):
+    """Reduce (N,C,H,W) over N,H,W -> (C,) — gradient of the bias broadcast."""
+    return FunctionalOp("Conv2dReduceSum", lambda x: jnp.sum(x, axis=(0, 2, 3)),
+                        [node_A], ctx)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _max_pool(x, kh, kw, p, s):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 1, kh, kw), (1, 1, s, s),
+        [(0, 0), (0, 0), (p, p), (p, p)])
+
+
+def _avg_pool(x, kh, kw, p, s):
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, s, s),
+        [(0, 0), (0, 0), (p, p), (p, p)])
+    # count_include_pad=True, matching the reference's divide-by-kernel-area
+    return summed / float(kh * kw)
+
+
+def max_pool2d_op(node_A, kernel_H, kernel_W, padding, stride, ctx=None):
+    kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
+    return FunctionalOp("MaxPool2d", lambda x: _max_pool(x, kh, kw, p, s),
+                        [node_A], ctx)
+
+
+def max_pool2d_gradient_op(node_out, node_out_gradient, node_in,
+                           kernel_H, kernel_W, padding, stride, ctx=None):
+    kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
+
+    def _grad(_y, dy, x):
+        _, vjp = jax.vjp(lambda v: _max_pool(v, kh, kw, p, s), x)
+        return vjp(dy)[0]
+
+    return FunctionalOp("MaxPool2dGradient", _grad,
+                        [node_out, node_out_gradient, node_in], ctx)
+
+
+def avg_pool2d_op(node_A, kernel_H, kernel_W, padding, stride, ctx=None):
+    kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
+    return FunctionalOp("AvgPool2d", lambda x: _avg_pool(x, kh, kw, p, s),
+                        [node_A], ctx)
+
+
+def avg_pool2d_gradient_op(node_out, node_out_gradient, node_in,
+                           kernel_H, kernel_W, padding, stride, ctx=None):
+    kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
+
+    def _grad(_y, dy, x):
+        _, vjp = jax.vjp(lambda v: _avg_pool(v, kh, kw, p, s), x)
+        return vjp(dy)[0]
+
+    return FunctionalOp("AvgPool2dGradient", _grad,
+                        [node_out, node_out_gradient, node_in], ctx)

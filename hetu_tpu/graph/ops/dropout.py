@@ -1,0 +1,77 @@
+"""Dropout / Dropout2d — RNG-consuming ops.
+
+Replaces the reference's curand mask kernels (``src/ops/Dropout.cu``,
+``Dropout2d.cu``). RNG is functional: the executor folds a per-step PRNGKey
+with the node id (``tc.next_rng``), so repeated traces are deterministic and
+the reference's hidden mask buffers (DropoutOp keeps the mask for the
+backward pass) are unnecessary — autodiff differentiates through the mask.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import Op
+
+
+class DropoutOp(Op):
+    needs_rng = True
+
+    def __init__(self, node_in, keep_prob, ctx=None, channelwise=False):
+        super().__init__([node_in], ctx)
+        self.keep_prob = float(keep_prob)
+        self.channelwise = channelwise
+
+    def compute(self, input_vals, tc):
+        (x,) = input_vals
+        if not tc.training or self.keep_prob >= 1.0:
+            return x
+        rng = tc.next_rng(self)
+        if self.channelwise:
+            mask_shape = x.shape[:2] + (1,) * (x.ndim - 2)
+        else:
+            mask_shape = x.shape
+        mask = jax.random.bernoulli(rng, self.keep_prob, mask_shape)
+        return jnp.where(mask, x / self.keep_prob, 0.0)
+
+
+def dropout_op(node_in, keep_prob, ctx=None):
+    return DropoutOp(node_in, keep_prob, ctx)
+
+
+def dropout2d_op(node_in, keep_prob, ctx=None):
+    """Drops whole channels of an (N, C, H, W) tensor (reference Dropout2d)."""
+    return DropoutOp(node_in, keep_prob, ctx, channelwise=True)
+
+
+class DropoutGradientOp(Op):
+    """API-parity gradient op: regenerates the forward mask from the paired
+    forward node's RNG and applies it to the incoming grad."""
+
+    needs_rng = True
+
+    def __init__(self, node_in, keep_prob, forward_node, ctx=None, channelwise=False):
+        super().__init__([node_in], ctx)
+        self.keep_prob = float(keep_prob)
+        self.forward_node = forward_node
+        self.channelwise = channelwise
+
+    def compute(self, input_vals, tc):
+        (g,) = input_vals
+        if not tc.training or self.keep_prob >= 1.0:
+            return g
+        rng = tc.next_rng(self.forward_node)
+        if self.channelwise:
+            mask_shape = g.shape[:2] + (1,) * (g.ndim - 2)
+        else:
+            mask_shape = g.shape
+        mask = jax.random.bernoulli(rng, self.keep_prob, mask_shape)
+        return jnp.where(mask, g / self.keep_prob, 0.0)
+
+
+def dropout_gradient_op(node_in, keep_prob, forward_node, ctx=None):
+    return DropoutGradientOp(node_in, keep_prob, forward_node, ctx)
+
+
+def dropout2d_gradient_op(node_in, keep_prob, forward_node, ctx=None):
+    return DropoutGradientOp(node_in, keep_prob, forward_node, ctx, channelwise=True)

@@ -1,0 +1,36 @@
+"""Embedding lookup + sparse gradient.
+
+Replaces the reference's EmbeddingLookup gather kernel
+(``src/ops/EmbeddingLookup.cu``) and the IndexedSlices scatter path
+(``OptimizersSparse.cu``). ``jnp.take`` lowers to a TPU gather; its vjp is a
+scatter-add, which XLA sorts/segments efficiently. When the embedding variable
+is PS-hosted (comm_mode PS/Hybrid), the executor routes lookups through the
+parameter-server client instead (see ops/ps.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..node import FunctionalOp
+
+
+def embedding_lookup_op(embedding, index, ctx=None):
+    def _lookup(table, idx):
+        return jnp.take(table, idx.astype(jnp.int32), axis=0)
+
+    op = FunctionalOp("EmbeddingLookUp", _lookup, [embedding, index], ctx)
+    op.embed_node = embedding
+    return op
+
+
+def embedding_lookup_gradient_op(vectors, index, embed_shape, ctx=None):
+    """Dense scatter-add of lookup grads into a zeros table (the reference
+    returns IndexedSlices; on TPU a fused scatter-add is preferred)."""
+    shape = tuple(int(s) for s in embed_shape)
+
+    def _grad(vec, idx):
+        flat_idx = idx.astype(jnp.int32).reshape(-1)
+        flat_vec = vec.reshape((-1, shape[-1]))
+        return jnp.zeros(shape, vec.dtype).at[flat_idx].add(flat_vec)
+
+    return FunctionalOp("EmbeddingLookUpGradient", _grad, [vectors, index], ctx)

@@ -1,0 +1,58 @@
+"""Loss ops: softmax cross-entropy (fused) and binary cross-entropy.
+
+Replaces the reference's fused SoftmaxCrossEntropy kernel
+(``src/ops/SoftmaxCrossEntropy.cu`` and the cuDNN variant). The
+log-softmax + weighted-sum composition here fuses into a single XLA reduction
+on TPU — numerically identical to the reference's max-subtracted form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import FunctionalOp
+
+
+def _softmax_ce(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(labels * logp, axis=-1)
+
+
+def softmaxcrossentropy_op(node_A, node_B, use_cudnn=True, ctx=None):
+    """Per-example CE between logits (N, C) and one-hot labels (N, C).
+
+    ``use_cudnn`` is accepted and ignored (reference SoftmaxCrossEntropy.py).
+    """
+    return FunctionalOp("SoftmaxCrossEntropy", _softmax_ce, [node_A, node_B], ctx)
+
+
+def softmaxcrossentropy_gradient_op(node_A, node_B, node_C, use_cudnn=True, ctx=None):
+    """(softmax(logits) - labels) * dL — reference SoftmaxCrossEntropyGradient."""
+
+    def _grad(logits, labels, dl):
+        return (jax.nn.softmax(logits, axis=-1) - labels) * dl[..., None]
+
+    return FunctionalOp("SoftmaxCrossEntropyGradient", _grad,
+                        [node_A, node_B, node_C], ctx)
+
+
+def binarycrossentropy_op(node_A, node_B, ctx=None):
+    """Elementwise BCE between prediction probabilities and labels
+    (reference BinaryCrossEntropy.py)."""
+
+    def _bce(pred, label):
+        eps = 1e-12
+        pred = jnp.clip(pred, eps, 1.0 - eps)
+        return -(label * jnp.log(pred) + (1.0 - label) * jnp.log(1.0 - pred))
+
+    return FunctionalOp("BinaryCrossEntropy", _bce, [node_A, node_B], ctx)
+
+
+def binarycrossentropy_gradient_op(node_A, node_B, node_C, ctx=None):
+    def _grad(pred, label, dl):
+        eps = 1e-12
+        pred = jnp.clip(pred, eps, 1.0 - eps)
+        return (pred - label) / (pred * (1.0 - pred)) * dl
+
+    return FunctionalOp("BinaryCrossEntropyGradient", _grad,
+                        [node_A, node_B, node_C], ctx)

@@ -1,0 +1,96 @@
+"""Matrix-multiply family: matmul, batched matmul, tensordot, CSR sparse.
+
+Replaces the reference's cuBLAS-backed MatrixMult/BatchMatrixMult
+(``src/ops/MatrixMult.cu``) and cuSPARSE csrmv/csrmm (``src/ops/CuSparse.cu``).
+Dense matmuls are ``jnp.dot`` in bf16-accumulate-f32 — they land directly on
+the MXU. The CSR products are expressed as gather + segment-sum, which XLA
+lowers to sorted-scatter; rows ride the VPU, which is the right trade on TPU
+where true sparse units don't exist.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..node import FunctionalOp, Op
+
+
+def matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
+    def _mm(a, b, ta=trans_A, tb=trans_B):
+        if ta:
+            a = a.T
+        if tb:
+            b = b.T
+        return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+    return FunctionalOp("MatMul", _mm, [node_A, node_B], ctx)
+
+
+def batch_matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
+    def _bmm(a, b, ta=trans_A, tb=trans_B):
+        if ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+    return FunctionalOp("BatchMatMul", _bmm, [node_A, node_B], ctx)
+
+
+def matrix_dot_op(node_A, node_B, axes=0, ctx=None):
+    """Elementwise multiply (reference MatrixDot.py — despite the name, its
+    kernel is an elementwise product; kept for API parity)."""
+    return FunctionalOp("MatrixDot", jnp.multiply, [node_A, node_B], ctx)
+
+
+# ---------------------------------------------------------------------------
+# CSR sparse products. The sparse operand is fed as a ``ND_Sparse_Array``
+# (COO rows/cols + values); at trace time it arrives as three arrays.
+# ---------------------------------------------------------------------------
+
+class SparseInputOp(Op):
+    """Adapter node whose runtime value is the (values, rows, cols, nrow, ncol)
+    tuple of a fed ND_Sparse_Array."""
+
+    is_placeholder = True
+
+    def __init__(self, name=None, ctx=None):
+        super().__init__([], ctx, name or "SparseInput")
+        self.trainable = False
+        self.is_feed = True
+
+
+def _coo_matvec(values, rows, cols, nrow, x):
+    contrib = values * jnp.take(x, cols, axis=0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=nrow)
+
+
+def _coo_matmat(values, rows, cols, nrow, B):
+    contrib = values[:, None] * jnp.take(B, cols, axis=0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=nrow)
+
+
+def csrmv_op(node_A, node_B, trans=False, ctx=None):
+    """Sparse(A) @ dense-vector(B); ``trans`` multiplies by Aᵀ."""
+
+    def _mv(a, x, t=trans):
+        values, rows, cols, nrow, ncol = a
+        if t:
+            rows, cols, nrow = cols, rows, ncol
+        return _coo_matvec(values, rows, cols, nrow, x)
+
+    return FunctionalOp("CSRMatVec", _mv, [node_A, node_B], ctx)
+
+
+def csrmm_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
+    """Sparse(A) @ dense-matrix(B)."""
+
+    def _mm(a, B, ta=trans_A, tb=trans_B):
+        values, rows, cols, nrow, ncol = a
+        if tb:
+            B = B.T
+        if ta:
+            rows, cols, nrow = cols, rows, ncol
+        return _coo_matmat(values, rows, cols, nrow, B)
+
+    return FunctionalOp("CSRMatMat", _mm, [node_A, node_B], ctx)

@@ -1,0 +1,81 @@
+"""Normalization ops: BatchNorm (stateful running stats), LayerNorm,
+InstanceNorm2d.
+
+Replaces the reference's hand-written reduction kernels
+(``src/ops/LayerNorm.cu`` — a 387-line two-pass reduction — ``BatchNorm.cu``,
+``InstanceNorm2d.cu``, and their cuDNN variants). On TPU these are small jnp
+reductions that XLA fuses into one pass; the BatchNorm running-mean/var state
+is threaded functionally by the executor (reference keeps it as hidden mutable
+arrays inside the op, BatchNorm.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..node import FunctionalOp, Op
+
+
+class BatchNormOp(Op):
+    """Batch normalization over (N, C, H, W) with per-channel scale/bias.
+
+    Reference gpu_ops/BatchNorm.py: inputs (x, scale, bias); running stats are
+    op state, updated only in training.
+    """
+
+    stateful = True
+
+    def __init__(self, node_in, bn_scale, bn_bias, momentum=0.99, eps=0.01, ctx=None):
+        super().__init__([node_in, bn_scale, bn_bias], ctx)
+        self.momentum = float(momentum)
+        self.eps = float(eps)
+
+    def state_init(self):
+        shape = getattr(self.inputs[1], "shape", None)
+        assert shape is not None, "BatchNorm scale must be a Variable with known shape"
+        c = int(np.prod(shape))
+        return {"mean": np.zeros((c,), np.float32), "var": np.ones((c,), np.float32)}
+
+    def compute_stateful(self, input_vals, state, tc):
+        x, scale, bias = input_vals
+        scale = scale.reshape((1, -1) + (1,) * (x.ndim - 2))
+        bias = bias.reshape((1, -1) + (1,) * (x.ndim - 2))
+        axes = (0,) + tuple(range(2, x.ndim))
+        if tc.training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1.0 - m) * mean,
+                "var": m * state["var"] + (1.0 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        norm = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.eps)
+        return norm * scale + bias, new_state
+
+
+def batch_normalization_op(node_in, bn_scale, bn_bias, momentum=0.99, eps=0.01, ctx=None):
+    return BatchNormOp(node_in, bn_scale, bn_bias, momentum, eps, ctx)
+
+
+def _ln(x, scale, bias, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * scale + bias
+
+
+def layer_normalization_op(node_in, ln_scale, ln_bias, eps=0.01, ctx=None):
+    return FunctionalOp("LayerNorm", lambda x, s, b, e=float(eps): _ln(x, s, b, e),
+                        [node_in, ln_scale, ln_bias], ctx)
+
+
+def instance_normalization2d_op(node_in, eps=0.01, ctx=None):
+    def _in2d(x, e=float(eps)):
+        mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+        var = jnp.var(x, axis=(2, 3), keepdims=True)
+        return (x - mean) / jnp.sqrt(var + e)
+
+    return FunctionalOp("InstanceNorm2d", _in2d, [node_in], ctx)

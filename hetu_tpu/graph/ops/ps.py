@@ -1,0 +1,47 @@
+"""Parameter-server communication ops (graph-level markers).
+
+Reference: ``gpu_ops/ParameterServerCommunicate.py`` — push/pull of grads and
+params to the ps-lite server, with an ASP/BSP x prefetch x dense/sparse/cache
+strategy matrix. In the TPU build the server is ``hetu_tpu.ps`` (host-resident
+C++ KV store); these ops bridge the jitted step to the host client via
+``jax.experimental.io_callback`` at the step boundary — the executor splits
+PS traffic out of the XLA program the same way the reference routes it to the
+d2h stream.
+"""
+from __future__ import annotations
+
+from ..node import Op
+
+
+class ParameterServerCommunicateOp(Op):
+    """Push a gradient to the PS (and pull back the fresh parameter)."""
+
+    is_ps = True
+
+    def __init__(self, node, ps_id=None, optimizer=None, ctx=None):
+        super().__init__([node], ctx)
+        self.ps_id = ps_id
+        self.optimizer = optimizer
+
+    def compute(self, input_vals, tc):
+        return tc.ps_push_pull(self, input_vals[0])
+
+
+def parameterServerCommunicate_op(node, ps_id=None, optimizer=None, ctx=None):
+    return ParameterServerCommunicateOp(node, ps_id, optimizer, ctx)
+
+
+class ParameterServerSparsePullOp(Op):
+    """Inference-time sparse pull of embedding rows (reference :236)."""
+
+    is_ps = True
+
+    def __init__(self, node_embed, node_index, ctx=None):
+        super().__init__([node_embed, node_index], ctx)
+
+    def compute(self, input_vals, tc):
+        return tc.ps_sparse_pull(self, input_vals)
+
+
+def parameterServerSparsePull_op(node_embed, node_index, ctx=None):
+    return ParameterServerSparsePullOp(node_embed, node_index, ctx)

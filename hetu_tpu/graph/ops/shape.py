@@ -1,0 +1,185 @@
+"""Shape/layout ops: reshape, transpose, slice, split, concat, pad, broadcast,
+reductions, one-hot.
+
+Replaces the reference's Reshape/Transpose/Slice/Split/Concat/Pad/Broadcast/
+BroadcastShape/ReduceSum/ReduceMean/ReduceSumAxisZero/OneHot CUDA kernels
+(``src/ops``). All of these are pure data-movement in XLA and usually fuse
+away entirely (the reference's lazy no-copy reshape/broadcast trick,
+ndarray.py:290-356, is XLA's default behavior).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..node import FunctionalOp
+
+
+def array_reshape_op(node, output_shape, ctx=None):
+    return FunctionalOp("ArrayReshape",
+                        lambda x, s=tuple(output_shape): jnp.reshape(x, s),
+                        [node], ctx)
+
+
+def array_reshape_gradient_op(node_in, node_out, ctx=None):
+    """Reshape grad back to the forward input's shape."""
+    return FunctionalOp("ArrayReshapeGradient",
+                        lambda x_in, g: jnp.reshape(g, x_in.shape),
+                        [node_in, node_out], ctx)
+
+
+def transpose_op(node, perm=None, ctx=None):
+    return FunctionalOp("Transpose", lambda x, p=perm: jnp.transpose(x, p), [node], ctx)
+
+
+def slice_op(node, begin, size, ctx=None):
+    begin = tuple(int(b) for b in begin)
+    size = tuple(int(s) for s in size)
+
+    def _slice(x):
+        sz = tuple(x.shape[i] - begin[i] if size[i] == -1 else size[i]
+                   for i in range(len(size)))
+        return jax.lax.dynamic_slice(x, begin, sz)
+
+    return FunctionalOp("Slice", _slice, [node], ctx)
+
+
+def slice_gradient_op(node, begin, size=None, ctx=None):
+    """Scatter the sliced grad back into zeros of the forward-input shape.
+
+    ``size`` here is the forward input's full shape (the reference recovers it
+    from the paired forward op at placement time, Slice.py).
+    """
+    begin = tuple(int(b) for b in begin)
+    out_shape = None if size is None else tuple(int(s) for s in size)
+
+    def _grad(g):
+        assert out_shape is not None, "slice_gradient_op needs the input shape"
+        out = jnp.zeros(out_shape, dtype=g.dtype)
+        return jax.lax.dynamic_update_slice(out, g, begin)
+
+    return FunctionalOp("SliceGradient", _grad, [node], ctx)
+
+
+def split_op(node, axes, indices, splits, ctx=None):
+    """Take partition ``indices[k]`` of ``splits[k]`` along each ``axes[k]``
+    (reference Split.py — multi-axis block split used by model parallelism)."""
+    axes = [int(a) for a in np.atleast_1d(axes)]
+    indices = [int(i) for i in np.atleast_1d(indices)]
+    splits = [int(s) for s in np.atleast_1d(splits)]
+
+    def _split(x):
+        out = x
+        for ax, idx, sp in zip(axes, indices, splits):
+            dim = out.shape[ax]
+            assert dim % sp == 0, f"axis {ax} size {dim} not divisible by {sp}"
+            part = dim // sp
+            out = jax.lax.slice_in_dim(out, idx * part, (idx + 1) * part, axis=ax)
+        return out
+
+    return FunctionalOp("Split", _split, [node], ctx)
+
+
+def split_gradient_op(node, axes, indices, splits, ctx=None):
+    axes = [int(a) for a in np.atleast_1d(axes)]
+    indices = [int(i) for i in np.atleast_1d(indices)]
+    splits = [int(s) for s in np.atleast_1d(splits)]
+
+    def _grad(g):
+        shape = list(g.shape)
+        starts = [0] * g.ndim
+        for ax, idx, sp in zip(axes, indices, splits):
+            shape[ax] = g.shape[ax] * sp
+            starts[ax] = idx * g.shape[ax]
+        out = jnp.zeros(tuple(shape), dtype=g.dtype)
+        return jax.lax.dynamic_update_slice(out, g, tuple(starts))
+
+    return FunctionalOp("SplitGradient", _grad, [node], ctx)
+
+
+def concat_op(node_A, node_B, axis=0, ctx=None):
+    return FunctionalOp("Concat",
+                        lambda a, b, ax=axis: jnp.concatenate([a, b], axis=ax),
+                        [node_A, node_B], ctx)
+
+
+def concat_gradient_op(grad_node, input_node, axis, idx, ctx=None):
+    """Slice the grad chunk belonging to input ``idx`` (0 or 1) back out."""
+
+    def _grad(g, x_in, ax=int(axis), which=int(idx)):
+        size = x_in.shape[ax]
+        start = 0 if which == 0 else g.shape[ax] - size
+        return jax.lax.slice_in_dim(g, start, start + size, axis=ax)
+
+    return FunctionalOp("ConcatGradient", _grad, [grad_node, input_node], ctx)
+
+
+def pad_op(node, paddings, mode="CONSTANT", constant_values=0, ctx=None):
+    pads = [tuple(int(v) for v in p) for p in paddings]
+    assert mode.upper() == "CONSTANT", "only CONSTANT pad supported (as reference)"
+
+    def _pad(x):
+        full = [(0, 0)] * (x.ndim - len(pads)) + pads
+        return jnp.pad(x, full, constant_values=constant_values)
+
+    return FunctionalOp("Pad", _pad, [node], ctx)
+
+
+def pad_gradient_op(node, paddings, mode="CONSTANT", ctx=None):
+    pads = [tuple(int(v) for v in p) for p in paddings]
+
+    def _grad(g):
+        full = [(0, 0)] * (g.ndim - len(pads)) + pads
+        idx = tuple(slice(lo, g.shape[i] - hi) for i, (lo, hi) in enumerate(full))
+        return g[idx]
+
+    return FunctionalOp("PadGradient", _grad, [node], ctx)
+
+
+def broadcastto_op(node_A, node_B, ctx=None):
+    """Broadcast A to B's shape with numpy trailing-dim alignment
+    (reference Broadcast.py)."""
+
+    def _bc(a, b):
+        return jnp.broadcast_to(a, b.shape)
+
+    return FunctionalOp("BroadcastTo", _bc, [node_A, node_B], ctx)
+
+
+def broadcast_shape_op(node, shape, add_axes=(), ctx=None):
+    shape = tuple(int(s) for s in shape)
+    add_axes = tuple(int(a) for a in add_axes)
+
+    def _bc(x):
+        y = x
+        for ax in sorted(add_axes):
+            y = jnp.expand_dims(y, ax)
+        return jnp.broadcast_to(y, shape)
+
+    return FunctionalOp("BroadcastShape", _bc, [node], ctx)
+
+
+def reduce_sum_op(node, axes, keepdims=False, ctx=None):
+    axes = tuple(int(a) for a in np.atleast_1d(axes))
+    return FunctionalOp("ReduceSum",
+                        lambda x: jnp.sum(x, axis=axes, keepdims=keepdims),
+                        [node], ctx)
+
+
+def reduce_mean_op(node, axes, keepdims=False, ctx=None):
+    axes = tuple(int(a) for a in np.atleast_1d(axes))
+    return FunctionalOp("ReduceMean",
+                        lambda x: jnp.mean(x, axis=axes, keepdims=keepdims),
+                        [node], ctx)
+
+
+def reducesumaxiszero_op(node, ctx=None):
+    return FunctionalOp("ReduceSumAxisZero", lambda x: jnp.sum(x, axis=0), [node], ctx)
+
+
+def one_hot_op(node, num_classes, ctx=None):
+    return FunctionalOp("OneHot",
+                        lambda x, n=int(num_classes): jax.nn.one_hot(
+                            x.astype(jnp.int32), n, dtype=jnp.float32),
+                        [node], ctx)

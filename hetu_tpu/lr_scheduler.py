@@ -1,0 +1,161 @@
+"""Learning-rate schedulers (reference ``python/hetu/lr_scheduler.py``).
+
+Same classes and stateful ``step()/get()`` surface as the reference, plus a
+``get_traced(step)`` form used inside the jitted training step so schedules
+compile into the XLA program (no retrace per LR change).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class FixedScheduler:
+    def __init__(self, learning_rate):
+        assert learning_rate >= 0
+        self.learning_rate = learning_rate
+        self.step_count = 0
+
+    def step(self):
+        self.step_count += 1
+        return self.get()
+
+    def get(self):
+        return self.learning_rate
+
+    def get_traced(self, step):
+        return jnp.asarray(self.learning_rate, jnp.float32)
+
+    def host_token(self):
+        """Host-side state baked into the traced program as a constant; the
+        executor includes this in its compile-cache key so host-driven lr
+        changes trigger a retrace."""
+        return None
+
+
+class StepScheduler(FixedScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, ending=1e-8):
+        super().__init__(learning_rate)
+        assert step_size > 0
+        self.step_size = int(step_size)
+        self.gamma = float(gamma)
+        self.ending = float(ending)
+
+    def get(self):
+        lr = self.learning_rate * self.gamma ** (self.step_count // self.step_size)
+        return max(lr, self.ending)
+
+    def get_traced(self, step):
+        lr = self.learning_rate * self.gamma ** jnp.floor_divide(step, self.step_size)
+        return jnp.maximum(lr, self.ending).astype(jnp.float32)
+
+
+class MultiStepScheduler(FixedScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1):
+        super().__init__(learning_rate)
+        self.milestones = sorted(int(m) for m in milestones)
+        self.gamma = float(gamma)
+
+    def get(self):
+        k = sum(1 for m in self.milestones if self.step_count >= m)
+        return self.learning_rate * self.gamma ** k
+
+    def get_traced(self, step):
+        ms = jnp.asarray(self.milestones, jnp.int32)
+        k = jnp.sum(step >= ms)
+        return (self.learning_rate * self.gamma ** k).astype(jnp.float32)
+
+
+class ExponentialScheduler(FixedScheduler):
+    def __init__(self, learning_rate, gamma=0.9, ending=1e-8):
+        super().__init__(learning_rate)
+        self.gamma = float(gamma)
+        self.ending = float(ending)
+
+    def get(self):
+        return max(self.learning_rate * self.gamma ** self.step_count, self.ending)
+
+    def get_traced(self, step):
+        lr = self.learning_rate * self.gamma ** step.astype(jnp.float32)
+        return jnp.maximum(lr, self.ending).astype(jnp.float32)
+
+
+class CosineScheduler(FixedScheduler):
+    """Cosine decay to ``ending`` over ``decay_steps`` (a TPU-build addition —
+    the reference ships ReduceOnPlateau instead; both are provided)."""
+
+    def __init__(self, learning_rate, decay_steps, ending=0.0, warmup_steps=0):
+        super().__init__(learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.ending = float(ending)
+        self.warmup_steps = int(warmup_steps)
+
+    def get(self):
+        return float(self.get_traced(jnp.asarray(self.step_count)))
+
+    def get_traced(self, step):
+        step_f = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        warm = jnp.minimum(step_f / max(self.warmup_steps, 1), 1.0) \
+            if self.warmup_steps > 0 else 1.0
+        frac = jnp.clip(step_f / max(self.decay_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(np.pi * frac))
+        lr = self.ending + (self.learning_rate - self.ending) * cos
+        return (warm * lr).astype(jnp.float32)
+
+
+class ReduceOnPlateauScheduler(FixedScheduler):
+    """Host-driven plateau scheduler (reference lr_scheduler.py:83). Being
+    value-driven it cannot be traced; ``get_traced`` returns the current lr as
+    a constant, so each reduction triggers one retrace — acceptable because
+    reductions are rare."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, ending=1e-8):
+        super().__init__(learning_rate)
+        assert mode in ("min", "max")
+        assert threshold_mode in ("rel", "abs")
+        self.mode = mode
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.threshold = float(threshold)
+        self.threshold_mode = threshold_mode
+        self.cooldown = int(cooldown)
+        self.ending = float(ending)
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_count = 0
+        self.cur_lr = learning_rate
+
+    def _better(self, value):
+        if self.best is None:
+            return True
+        if self.threshold_mode == "rel":
+            delta = self.threshold * abs(self.best)
+        else:
+            delta = self.threshold
+        return value < self.best - delta if self.mode == "min" \
+            else value > self.best + delta
+
+    def step(self, value):
+        self.step_count += 1
+        if self._better(value):
+            self.best = value
+            self.num_bad = 0
+        elif self.cooldown_count > 0:
+            self.cooldown_count -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.cur_lr = max(self.cur_lr * self.factor, self.ending)
+                self.num_bad = 0
+                self.cooldown_count = self.cooldown
+        return self.cur_lr
+
+    def get(self):
+        return self.cur_lr
+
+    def get_traced(self, step):
+        return jnp.asarray(self.cur_lr, jnp.float32)
+
+    def host_token(self):
+        return self.cur_lr

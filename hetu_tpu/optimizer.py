@@ -1,0 +1,205 @@
+"""Optimizers: SGD / Momentum(+Nesterov) / AdaGrad / Adam (+AdamW).
+
+Capability parity with the reference's ``python/hetu/optimizer.py``
+(Optimizer :13, OptimizerOp :85, minimize :64). The reference applies updates
+with fused CUDA kernels (``src/ops/Optimizers.cu``) and rewrites gradient
+inputs into AllReduce/PS communication ops in ``backward_hook`` (:125-139).
+Here the update rules are pure jax expressions traced into the same XLA
+program as the step (XLA fuses them into the gradient epilogue), and the
+comm-op rewrite happens once in ``OptimizerOp.insert_comm_ops`` at executor
+construction.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from .graph.node import Op, PlaceholderOp, find_topo_sort
+from .graph.gradients import gradients
+
+
+class Optimizer:
+    """Base optimizer holding the lr (float or an ``lr_scheduler``)."""
+
+    def __init__(self, learning_rate, l2reg=0.0):
+        self.learning_rate = learning_rate
+        self.l2reg = float(l2reg)
+
+    # -- graph construction -------------------------------------------------
+    def minimize(self, loss, var_list: Optional[Sequence[Op]] = None):
+        if var_list is None:
+            var_list = [n for n in find_topo_sort([loss])
+                        if isinstance(n, PlaceholderOp) and n.trainable]
+        grads = gradients(loss, var_list)
+        return OptimizerOp(grads, self, var_list)
+
+    def get_gradients(self, loss, var_list=None):
+        if var_list is None:
+            var_list = [n for n in find_topo_sort([loss])
+                        if isinstance(n, PlaceholderOp) and n.trainable]
+        return gradients(loss, var_list), var_list
+
+    # -- traced update rules -------------------------------------------------
+    def lr_value(self, step):
+        lr = self.learning_rate
+        if hasattr(lr, "get_traced"):
+            return lr.get_traced(step)
+        if hasattr(lr, "get"):
+            return lr.get()
+        return lr
+
+    def _regularized(self, param, grad):
+        if self.l2reg > 0:
+            return grad + self.l2reg * param
+        return grad
+
+    def slot_init(self, param):
+        return ()
+
+    def cache_token(self):
+        """Host-side state that gets baked into the traced step as constants
+        (e.g. ReduceOnPlateau's current lr) — part of the compile-cache key."""
+        lr = self.learning_rate
+        if hasattr(lr, "host_token"):
+            return lr.host_token()
+        return None
+
+    def apply_dense(self, param, grad, slot, lr):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+
+    def apply_dense(self, param, grad, slot, lr):
+        grad = self._regularized(param, grad)
+        return param - lr * grad, slot
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, nesterov=False, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.momentum = float(momentum)
+        self.nesterov = nesterov
+
+    def slot_init(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def apply_dense(self, param, grad, slot, lr):
+        grad = self._regularized(param, grad)
+        v = self.momentum * slot["velocity"] - lr * grad
+        if self.nesterov:
+            new_param = param + self.momentum * v - lr * grad
+        else:
+            new_param = param + v
+        return new_param, {"velocity": v}
+
+
+class AdaGradOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, initial_accumulator_value=0.0,
+                 eps=1e-7, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.initial_accumulator_value = float(initial_accumulator_value)
+        self.eps = float(eps)
+
+    def slot_init(self, param):
+        return {"accum": jnp.full_like(param, self.initial_accumulator_value)}
+
+    def apply_dense(self, param, grad, slot, lr):
+        grad = self._regularized(param, grad)
+        accum = slot["accum"] + grad * grad
+        new_param = param - lr * grad / (jnp.sqrt(accum) + self.eps)
+        return new_param, {"accum": accum}
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7, l2reg=0.0, weight_decay=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+        self.weight_decay = float(weight_decay)
+
+    def slot_init(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def apply_dense(self, param, grad, slot, lr):
+        grad = self._regularized(param, grad)
+        t = slot["t"] + 1.0
+        m = self.beta1 * slot["m"] + (1.0 - self.beta1) * grad
+        v = self.beta2 * slot["v"] + (1.0 - self.beta2) * grad * grad
+        m_hat = m / (1.0 - self.beta1 ** t)
+        v_hat = v / (1.0 - self.beta2 ** t)
+        new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        if self.weight_decay > 0:
+            new_param = new_param - lr * self.weight_decay * param
+        return new_param, {"m": m, "v": v, "t": t}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7, weight_decay=0.01):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         l2reg=0.0, weight_decay=weight_decay)
+
+
+class OptimizerOp(Op):
+    """The graph node applying updates to every trainable var
+    (reference optimizer.py:85)."""
+
+    is_optimizer = True
+
+    def __init__(self, grads, optimizer: Optimizer, var_list):
+        super().__init__(list(grads), None)
+        self.optimizer = optimizer
+        self.vars = list(var_list)
+        self.name = f"Optimizer_{type(optimizer).__name__}_{self.id}"
+        self._comm_inserted = False
+
+    # -- comm strategy rewrite (reference backward_hook optimizer.py:125) ---
+    def insert_comm_ops(self, config):
+        if self._comm_inserted:
+            return
+        self._comm_inserted = True
+        mode = config.comm_mode
+        if mode is None:
+            return
+        from .graph.ops.comm import allreduceCommunicate_op
+        from .graph.ops.ps import parameterServerCommunicate_op
+        new_inputs = []
+        for var, grad in zip(self.vars, self.inputs):
+            sparse = getattr(var, "is_embed", False)
+            if mode == "AllReduce" or (mode == "Hybrid" and not sparse):
+                new_inputs.append(allreduceCommunicate_op(grad))
+            elif mode == "PS" or (mode == "Hybrid" and sparse):
+                new_inputs.append(parameterServerCommunicate_op(
+                    grad, ps_id=var.name, optimizer=self.optimizer))
+            else:
+                new_inputs.append(grad)
+        self.inputs = new_inputs
+
+    # -- executor protocol --------------------------------------------------
+    def init_slots(self, params_by_id):
+        return tuple(self.optimizer.slot_init(params_by_id[id(v)]) for v in self.vars)
+
+    def apply_updates(self, env, slots, tc):
+        lr = self.optimizer.lr_value(tc.step)
+        new_slots = []
+        for var, grad_node, slot in zip(self.vars, self.inputs, slots):
+            param = env[id(var)]
+            grad = env[id(grad_node)]
+            if grad is None:  # PS-managed parameter: server applied the update
+                new_slots.append(slot)
+                continue
+            new_param, new_slot = self.optimizer.apply_dense(param, grad, slot, lr)
+            tc.param_updates[id(var)] = new_param
+            new_slots.append(new_slot)
+        tc.slot_updates[id(self)] = tuple(new_slots)
+
+    def compute(self, input_vals, tc):
+        raise AssertionError("OptimizerOp is applied by the executor")

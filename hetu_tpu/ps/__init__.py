@@ -1,0 +1,53 @@
+"""Parameter-server subsystem (reference ``ps-lite`` + ``src/hetu_cache``).
+
+Host-resident KV server with server-side optimizers for sparse embeddings,
+plus the bounded-staleness embedding cache. The C++ core lives in
+``hetu_tpu/csrc``; this package holds the Python client/launch surface.
+
+Milestone status: scaffolding — the server/client land in a later commit this
+round (SURVEY.md §7 step 5).
+"""
+from __future__ import annotations
+
+_NOT_READY = ("The parameter-server backend is not initialized. Launch roles "
+              "via hetu_tpu.launcher (scheduler/server/worker) first.")
+
+_worker = None
+
+
+def scheduler_init():
+    from .server import start_scheduler_from_env
+    start_scheduler_from_env()
+
+
+def scheduler_finish():
+    from .server import stop_scheduler
+    stop_scheduler()
+
+
+def server_init():
+    from .server import start_server_from_env
+    start_server_from_env()
+
+
+def server_finish():
+    from .server import stop_server
+    stop_server()
+
+
+def worker_init():
+    global _worker
+    from .client import PSClient
+    _worker = PSClient.from_env()
+
+
+def worker_finish():
+    global _worker
+    if _worker is not None:
+        _worker.close()
+        _worker = None
+
+
+def get_worker_communicate():
+    assert _worker is not None, _NOT_READY
+    return _worker

@@ -1,0 +1,11 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's local-process-cluster test strategy (SURVEY.md §4):
+multi-chip behavior is validated on a virtual device mesh, no TPU pod needed.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

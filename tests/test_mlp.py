@@ -1,0 +1,73 @@
+"""End-to-end MLP training (SURVEY.md §7 step 2: the minimum slice).
+
+Builds the reference examples/cnn MLP pattern through dataloaders + Executor
+and checks the loss decreases and accuracy beats chance by a wide margin.
+"""
+import numpy as np
+
+import hetu_tpu as ht
+
+
+def fc(x, shape, name, with_relu=True):
+    weight = ht.init.random_normal(shape=shape, stddev=0.1, name=name + "_weight")
+    bias = ht.init.random_normal(shape=shape[-1:], stddev=0.1, name=name + "_bias")
+    x = ht.matmul_op(x, weight)
+    x = x + ht.broadcastto_op(bias, x)
+    if with_relu:
+        x = ht.relu_op(x)
+    return x
+
+
+def test_mlp_convergence():
+    train_x, train_y = ht.data._synthetic_classification(2048, (32,), 10, seed=42)
+    train_y = ht.data.convert_to_one_hot(train_y, 10)
+
+    batch_size = 128
+    x = ht.dataloader_op([ht.Dataloader(train_x, batch_size, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(train_y, batch_size, "train")])
+
+    h = fc(x, (32, 64), "fc1")
+    h = fc(h, (64, 64), "fc2")
+    y = fc(h, (64, 10), "fc3", with_relu=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=0.1)
+    train_op = opt.minimize(loss)
+
+    ex = ht.Executor({"train": [loss, y, y_, train_op]}, ctx=ht.cpu(0))
+    n_batches = ex.get_batch_num("train")
+    assert n_batches == 16
+
+    first_epoch_loss, last_epoch_loss = None, None
+    for epoch in range(8):
+        losses, correct = [], []
+        for _ in range(n_batches):
+            lv, yv, ytv, _ = ex.run("train")
+            losses.append(float(lv.asnumpy()))
+            correct.extend(np.argmax(yv.asnumpy(), 1) == np.argmax(ytv.asnumpy(), 1))
+        if epoch == 0:
+            first_epoch_loss = np.mean(losses)
+        last_epoch_loss = np.mean(losses)
+        acc = np.mean(correct)
+    assert last_epoch_loss < first_epoch_loss * 0.5, \
+        f"loss did not halve: {first_epoch_loss} -> {last_epoch_loss}"
+    assert acc > 0.8, f"accuracy too low: {acc}"
+
+
+def test_mlp_validate_subexecutor():
+    train_x, train_y = ht.data._synthetic_classification(512, (16,), 4, seed=7)
+    train_y1h = ht.data.convert_to_one_hot(train_y, 4)
+    x = ht.dataloader_op([ht.Dataloader(train_x, 64, "train"),
+                          ht.Dataloader(train_x, 64, "validate")])
+    y_ = ht.dataloader_op([ht.Dataloader(train_y1h, 64, "train"),
+                           ht.Dataloader(train_y1h, 64, "validate")])
+    y = fc(x, (16, 4), "lin", with_relu=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    opt = ht.optim.SGDOptimizer(0.2)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op], "validate": [loss, y, y_]},
+                     ctx=ht.cpu(0))
+    for _ in range(3 * ex.get_batch_num("train")):
+        ex.run("train")
+    vloss = np.mean([float(ex.run("validate")[0].asnumpy())
+                     for _ in range(ex.get_batch_num("validate"))])
+    assert vloss < 1.0
