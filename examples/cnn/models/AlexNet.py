@@ -1,0 +1,35 @@
+"""AlexNet (CIFAR-sized, reference examples/cnn/models/AlexNet.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def conv_relu(x, shape, name, padding=1, stride=1):
+    w = init.he_normal(shape, name=name + '_weight')
+    x = ht.conv2d_op(x, w, padding=padding, stride=stride)
+    return ht.relu_op(x)
+
+
+def fc(x, shape, name, with_relu=True):
+    w = init.he_normal(shape, name=name + '_weight')
+    b = init.zeros(shape[-1:], name=name + '_bias')
+    y = ht.matmul_op(x, w)
+    y = y + ht.broadcastto_op(b, y)
+    return ht.relu_op(y) if with_relu else y
+
+
+def alexnet(x, y_, num_class=10):
+    print('Building AlexNet model...')
+    x = conv_relu(x, (64, 3, 3, 3), 'alexnet_conv1', padding=1)
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)            # 16x16
+    x = conv_relu(x, (192, 64, 3, 3), 'alexnet_conv2', padding=1)
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)            # 8x8
+    x = conv_relu(x, (384, 192, 3, 3), 'alexnet_conv3', padding=1)
+    x = conv_relu(x, (256, 384, 3, 3), 'alexnet_conv4', padding=1)
+    x = conv_relu(x, (256, 256, 3, 3), 'alexnet_conv5', padding=1)
+    x = ht.max_pool2d_op(x, 2, 2, 0, 2)            # 4x4
+    x = ht.array_reshape_op(x, (-1, 256 * 4 * 4))
+    x = ht.dropout_op(fc(x, (256 * 4 * 4, 1024), 'alexnet_fc1'), 0.5)
+    x = ht.dropout_op(fc(x, (1024, 512), 'alexnet_fc2'), 0.5)
+    y = fc(x, (512, num_class), 'alexnet_fc3', with_relu=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y

@@ -1,0 +1,33 @@
+"""LSTM over MNIST rows as a 28-step sequence (reference
+examples/cnn/models/LSTM.py — statically unrolled; the 4 gate matmuls are
+fused into one (D, 4H) projection so each step is a single MXU call)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def lstm(x, y_, num_class=10, dimhidden=128, diminput=28, nsteps=28):
+    print('Building LSTM model...')
+    H = dimhidden
+    w_ih = init.xavier_uniform((diminput, 4 * H), name='lstm_w_ih')
+    w_hh = init.xavier_uniform((H, 4 * H), name='lstm_w_hh')
+    b = init.zeros((4 * H,), name='lstm_b')
+    w_out = init.random_normal((H, num_class), stddev=0.1, name='lstm_w_out')
+    b_out = init.zeros((num_class,), name='lstm_b_out')
+
+    h, c = None, None
+    for t in range(nsteps):
+        x_t = ht.slice_op(x, (0, t * diminput), (-1, diminput))
+        gates = ht.matmul_op(x_t, w_ih)
+        if h is not None:
+            gates = gates + ht.matmul_op(h, w_hh)
+        gates = gates + ht.broadcastto_op(b, gates)
+        i = ht.sigmoid_op(ht.slice_op(gates, (0, 0), (-1, H)))
+        f = ht.sigmoid_op(ht.slice_op(gates, (0, H), (-1, H)))
+        g = ht.tanh_op(ht.slice_op(gates, (0, 2 * H), (-1, H)))
+        o = ht.sigmoid_op(ht.slice_op(gates, (0, 3 * H), (-1, H)))
+        c = i * g if c is None else f * c + i * g
+        h = o * ht.tanh_op(c)
+    y = ht.matmul_op(h, w_out)
+    y = y + ht.broadcastto_op(b_out, y)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y

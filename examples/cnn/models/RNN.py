@@ -1,0 +1,26 @@
+"""Vanilla RNN over MNIST rows as a 28-step sequence (reference
+examples/cnn/models/RNN.py — graph statically unrolled over time)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def rnn(x, y_, num_class=10, dimhidden=128, diminput=28, nsteps=28):
+    print('Building RNN model...')
+    w_ih = init.random_normal((diminput, dimhidden), stddev=0.1, name='rnn_w_ih')
+    w_hh = init.random_normal((dimhidden, dimhidden), stddev=0.1, name='rnn_w_hh')
+    b_h = init.zeros((dimhidden,), name='rnn_b_h')
+    w_out = init.random_normal((dimhidden, num_class), stddev=0.1, name='rnn_w_out')
+    b_out = init.zeros((num_class,), name='rnn_b_out')
+
+    h = None
+    for t in range(nsteps):
+        x_t = ht.slice_op(x, (0, t * diminput), (-1, diminput))
+        pre = ht.matmul_op(x_t, w_ih)
+        if h is not None:
+            pre = pre + ht.matmul_op(h, w_hh)
+        pre = pre + ht.broadcastto_op(b_h, pre)
+        h = ht.tanh_op(pre)
+    y = ht.matmul_op(h, w_out)
+    y = y + ht.broadcastto_op(b_out, y)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y

@@ -1,0 +1,51 @@
+"""ResNet-18/34 for CIFAR (capability parity with reference
+examples/cnn/models/ResNet.py — basic blocks, BN, global pool)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def conv_bn(x, in_c, out_c, stride, name, kernel=3):
+    pad = kernel // 2
+    w = init.he_normal((out_c, in_c, kernel, kernel), name=name + '_weight')
+    x = ht.conv2d_op(x, w, padding=pad, stride=stride)
+    scale = init.ones((out_c,), name=name + '_bn_scale')
+    bias = init.zeros((out_c,), name=name + '_bn_bias')
+    return ht.batch_normalization_op(x, scale, bias)
+
+
+def basic_block(x, in_c, out_c, stride, name):
+    out = conv_bn(x, in_c, out_c, stride, name + '_conv1')
+    out = ht.relu_op(out)
+    out = conv_bn(out, out_c, out_c, 1, name + '_conv2')
+    if stride != 1 or in_c != out_c:
+        x = conv_bn(x, in_c, out_c, stride, name + '_short', kernel=1)
+    return ht.relu_op(out + x)
+
+
+def _resnet(x, y_, layers, num_class=10):
+    cur_c = 64
+    x = ht.relu_op(conv_bn(x, 3, cur_c, 1, 'resnet_stem'))
+    for stage, (n_blocks, out_c, stride) in enumerate(
+            zip(layers, (64, 128, 256, 512), (1, 2, 2, 2))):
+        for b in range(n_blocks):
+            x = basic_block(x, cur_c, out_c, stride if b == 0 else 1,
+                            f'resnet_s{stage}_b{b}')
+            cur_c = out_c
+    # global average pool: (N, 512, 4, 4) -> (N, 512)
+    x = ht.reduce_mean_op(x, [2, 3])
+    w = init.he_normal((512, num_class), name='resnet_fc_weight')
+    b = init.zeros((num_class,), name='resnet_fc_bias')
+    y = ht.matmul_op(x, w)
+    y = y + ht.broadcastto_op(b, y)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y
+
+
+def resnet18(x, y_, num_class=10):
+    print('Building ResNet-18 model...')
+    return _resnet(x, y_, (2, 2, 2, 2), num_class)
+
+
+def resnet34(x, y_, num_class=10):
+    print('Building ResNet-34 model...')
+    return _resnet(x, y_, (3, 4, 6, 3), num_class)

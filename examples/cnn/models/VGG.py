@@ -1,0 +1,48 @@
+"""VGG-16/19 for CIFAR (reference examples/cnn/models/VGG.py)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def conv_bn_relu(x, in_c, out_c, name):
+    w = init.he_normal((out_c, in_c, 3, 3), name=name + '_weight')
+    x = ht.conv2d_op(x, w, padding=1, stride=1)
+    scale = init.ones((out_c,), name=name + '_bn_scale')
+    bias = init.zeros((out_c,), name=name + '_bn_bias')
+    x = ht.batch_normalization_op(x, scale, bias)
+    return ht.relu_op(x)
+
+
+def vgg_block(x, in_c, out_c, repeat, name):
+    for i in range(repeat):
+        x = conv_bn_relu(x, in_c if i == 0 else out_c, out_c, f'{name}_{i}')
+    return ht.max_pool2d_op(x, kernel_H=2, kernel_W=2, padding=0, stride=2)
+
+
+def fc(x, shape, name, with_relu=True):
+    w = init.he_normal(shape, name=name + '_weight')
+    b = init.zeros(shape[-1:], name=name + '_bias')
+    y = ht.matmul_op(x, w)
+    y = y + ht.broadcastto_op(b, y)
+    return ht.relu_op(y) if with_relu else y
+
+
+def _vgg(x, y_, repeats, num_class=10):
+    for i, (out_c, rep) in enumerate(zip((64, 128, 256, 512, 512), repeats)):
+        x = vgg_block(x, 3 if i == 0 else (64, 128, 256, 512, 512)[i - 1],
+                      out_c, rep, f'vgg_block{i}')
+    x = ht.array_reshape_op(x, (-1, 512))
+    x = fc(x, (512, 4096), 'vgg_fc1')
+    x = fc(x, (4096, 4096), 'vgg_fc2')
+    y = fc(x, (4096, num_class), 'vgg_fc3', with_relu=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y, y_), [0])
+    return loss, y
+
+
+def vgg16(x, y_, num_class=10):
+    print('Building VGG-16 model...')
+    return _vgg(x, y_, (2, 2, 3, 3, 3), num_class)
+
+
+def vgg19(x, y_, num_class=10):
+    print('Building VGG-19 model...')
+    return _vgg(x, y_, (2, 2, 4, 4, 4), num_class)

@@ -2,3 +2,8 @@ from .MLP import mlp
 from .LogReg import logreg
 from .CNN import cnn_3_layers
 from .LeNet import lenet
+from .AlexNet import alexnet
+from .VGG import vgg16, vgg19
+from .ResNet import resnet18, resnet34
+from .RNN import rnn
+from .LSTM import lstm

@@ -181,7 +181,11 @@ class TraceContext:
                                       self.op_state_in)
                 sub_tc._in_grad_retrace = True
                 for node in sub_topo:
+                    # skip the gradient/comm/optimizer tail — only the forward
+                    # path to the loss matters inside the vjp closure
                     if node.is_gradient or node.is_optimizer:
+                        continue
+                    if any(id(i) not in env2 for i in node.inputs):
                         continue
                     _eval_node(node, env2, sub_tc)
                 loss_val = env2[id(gctx.loss)]

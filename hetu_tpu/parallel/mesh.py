@@ -1,0 +1,46 @@
+"""Device-mesh construction for dp/pp/tp/sp/ep parallelism.
+
+The reference expresses placement as DeviceGroups + per-op `deduce_states`
+tuples and drives NCCL groups from Python (communicator/mpi_nccl_comm.py:145).
+The TPU-native equivalent is one ``jax.sharding.Mesh`` with named axes; all
+collectives are compiled (GSPMD or explicit lax collectives inside shard_map)
+and ride ICI. Axis order is chosen so the innermost axes (tp/sp) map to
+physically adjacent devices — tensor-parallel collectives are
+latency-sensitive, data-parallel ones are not.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a 5-axis mesh (dp, pp, ep, sp, tp); size-1 axes cost nothing."""
+    if devices is None:
+        devices = jax.devices()
+    want = dp * pp * tp * sp * ep
+    assert want == len(devices), (
+        f"mesh {dp}x{pp}x{ep}x{sp}x{tp}={want} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(dp, pp, ep, sp, tp)
+    return Mesh(arr, AXES)
+
+
+def auto_mesh(n_devices: Optional[int] = None, tp: int = 1, pp: int = 1,
+              sp: int = 1, ep: int = 1) -> Mesh:
+    """Fill dp with whatever devices remain after the model axes."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    model = tp * pp * sp * ep
+    assert n % model == 0, f"{n} devices not divisible by tp*pp*sp*ep={model}"
+    return make_mesh(dp=n // model, pp=pp, tp=tp, sp=sp, ep=ep,
+                     devices=devices[:n])
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

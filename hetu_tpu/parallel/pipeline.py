@@ -1,0 +1,161 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+Reference mechanism: per-stage ``ht.context(...)`` blocks, auto-inserted
+NCCL PipelineSend/Recv with a runtime shape handshake, and a Python microbatch
+loop (``SubExecutor4Gpipe``, executor.py:435-767) that runs all forwards then
+all backwards and applies the optimizer once.
+
+TPU-native redesign: the whole pipeline — all stages, all microbatches,
+forward AND backward — is ONE jitted program. Stage weights are stacked on a
+leading axis sharded over ``pp``; inside a ``jax.shard_map`` (manual over
+``pp``, GSPMD-auto over dp/tp/sp/ep) activations advance between stages with
+``lax.ppermute`` over ICI. ``jax.grad`` differentiates straight through the
+ppermute (its transpose is the reverse permute), so the 1F1B-ish reverse
+schedule emerges from XLA's dataflow rather than host code, and the optimizer
+applies once per step like GPipe. Shapes are static — the reference's dynamic
+shape handshake (PipelineSend.py:30-44) is unnecessary by construction.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import transformer as tfm
+
+
+def _stack_stages(params, pp: int):
+    """Reshape per-layer stacked block params (L, ...) -> (pp, L//pp, ...)."""
+    def reshape(x):
+        L = x.shape[0]
+        assert L % pp == 0, f"n_layers {L} not divisible by pp {pp}"
+        return x.reshape(pp, L // pp, *x.shape[1:])
+    return jax.tree.map(reshape, params)
+
+
+def pipeline_spec(cfg: tfm.TransformerConfig, pp: int):
+    """Sharding for pipeline params: blocks get a leading 'pp' dim; embed/head
+    replicated across stages (stage 0 / stage pp-1 use them)."""
+    base = tfm.param_specs(cfg)
+    blocks = {k: P("pp", *s) for k, s in base["blocks"].items()}
+    return {**base, "blocks": blocks}
+
+
+def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                             num_microbatches: int, lr: float = 1e-3,
+                             aux_weight: float = 0.01):
+    """Build the jitted GPipe step.
+
+    tokens/targets: (M, mb, T) — M microbatches. Returns
+    (loss, params, opt_state).
+    """
+    pp = mesh.shape["pp"]
+    M = num_microbatches
+    assert cfg.n_layers % pp == 0
+
+    def stage_fn(h, stage_blocks):
+        """Run this device's layers over one microbatch activation."""
+        block = functools.partial(tfm._block, cfg=cfg, mesh=None)
+        if cfg.remat:
+            block = jax.checkpoint(block)
+
+        def body(carry, layer_params):
+            h, aux = carry
+            h, a = block(h, layer_params)
+            return (h, aux + a), None
+
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        (h, aux), _ = jax.lax.scan(body, (h, aux0), stage_blocks)
+        return h, aux
+
+    def fwd_loss(params, tokens, targets):
+        """Pipelined forward + loss, manual over pp via shard_map."""
+        stage_blocks = params["blocks"]  # (1, L/pp, ...) local slice per stage
+        other = {k: v for k, v in params.items() if k != "blocks"}
+        B, T = tokens.shape[1], tokens.shape[2]
+        state0 = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
+
+        def pipelined(stage_blocks, other, tokens, targets, state0):
+            # inside: manual over 'pp' — axis_index tells us our stage
+            stage = jax.lax.axis_index("pp")
+            local_blocks = jax.tree.map(lambda x: x[0], stage_blocks)
+
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            n_ticks = M + pp - 1
+            # carries vary per pp-shard: mark them 'varying' for the vma type
+            # system before entering the scan
+            varying = lambda x: jax.lax.pcast(x, ("pp",), to="varying")
+            state = varying(state0)
+            loss_sum = varying(jnp.zeros((), jnp.float32))
+            aux_sum = varying(jnp.zeros((), jnp.float32))
+
+            def tick(carry, t):
+                state, loss_sum, aux_sum = carry
+                # stage 0 ingests microbatch t (if any); others use received
+                mb_idx = jnp.clip(t, 0, M - 1)
+                mb_tokens = jax.lax.dynamic_index_in_dim(
+                    tokens, mb_idx, 0, keepdims=False)
+                inject = tfm.embed_tokens(other, mb_tokens, cfg)
+                state = jnp.where((stage == 0) & (t < M), inject, state)
+                out, aux = stage_fn(state, local_blocks)
+                # this stage holds a real microbatch only during its window
+                valid = (t >= stage) & (t < stage + M)
+                aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+                # last stage computes loss for the microbatch that has now
+                # passed through all stages: microbatch t-(pp-1)
+                done_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+                mb_targets = jax.lax.dynamic_index_in_dim(
+                    targets, done_idx, 0, keepdims=False)
+                mb_loss = tfm.nll_loss(tfm.lm_head(other, out), mb_targets)
+                take = (stage == pp - 1) & (t >= pp - 1)
+                loss_sum = loss_sum + jnp.where(take, mb_loss, 0.0)
+                # advance activations to the next stage
+                state = jax.lax.ppermute(out, "pp", perm)
+                return (state, loss_sum, aux_sum), None
+
+            (state, loss_sum, aux_sum), _ = jax.lax.scan(
+                tick, (state, loss_sum, aux_sum), jnp.arange(n_ticks))
+            # NLL lives on the last stage, aux is spread over stages; combine
+            loss = jax.lax.psum(loss_sum, "pp") / M
+            aux = jax.lax.psum(aux_sum, "pp") / M
+            return loss + aux_weight * aux
+
+        block_in_spec = jax.tree.map(lambda _: P("pp"), stage_blocks)
+        other_spec = jax.tree.map(lambda _: P(), other)
+        return jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(block_in_spec, other_spec, P(), P(), P()),
+            out_specs=P(),
+            axis_names=frozenset({"pp"}),
+        )(stage_blocks, other, tokens, targets, state0)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(fwd_loss)(params, tokens, targets)
+        new_params, new_opt = tfm.adamw_update(params, grads, opt_state, lr=lr)
+        return loss, new_params, new_opt
+
+    specs = pipeline_spec(cfg, pp)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    data_shard = NamedSharding(mesh, P(None, "dp", None))
+    return jax.jit(
+        step,
+        in_shardings=(pshard, opt_shard, data_shard, data_shard),
+        out_shardings=(NamedSharding(mesh, P()), pshard, opt_shard),
+        donate_argnums=(0, 1),
+    )
+
+
+def init_pipeline_params(rng, cfg: tfm.TransformerConfig, mesh: Mesh):
+    pp = mesh.shape["pp"]
+    params = tfm.init_params(rng, cfg)
+    params = {**params, "blocks": _stack_stages(params["blocks"], pp)}
+    specs = pipeline_spec(cfg, pp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)

@@ -1,11 +1,20 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's local-process-cluster test strategy (SURVEY.md §4):
 multi-chip behavior is validated on a virtual device mesh, no TPU pod needed.
+
+Note: this environment's sitecustomize pins JAX_PLATFORMS=axon (the tunneled
+TPU), so the env var alone is not enough — jax.config.update after import is
+authoritative.
 """
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+# appended last: with duplicate flags, XLA takes the last occurrence
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
