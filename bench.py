@@ -1,0 +1,106 @@
+"""Benchmark harness — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline config (BASELINE.md): ResNet-18 / CIFAR10-shape data through the
+define-then-run Executor on the real chip — samples/sec/chip. Syncs once per
+timed window (host<->device roundtrips on the tunneled chip cost ~64ms and
+must not be counted per step). ``--all`` also reports the flagship
+transformer tokens/s/chip.
+
+vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
+recorded baseline is the reference's "≥30% faster than TF1" claim proxied by
+our own first-round measurement. Until a cross-framework A/B exists on this
+hardware, vs_baseline reports value / BASELINE_REFERENCE (stored below once
+round 1 lands).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+# Round-1 measurement recorded as the running baseline for later rounds
+# (v5e-1, 2026-07-29: 4929 samples/s, 26ms step @ bs128).
+BASELINE_SAMPLES_PER_SEC = 4929.1
+
+
+def bench_resnet18(batch_size=128, warmup=5, iters=30):
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "examples", "cnn"))
+    import hetu_tpu as ht
+    import models
+
+    rng = np.random.RandomState(0)
+    n = batch_size * 4
+    data_x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    data_y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    x = ht.dataloader_op([ht.Dataloader(data_x, batch_size, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(data_y, batch_size, "train")])
+    loss, y = models.resnet18(x, y_, 10)
+    opt = ht.optim.MomentumOptimizer(learning_rate=0.1)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.tpu(0))
+
+    for _ in range(warmup):
+        ex.run("train")
+    # sync: pull the loss once to drain the queue
+    float(ex.run("train")[0].asnumpy())
+
+    t0 = time.time()
+    for _ in range(iters - 1):
+        ex.run("train")
+    last = ex.run("train")[0]
+    float(last.asnumpy())  # one sync for the whole window
+    dt = (time.time() - t0) / iters
+    return batch_size / dt, dt * 1000
+
+
+def bench_transformer(warmup=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq_len=512)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = tfm.init_opt_state(params)
+    step = tfm.make_train_step(cfg, mesh=None, lr=3e-4)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 8192, (16, 512)), jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+    for _ in range(warmup):
+        loss, params, opt = step(params, opt, tok, tgt)
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(iters):
+        loss, params, opt = step(params, opt, tok, tgt)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / iters
+    return 16 * 512 / dt, dt * 1000
+
+
+def main():
+    samples_per_sec, step_ms = bench_resnet18()
+    vs = (samples_per_sec / BASELINE_SAMPLES_PER_SEC
+          if BASELINE_SAMPLES_PER_SEC else 1.0)
+    print(json.dumps({
+        "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+        "detail": {"step_ms": round(step_ms, 2), "batch_size": 128},
+    }))
+    if "--all" in sys.argv:
+        toks, tms = bench_transformer()
+        print(json.dumps({
+            "metric": "transformer_38M_seq512_tokens_per_sec_per_chip",
+            "value": round(toks, 0),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 1.0,
+            "detail": {"step_ms": round(tms, 2)},
+        }))
+
+
+if __name__ == "__main__":
+    main()

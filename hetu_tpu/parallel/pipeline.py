@@ -38,11 +38,15 @@ def _stack_stages(params, pp: int):
 
 
 def pipeline_spec(cfg: tfm.TransformerConfig, pp: int):
-    """Sharding for pipeline params: blocks get a leading 'pp' dim; embed/head
-    replicated across stages (stage 0 / stage pp-1 use them)."""
+    """Sharding for pipeline params: blocks get a leading 'pp' dim; embed/pos/
+    head/final-norm are replicated — they are consumed inside the manual-pp
+    region, where a tp-sharded gather trips a CHECK in XLA's SPMD partitioner
+    (observed on XLA@jax0.9: PartitionGatherTrivialSlicedOperandDimensions),
+    and stage 0 / stage pp-1 need them everywhere anyway."""
     base = tfm.param_specs(cfg)
     blocks = {k: P("pp", *s) for k, s in base["blocks"].items()}
-    return {**base, "blocks": blocks}
+    replicated = {k: P() for k in base if k != "blocks"}
+    return {**replicated, "blocks": blocks}
 
 
 def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
