@@ -1,0 +1,56 @@
+"""Build the native components (C++17, no external deps) into shared libs.
+
+Replaces the reference's cmake build (CMakeLists.txt, cmake/config.example.cmake)
+with a dependency-free g++ invocation; libraries are rebuilt automatically when
+sources are newer than the .so (so `import hetu_tpu.ps` always works after a
+checkout, mirroring how the reference loads prebuilt .so files in _base.py:78-90).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_CSRC = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_CSRC, "build")
+
+_TARGETS = {
+    "libhetu_ps.so": {
+        "srcs": ["ps/capi.cc"],
+        "deps": ["ps/net.h", "ps/store.h", "ps/server.h", "ps/scheduler.h",
+                 "ps/worker.h"],
+    },
+    "libhetu_cache.so": {
+        "srcs": ["cache/cache_capi.cc"],
+        "deps": ["cache/cache.h", "ps/net.h", "ps/store.h", "ps/worker.h"],
+    },
+}
+
+
+def _mtime(path):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+def build(name: str) -> str:
+    """Build (if stale) and return the path to the named shared library."""
+    spec = _TARGETS[name]
+    out = os.path.join(_BUILD, name)
+    srcs = [os.path.join(_CSRC, s) for s in spec["srcs"]]
+    deps = srcs + [os.path.join(_CSRC, d) for d in spec["deps"]]
+    missing = [p for p in deps if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(f"cannot build {name}: missing {missing}")
+    if _mtime(out) >= max(_mtime(p) for p in deps):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+           "-I", _CSRC, "-o", out] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(e.stderr)
+        raise RuntimeError(f"native build of {name} failed: {' '.join(cmd)}")
+    return out

@@ -1,0 +1,275 @@
+// extern "C" surface of the hetu_tpu parameter server, consumed via ctypes.
+//
+// Capability parity with the reference's ps-lite/src/python_binding.cc
+// (Init/Finalize :8-16, Push/Pull/DDPushPull :18-30, Sparse*/S*PushPull
+// :32-66, PushData/PullData :72-88, Wait/WaitData/BarrierWorker :82-92,
+// InitTensor :94, Clear/ClearOnServer/SaveParam/LoadParam :104-119,
+// startRecord/getLoads :121-127, StartServer :129, rank/nrank :134-140).
+// Arrays cross the boundary as raw pointers + lengths instead of DLArray
+// structs: the TPU frontend's NDArray is a jax.Array, so the Python client
+// stages through pinned numpy buffers (hetu_tpu/ps/client.py).
+//
+// Role selection via DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+// DMLC_NUM_WORKER / DMLC_NUM_SERVER / WORKER_ID / SERVER_ID /
+// DMLC_PS_SERVER_PORT, matching the reference launcher's env plumbing
+// (python/runner.py:186-190, tests/pstests/local_s2_w2.yml).
+
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "scheduler.h"
+#include "server.h"
+#include "worker.h"
+
+namespace {
+
+std::unique_ptr<hetups::Scheduler> g_scheduler;
+std::unique_ptr<hetups::PsServer> g_server;
+std::unique_ptr<hetups::Conn> g_server_sched_conn;  // server's scheduler link
+std::unique_ptr<hetups::PsWorker> g_worker;
+std::string g_last_error;
+std::string g_loads;
+
+const char* env_or(const char* k, const char* dflt) {
+  const char* v = std::getenv(k);
+  return v ? v : dflt;
+}
+
+int env_int(const char* k, int dflt) {
+  const char* v = std::getenv(k);
+  return v ? std::atoi(v) : dflt;
+}
+
+template <typename F>
+void guard(F&& f) {
+  try {
+    f();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    std::fprintf(stderr, "[hetups] %s\n", e.what());
+  }
+}
+
+hetups::PsWorker& worker() {
+  if (!g_worker)
+    throw std::runtime_error(
+        "no worker agent: Init() not called with DMLC_ROLE=worker, or "
+        "already finalized");
+  return *g_worker;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns-and-clears: the caller observes each failure once.
+const char* LastError() {
+  static std::string report;
+  report = g_last_error;
+  g_last_error.clear();
+  return report.c_str();
+}
+
+void Init() {
+  guard([] {
+    std::string role = env_or("DMLC_ROLE", "worker");
+    std::string root = env_or("DMLC_PS_ROOT_URI", "127.0.0.1");
+    int root_port = env_int("DMLC_PS_ROOT_PORT", 13200);
+    int n_workers = env_int("DMLC_NUM_WORKER", 1);
+    int n_servers = env_int("DMLC_NUM_SERVER", 1);
+    if (role == "scheduler") {
+      if (g_scheduler) return;
+      g_scheduler = std::make_unique<hetups::Scheduler>(root_port, n_servers,
+                                                        n_workers);
+      g_scheduler->start();
+    } else if (role == "server") {
+      if (g_server) return;
+      int id = env_int("SERVER_ID", 0);
+      int port = env_int("DMLC_PS_SERVER_PORT", 13201 + 2 * id);
+      std::string host = env_or("DMLC_PS_SERVER_URI", "127.0.0.1");
+      g_server = std::make_unique<hetups::PsServer>(id, host, port);
+      g_server->start();
+      // register the listen address with the scheduler
+      g_server_sched_conn = std::make_unique<hetups::Conn>(
+          hetups::connect_to(root, root_port));
+      hetups::Message reg;
+      reg.head.type = static_cast<int32_t>(hetups::PsfType::kRegister);
+      int32_t meta[3] = {0, id, port};
+      reg.args.push_back(hetups::Arg::i32(meta, 3));
+      reg.args.push_back(hetups::Arg::str(host));
+      g_server_sched_conn->send(reg);
+      hetups::Message book;
+      if (!g_server_sched_conn->recv(&book))
+        throw std::runtime_error("scheduler closed during server registration");
+    } else {  // worker
+      if (g_worker) return;
+      int id = env_int("WORKER_ID", 0);
+      g_worker = std::make_unique<hetups::PsWorker>(id, n_workers, root,
+                                                    root_port);
+    }
+  });
+}
+
+void StartServer() { /* folded into Init() by role; kept for API parity */ }
+
+void SchedulerWait() {
+  guard([] {
+    if (g_scheduler) g_scheduler->wait();
+  });
+}
+
+void Finalize() {
+  guard([] {
+    if (g_worker) {
+      g_worker->finalize();
+      g_worker.reset();
+    }
+    if (g_server) {
+      if (g_server_sched_conn) {
+        hetups::Message bye;
+        bye.head.type = static_cast<int32_t>(hetups::PsfType::kShutdown);
+        try {
+          g_server_sched_conn->send(bye);
+        } catch (...) {
+        }
+        g_server_sched_conn->close();
+        g_server_sched_conn.reset();
+      }
+      g_server->stop();
+      g_server.reset();
+    }
+    if (g_scheduler) {
+      g_scheduler->wait();
+      g_scheduler->stop();
+      g_scheduler.reset();
+    }
+  });
+}
+
+// -- dense ------------------------------------------------------------------
+void Push(int node, const float* grad, long len) {
+  guard([&] { worker().push(node, grad, static_cast<size_t>(len)); });
+}
+
+void Pull(int node, float* out, long len) {
+  guard([&] { worker().pull(node, out, static_cast<size_t>(len)); });
+}
+
+void DDPushPull(int node, const float* grad, float* out, long len) {
+  guard([&] { worker().dd_pushpull(node, grad, out, static_cast<size_t>(len)); });
+}
+
+// -- sparse -----------------------------------------------------------------
+void SparsePush(int node, const long* idx, const float* vals, long nidx) {
+  guard([&] {
+    worker().sparse_push(node, reinterpret_cast<const int64_t*>(idx), vals,
+                          static_cast<size_t>(nidx));
+  });
+}
+
+void SparsePull(int node, const long* idx, float* vals, long nidx) {
+  guard([&] {
+    worker().sparse_pull(node, reinterpret_cast<const int64_t*>(idx), vals,
+                          static_cast<size_t>(nidx));
+  });
+}
+
+void SDPushPull(int node, const long* idx, const float* vals, long nidx,
+                float* out) {
+  guard([&] {
+    worker().sd_pushpull(node, reinterpret_cast<const int64_t*>(idx), vals,
+                          static_cast<size_t>(nidx), out);
+  });
+}
+
+void SSPushPull(int node, const long* in_idx, const float* vals,
+                const long* out_idx, float* out, long nidx) {
+  guard([&] {
+    worker().ss_pushpull(node, reinterpret_cast<const int64_t*>(in_idx), vals,
+                          reinterpret_cast<const int64_t*>(out_idx), out,
+                          static_cast<size_t>(nidx));
+  });
+}
+
+// -- data blobs -------------------------------------------------------------
+long PushData(int node, const unsigned long long* ids, int n,
+              const float* vals, const long* lens) {
+  long q = -1;
+  guard([&] {
+    q = worker().push_data(node, reinterpret_cast<const uint64_t*>(ids),
+                            static_cast<size_t>(n), vals,
+                            reinterpret_cast<const int64_t*>(lens));
+  });
+  return q;
+}
+
+long PullData(int node, const unsigned long long* ids, int n, float* vals,
+              const long* lens) {
+  long q = -1;
+  guard([&] {
+    q = worker().pull_data(node, reinterpret_cast<const uint64_t*>(ids),
+                            static_cast<size_t>(n), vals,
+                            reinterpret_cast<const int64_t*>(lens));
+  });
+  return q;
+}
+
+void WaitData(long query) {
+  guard([&] { worker().wait_data(query); });
+}
+
+// -- control ----------------------------------------------------------------
+void Wait(int node) {
+  guard([&] { worker().wait(node); });
+}
+
+void BarrierWorker() {
+  guard([] { worker().barrier(); });
+}
+
+void InitTensor(int node, int ptype, long len, long width, int init_type,
+                double init_a, double init_b, unsigned long long seed,
+                int otype, float* lrs, int nlr) {
+  guard([&] {
+    worker().parameter_init(
+        node, static_cast<hetups::ParamKind>(ptype), static_cast<size_t>(len),
+        static_cast<size_t>(width), static_cast<hetups::InitType>(init_type),
+        init_a, init_b, seed, static_cast<hetups::OptType>(otype), lrs,
+        static_cast<size_t>(nlr));
+  });
+}
+
+void Clear(int node) {
+  guard([&] { worker().clear(node); });
+}
+
+void ClearOnServer(int node) {
+  guard([&] { worker().clear_on_server(node); });
+}
+
+void SaveParam(int node, const char* dir) {
+  guard([&] { worker().parameter_save(node, dir); });
+}
+
+void LoadParam(int node, const char* dir) {
+  guard([&] { worker().parameter_load(node, dir); });
+}
+
+void startRecord(const char* dir) {
+  guard([&] { worker().start_record(dir); });
+}
+
+const char* getLoads() {
+  guard([] { g_loads = worker().get_loads(); });
+  return g_loads.c_str();
+}
+
+int rank() { return g_worker ? worker().rank() : 0; }
+int nrank() { return g_worker ? worker().nrank() : 1; }
+int num_servers() {
+  return g_worker ? static_cast<int>(worker().num_servers()) : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,256 @@
+// TCP transport for the hetu_tpu parameter server.
+//
+// Capability parity with the reference's ps-lite "van" layer
+// (ps-lite/src/van.cc:29-42, zmq_van.h): a message-framed, connection-oriented
+// transport. Redesigned: raw POSIX TCP with length-prefixed frames instead of
+// ZMQ — no external dependency, same loopback/process-cluster test story
+// (reference tests/pstests/local_s2_w2.yml).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hetups {
+
+// ---------------------------------------------------------------------------
+// Wire format: fixed header + n_args payload arrays.
+//   MsgHeader | {ArgHeader | bytes} * n_args
+// Same-architecture cluster assumed (host byte order), like the reference van.
+// ---------------------------------------------------------------------------
+
+enum class PsfType : int32_t {
+  // control plane
+  kRegister = 0,       // node -> scheduler: {role, id, listen addr}
+  kAddressBook = 1,    // scheduler -> node: server addresses
+  kBarrier = 2,        // worker -> scheduler -> worker
+  kShutdown = 3,
+  kAck = 4,
+  // dense
+  kDensePush = 10,
+  kDensePull = 11,
+  kDDPushPull = 12,
+  // sparse (2D row-partitioned)
+  kSparsePush = 20,
+  kSparsePull = 21,
+  kSDPushPull = 22,
+  kSSPushPull = 23,
+  // param management
+  kParamInit = 30,
+  kParamClear = 31,
+  kParamSave = 32,
+  kParamLoad = 33,
+  // bounded-staleness cache table (reference ps-lite psf/cachetable.h:22-43)
+  kSyncEmbedding = 40,
+  kPushEmbedding = 41,
+  kPushSyncEmbedding = 42,
+  // arbitrary-length data blobs (reference PushData/PullData)
+  kDataPush = 50,
+  kDataPull = 51,
+};
+
+struct MsgHeader {
+  int32_t type = 0;       // PsfType
+  int32_t tensor_id = 0;  // node_name in the reference C API
+  uint64_t req_id = 0;
+  int32_t n_args = 0;
+  int32_t flags = 0;
+};
+
+enum class ArgType : int32_t { kF32 = 0, kI64 = 1, kF64 = 2, kBytes = 3, kI32 = 4, kU64 = 5 };
+
+struct ArgHeader {
+  int32_t dtype = 0;
+  int32_t pad = 0;
+  uint64_t nbytes = 0;
+};
+
+// One payload argument: a typed, sized view (owning buffer on receive).
+struct Arg {
+  ArgType dtype = ArgType::kBytes;
+  std::vector<uint8_t> buf;
+
+  Arg() = default;
+  Arg(ArgType t, const void* data, size_t nbytes) : dtype(t) {
+    buf.resize(nbytes);
+    if (nbytes) std::memcpy(buf.data(), data, nbytes);
+  }
+  static Arg f32(const float* p, size_t n) { return Arg(ArgType::kF32, p, n * 4); }
+  static Arg i64(const int64_t* p, size_t n) { return Arg(ArgType::kI64, p, n * 8); }
+  static Arg u64(const uint64_t* p, size_t n) { return Arg(ArgType::kU64, p, n * 8); }
+  static Arg i32(const int32_t* p, size_t n) { return Arg(ArgType::kI32, p, n * 4); }
+  static Arg f64(const double* p, size_t n) { return Arg(ArgType::kF64, p, n * 8); }
+  static Arg str(const std::string& s) { return Arg(ArgType::kBytes, s.data(), s.size()); }
+
+  const float* as_f32() const { return reinterpret_cast<const float*>(buf.data()); }
+  const int64_t* as_i64() const { return reinterpret_cast<const int64_t*>(buf.data()); }
+  const uint64_t* as_u64() const { return reinterpret_cast<const uint64_t*>(buf.data()); }
+  const int32_t* as_i32() const { return reinterpret_cast<const int32_t*>(buf.data()); }
+  const double* as_f64() const { return reinterpret_cast<const double*>(buf.data()); }
+  float* mut_f32() { return reinterpret_cast<float*>(buf.data()); }
+  std::string as_str() const { return std::string(buf.begin(), buf.end()); }
+  size_t n_f32() const { return buf.size() / 4; }
+  size_t n_i64() const { return buf.size() / 8; }
+  size_t size() const { return buf.size(); }
+};
+
+struct Message {
+  MsgHeader head;
+  std::vector<Arg> args;
+};
+
+// ---------------------------------------------------------------------------
+// Socket helpers
+// ---------------------------------------------------------------------------
+
+inline void send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) throw std::runtime_error("hetups: send failed (peer closed?)");
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+}
+
+inline bool recv_all(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;  // closed or error
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+// Sends header+args as one buffered write (one syscall for small messages).
+inline void send_msg(int fd, const Message& m) {
+  MsgHeader h = m.head;
+  h.n_args = static_cast<int32_t>(m.args.size());
+  size_t total = sizeof(MsgHeader);
+  for (const auto& a : m.args) total += sizeof(ArgHeader) + a.buf.size();
+  std::vector<uint8_t> out(total);
+  uint8_t* p = out.data();
+  std::memcpy(p, &h, sizeof(h));
+  p += sizeof(h);
+  for (const auto& a : m.args) {
+    ArgHeader ah{static_cast<int32_t>(a.dtype), 0, a.buf.size()};
+    std::memcpy(p, &ah, sizeof(ah));
+    p += sizeof(ah);
+    if (!a.buf.empty()) std::memcpy(p, a.buf.data(), a.buf.size());
+    p += a.buf.size();
+  }
+  send_all(fd, out.data(), out.size());
+}
+
+inline bool recv_msg(int fd, Message* m) {
+  if (!recv_all(fd, &m->head, sizeof(MsgHeader))) return false;
+  m->args.clear();
+  m->args.resize(m->head.n_args);
+  for (auto& a : m->args) {
+    ArgHeader ah;
+    if (!recv_all(fd, &ah, sizeof(ah))) return false;
+    a.dtype = static_cast<ArgType>(ah.dtype);
+    a.buf.resize(ah.nbytes);
+    if (ah.nbytes && !recv_all(fd, a.buf.data(), ah.nbytes)) return false;
+  }
+  return true;
+}
+
+inline int listen_on(const std::string& host, int port, int backlog = 128) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("hetups: socket() failed");
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = host.empty() ? INADDR_ANY : ::inet_addr(host.c_str());
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    throw std::runtime_error("hetups: bind failed on port " + std::to_string(port));
+  if (::listen(fd, backlog) != 0) throw std::runtime_error("hetups: listen failed");
+  return fd;
+}
+
+// Resolve a dotted-quad IP or hostname (reference vans resolve via
+// network_utils.h; DMLC_PS_ROOT_URI may be a hostname in cluster ymls).
+inline in_addr_t resolve_host(const std::string& host) {
+  in_addr_t ip = ::inet_addr(host.c_str());
+  if (ip != INADDR_NONE) return ip;
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+    throw std::runtime_error("hetups: cannot resolve host '" + host + "'");
+  in_addr_t out =
+      reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr.s_addr;
+  ::freeaddrinfo(res);
+  return out;
+}
+
+// Connect with retry — nodes race the scheduler/servers at startup
+// (the reference's van retries similarly via resender.h timeouts).
+inline int connect_to(const std::string& host, int port, int retries = 600,
+                      int wait_ms = 100) {
+  in_addr_t ip = resolve_host(host);
+  for (int i = 0; i < retries; ++i) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("hetups: socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    addr.sin_addr.s_addr = ip;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    struct timespec ts = {wait_ms / 1000, (wait_ms % 1000) * 1000000L};
+    nanosleep(&ts, nullptr);
+  }
+  throw std::runtime_error("hetups: connect to " + host + ":" +
+                           std::to_string(port) + " timed out");
+}
+
+// A connection whose requests may be issued from many threads: writes are
+// serialized by a mutex; responses are matched by req_id by a reader thread.
+class Conn {
+ public:
+  explicit Conn(int fd) : fd_(fd) {}
+  ~Conn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Conn(const Conn&) = delete;
+
+  void send(const Message& m) {
+    std::lock_guard<std::mutex> g(send_mu_);
+    send_msg(fd_, m);
+  }
+  bool recv(Message* m) { return recv_msg(fd_, m); }
+  int fd() const { return fd_; }
+  void close() {
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+ private:
+  int fd_;
+  std::mutex send_mu_;
+};
+
+}  // namespace hetups

@@ -1,0 +1,166 @@
+// Scheduler: node registry + address-book broadcast + worker barriers.
+//
+// Capability parity with the reference's ps-lite Postoffice/scheduler role
+// (src/postoffice.cc, van.cc ProcessAddNodeCommandAtScheduler :47): nodes
+// join, the scheduler assembles the cluster view and broadcasts it; workers
+// use the scheduler for group barriers (Postoffice::Barrier).
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+
+namespace hetups {
+
+class Scheduler {
+ public:
+  Scheduler(int port, int num_servers, int num_workers)
+      : port_(port), num_servers_(num_servers), num_workers_(num_workers) {}
+
+  ~Scheduler() { stop(); }
+
+  void start() {
+    listen_fd_ = listen_on("", port_);
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  void stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+  }
+
+  // Blocks until every node has sent kShutdown (clean cluster teardown).
+  void wait() {
+    std::unique_lock<std::mutex> g(mu_);
+    done_cv_.wait(g, [this] {
+      return shutdowns_ >= num_servers_ + num_workers_;
+    });
+  }
+
+ private:
+  void accept_loop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+    }
+  }
+
+  void serve_conn(int fd) {
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      live_fds_.push_back(fd);
+    }
+    Message req;
+    while (recv_msg(fd, &req)) {
+      switch (static_cast<PsfType>(req.head.type)) {
+        case PsfType::kRegister: {
+          // args: i32[role(0=server,1=worker), id, port], str host
+          const int32_t* meta = req.args[0].as_i32();
+          std::string host = req.args[1].as_str();
+          std::unique_lock<std::mutex> g(mu_);
+          if (meta[0] == 0) {
+            if (meta[1] < 0 || meta[1] >= num_servers_) {
+              std::fprintf(stderr,
+                           "[hetups scheduler] SERVER_ID %d out of range "
+                           "[0, %d) — check DMLC_NUM_SERVER\n",
+                           meta[1], num_servers_);
+              break;
+            }
+            if (server_addrs_.size() <
+                static_cast<size_t>(num_servers_))
+              server_addrs_.resize(num_servers_);
+            server_addrs_[meta[1]] = host + ":" + std::to_string(meta[2]);
+            ++servers_seen_;
+          } else {
+            ++workers_seen_;
+          }
+          reg_cv_.notify_all();
+          reg_cv_.wait(g, [this] {
+            return servers_seen_ >= num_servers_ && workers_seen_ >= num_workers_;
+          });
+          std::string book;
+          for (auto& a : server_addrs_) book += a + "\n";
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAddressBook);
+          rsp.head.req_id = req.head.req_id;
+          rsp.args.push_back(Arg::str(book));
+          g.unlock();
+          send_msg(fd, rsp);
+          break;
+        }
+        case PsfType::kBarrier: {
+          std::unique_lock<std::mutex> g(mu_);
+          uint64_t my_gen = barrier_gen_;
+          ++barrier_count_;
+          if (barrier_count_ >= num_workers_) {
+            barrier_count_ = 0;
+            ++barrier_gen_;
+            barrier_cv_.notify_all();
+          } else {
+            barrier_cv_.wait(g, [this, my_gen] { return barrier_gen_ > my_gen; });
+          }
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+          rsp.head.req_id = req.head.req_id;
+          g.unlock();
+          send_msg(fd, rsp);
+          break;
+        }
+        case PsfType::kShutdown: {
+          std::unique_lock<std::mutex> g(mu_);
+          ++shutdowns_;
+          done_cv_.notify_all();
+          goto out;
+        }
+        default:
+          break;
+      }
+    }
+  out:
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      live_fds_.erase(std::remove(live_fds_.begin(), live_fds_.end(), fd),
+                      live_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int num_servers_;
+  int num_workers_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+
+  std::mutex fds_mu_;
+  std::vector<int> live_fds_;
+  std::mutex mu_;
+  std::condition_variable reg_cv_, barrier_cv_, done_cv_;
+  std::vector<std::string> server_addrs_;
+  int servers_seen_ = 0, workers_seen_ = 0;
+  int barrier_count_ = 0;
+  uint64_t barrier_gen_ = 0;
+  int shutdowns_ = 0;
+};
+
+}  // namespace hetups

@@ -1,0 +1,424 @@
+// The parameter server process: TCP accept loop + per-connection handler
+// threads serving PSF requests against the Store.
+//
+// Capability parity with the reference's KVServer + PSFHandle
+// (ps-lite/include/ps/server/PSFHandle.h: DensePull :31, DensePush :51
+// (+= accumulate), DDPushPull :78, SparsePull :106, cachetable.h kSync*).
+// Concurrency: connections are handled in parallel; per-param shared_mutex
+// guards give the reference's ASP lock-granularity (PSFHandle.h:44-95).
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+#include "store.h"
+
+namespace hetups {
+
+class PsServer {
+ public:
+  PsServer(int rank, const std::string& host, int port)
+      : rank_(rank), host_(host), port_(port) {}
+
+  ~PsServer() { stop(); }
+
+  void start() {
+    listen_fd_ = listen_on("", port_);
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  void stop() {
+    running_ = false;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+  }
+
+  int rank() const { return rank_; }
+
+ private:
+  void accept_loop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+    }
+  }
+
+  void serve_conn(int fd) {
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      live_fds_.push_back(fd);
+    }
+    Message req;
+    while (recv_msg(fd, &req)) {
+      if (static_cast<PsfType>(req.head.type) == PsfType::kShutdown) break;
+      Message rsp;
+      rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+      rsp.head.tensor_id = req.head.tensor_id;
+      rsp.head.req_id = req.head.req_id;
+      try {
+        handle(req, &rsp);
+      } catch (const std::exception& e) {
+        std::fprintf(stderr, "[hetups server %d] error on psf %d tensor %d: %s\n",
+                     rank_, req.head.type, req.head.tensor_id, e.what());
+        rsp.head.flags = -1;
+        rsp.args.clear();
+        rsp.args.push_back(Arg::str(e.what()));
+      }
+      try {
+        send_msg(fd, rsp);
+      } catch (...) {
+        break;  // peer gone mid-reply
+      }
+    }
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      live_fds_.erase(std::remove(live_fds_.begin(), live_fds_.end(), fd),
+                      live_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  // ---------------------------------------------------------------------
+  void handle(Message& req, Message* rsp) {
+    const auto type = static_cast<PsfType>(req.head.type);
+    const int32_t key = req.head.tensor_id;
+    switch (type) {
+      case PsfType::kParamInit: {
+        // args: i64[kind, len, width, init_type, otype, n_lr],
+        //       f64[a, b], u64[seed], f32 lrs
+        const int64_t* meta = req.args[0].as_i64();
+        const double* ab = req.args[1].as_f64();
+        uint64_t seed = req.args[2].as_u64()[0];
+        const float* lrs = req.args[3].as_f32();
+        size_t n_lr = req.args[3].n_f32();
+        Param* p = store_.get_or_create(key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        size_t want = static_cast<size_t>(meta[1]) *
+                      (meta[0] == 0 ? 1 : static_cast<size_t>(meta[2]));
+        if (p->data.size() == want && want > 0) break;  // idempotent re-init
+        p->kind = static_cast<ParamKind>(meta[0]);
+        if (p->kind == ParamKind::kDense) {
+          p->len = static_cast<size_t>(meta[1]);
+          p->rows = 0;
+          p->width = 1;
+        } else {
+          p->rows = static_cast<size_t>(meta[1]);
+          p->width = static_cast<size_t>(meta[2]);
+          p->len = p->rows * p->width;
+        }
+        p->otype = static_cast<OptType>(meta[4]);
+        p->lrs.assign(lrs, lrs + n_lr);
+        p->data.assign(p->len, 0.0f);
+        init_values(&p->data, static_cast<InitType>(meta[3]), ab[0], ab[1],
+                    seed + static_cast<uint64_t>(rank_) * 0x9e3779b9u);
+        alloc_slots(*p);
+        if (p->kind == ParamKind::kCacheTable)
+          p->versions.assign(p->rows, 1);  // version 0 = "never seen" client-side
+        break;
+      }
+      case PsfType::kDensePush: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32());
+        break;
+      }
+      case PsfType::kDensePull: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::shared_lock<std::shared_mutex> g(p->mu);
+        rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
+        break;
+      }
+      case PsfType::kDDPushPull: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32());
+        rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
+        break;
+      }
+      case PsfType::kSparsePush: {
+        // args: i64 local row ids (deduped), f32 vals (nidx x width)
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* vals = req.args[1].as_f32();
+        for (size_t i = 0; i < nidx; ++i)
+          apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
+                       vals + i * p->width, p->width);
+        break;
+      }
+      case PsfType::kSparsePull: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::shared_lock<std::shared_mutex> g(p->mu);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        std::vector<float> out(nidx * p->width);
+        for (size_t i = 0; i < nidx; ++i)
+          std::memcpy(out.data() + i * p->width,
+                      p->data.data() + static_cast<size_t>(idx[i]) * p->width,
+                      p->width * 4);
+        rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        break;
+      }
+      case PsfType::kSDPushPull: {
+        // sparse push + dense pull (grads are sparse, want full table back)
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* vals = req.args[1].as_f32();
+        for (size_t i = 0; i < nidx; ++i)
+          apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
+                       vals + i * p->width, p->width);
+        rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
+        break;
+      }
+      case PsfType::kSSPushPull: {
+        // sparse push + sparse pull of (possibly different) rows
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* vals = req.args[1].as_f32();
+        for (size_t i = 0; i < nidx; ++i)
+          apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
+                       vals + i * p->width, p->width);
+        const int64_t* oidx = req.args[2].as_i64();
+        size_t no = req.args[2].n_i64();
+        std::vector<float> out(no * p->width);
+        for (size_t i = 0; i < no; ++i)
+          std::memcpy(out.data() + i * p->width,
+                      p->data.data() + static_cast<size_t>(oidx[i]) * p->width,
+                      p->width * 4);
+        rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        break;
+      }
+      case PsfType::kParamClear: {
+        Param* p = store_.get(key);
+        if (!p) break;
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        std::fill(p->data.begin(), p->data.end(), 0.0f);
+        std::fill(p->accum.begin(), p->accum.end(), 0.0f);
+        std::fill(p->accum2.begin(), p->accum2.end(), 0.0f);
+        p->step = 0;
+        if (!p->versions.empty()) std::fill(p->versions.begin(), p->versions.end(), 1);
+        break;
+      }
+      case PsfType::kParamSave: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::shared_lock<std::shared_mutex> g(p->mu);
+        std::string path = shard_path(req.args[0].as_str(), key);
+        FILE* f = std::fopen(path.c_str(), "wb");
+        if (!f) throw std::runtime_error("cannot open " + path);
+        int64_t meta[3] = {static_cast<int64_t>(p->kind),
+                           static_cast<int64_t>(p->rows ? p->rows : p->len),
+                           static_cast<int64_t>(p->width)};
+        std::fwrite(meta, sizeof(meta), 1, f);
+        std::fwrite(p->data.data(), 4, p->data.size(), f);
+        std::fclose(f);
+        break;
+      }
+      case PsfType::kParamLoad: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        std::string path = shard_path(req.args[0].as_str(), key);
+        FILE* f = std::fopen(path.c_str(), "rb");
+        if (!f) throw std::runtime_error("cannot open " + path);
+        int64_t meta[3];
+        if (std::fread(meta, sizeof(meta), 1, f) != 1) {
+          std::fclose(f);
+          throw std::runtime_error("truncated " + path);
+        }
+        size_t n = std::fread(p->data.data(), 4, p->data.size(), f);
+        std::fclose(f);
+        if (n != p->data.size())
+          throw std::runtime_error("size mismatch loading " + path);
+        break;
+      }
+      case PsfType::kSyncEmbedding: {
+        // Bounded-staleness pull (reference hetu_client.cc:6-37 + PSFHandle
+        // cachetable: return only rows whose server version exceeds the
+        // client's version + bound).
+        // args: i64 local rows, u64 client versions, u64[bound]
+        Param* p = store_.get(key);
+        check(p, key);
+        std::shared_lock<std::shared_mutex> g(p->mu);
+        const int64_t* idx = req.args[0].as_i64();
+        const uint64_t* cver = req.args[1].as_u64();
+        uint64_t bound = req.args[2].as_u64()[0];
+        size_t nidx = req.args[0].n_i64();
+        std::vector<int32_t> sel;
+        std::vector<float> rows;
+        std::vector<uint64_t> vers;
+        for (size_t i = 0; i < nidx; ++i) {
+          size_t r = static_cast<size_t>(idx[i]);
+          if (p->versions[r] > cver[i] + bound) {
+            sel.push_back(static_cast<int32_t>(i));
+            rows.insert(rows.end(), p->data.begin() + r * p->width,
+                        p->data.begin() + (r + 1) * p->width);
+            vers.push_back(p->versions[r]);
+          }
+        }
+        rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
+        rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
+        rsp->args.push_back(Arg::u64(vers.data(), vers.size()));
+        break;
+      }
+      case PsfType::kPushEmbedding: {
+        // args: i64 local rows, f32 grads, u64 per-row update counts
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* grads = req.args[1].as_f32();
+        const uint64_t* ups = req.args[2].as_u64();
+        for (size_t i = 0; i < nidx; ++i) {
+          size_t r = static_cast<size_t>(idx[i]);
+          apply_update(*p, r * p->width, grads + i * p->width, p->width);
+          p->versions[r] += ups[i];  // reference optimizer.h:63-75 ApplyCache
+        }
+        break;
+      }
+      case PsfType::kPushSyncEmbedding: {
+        // push grads for rows A, then bounded-staleness sync rows B.
+        // args: i64 pushA, f32 gradsA, u64 upsA, i64 syncB, u64 cverB, u64[bound]
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        begin_update(*p);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* grads = req.args[1].as_f32();
+        const uint64_t* ups = req.args[2].as_u64();
+        for (size_t i = 0; i < nidx; ++i) {
+          size_t r = static_cast<size_t>(idx[i]);
+          apply_update(*p, r * p->width, grads + i * p->width, p->width);
+          p->versions[r] += ups[i];
+        }
+        const int64_t* sidx = req.args[3].as_i64();
+        const uint64_t* cver = req.args[4].as_u64();
+        uint64_t bound = req.args[5].as_u64()[0];
+        size_t ns = req.args[3].n_i64();
+        std::vector<int32_t> sel;
+        std::vector<float> rows;
+        std::vector<uint64_t> vers;
+        for (size_t i = 0; i < ns; ++i) {
+          size_t r = static_cast<size_t>(sidx[i]);
+          if (p->versions[r] > cver[i] + bound) {
+            sel.push_back(static_cast<int32_t>(i));
+            rows.insert(rows.end(), p->data.begin() + r * p->width,
+                        p->data.begin() + (r + 1) * p->width);
+            vers.push_back(p->versions[r]);
+          }
+        }
+        rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
+        rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
+        rsp->args.push_back(Arg::u64(vers.data(), vers.size()));
+        break;
+      }
+      case PsfType::kDataPush: {
+        // arbitrary-length blob rows keyed by u64 (reference PushData — used
+        // for GNN graph data). args: u64 keys, i64 lens, f32 concat values
+        std::unique_lock<std::shared_mutex> g(data_mu_);
+        const uint64_t* keys = req.args[0].as_u64();
+        size_t nk = req.args[0].n_i64();
+        const int64_t* lens = req.args[1].as_i64();
+        const float* vals = req.args[2].as_f32();
+        size_t off = 0;
+        for (size_t i = 0; i < nk; ++i) {
+          auto& blob = data_store_[{key, keys[i]}];
+          blob.assign(vals + off, vals + off + lens[i]);
+          off += static_cast<size_t>(lens[i]);
+        }
+        break;
+      }
+      case PsfType::kDataPull: {
+        std::shared_lock<std::shared_mutex> g(data_mu_);
+        const uint64_t* keys = req.args[0].as_u64();
+        size_t nk = req.args[0].n_i64();
+        std::vector<float> out;
+        for (size_t i = 0; i < nk; ++i) {
+          auto it = data_store_.find({key, keys[i]});
+          if (it == data_store_.end())
+            throw std::runtime_error("DataPull: missing key");
+          out.insert(out.end(), it->second.begin(), it->second.end());
+        }
+        rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        break;
+      }
+      default:
+        throw std::runtime_error("server: unknown psf type " +
+                                 std::to_string(req.head.type));
+    }
+  }
+
+  static void check(Param* p, int32_t key) {
+    if (!p)
+      throw std::runtime_error("param " + std::to_string(key) +
+                               " not initialized (call InitTensor first)");
+  }
+
+  std::string shard_path(const std::string& dir, int32_t key) const {
+    return dir + "/param_" + std::to_string(key) + "_shard" +
+           std::to_string(rank_) + ".bin";
+  }
+
+  struct PairHash {
+    size_t operator()(const std::pair<int32_t, uint64_t>& p) const {
+      return std::hash<uint64_t>()(p.second * 1315423911u ^
+                                   static_cast<uint64_t>(p.first));
+    }
+  };
+
+  int rank_;
+  std::string host_;
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::mutex fds_mu_;
+  std::vector<int> live_fds_;
+  Store store_;
+  std::shared_mutex data_mu_;
+  std::unordered_map<std::pair<int32_t, uint64_t>, std::vector<float>, PairHash>
+      data_store_;
+};
+
+}  // namespace hetups

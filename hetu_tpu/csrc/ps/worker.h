@@ -1,0 +1,717 @@
+// Worker-side PS agent: key-range partitioning, async push/pull on a thread
+// pool, per-tensor completion tracking.
+//
+// Capability parity with the reference's PSAgent/Worker
+// (ps-lite/include/ps/worker/PSAgent.h: registerTensor key-range partitioning
+// :104-122, dedup-by-key sparse push/pull :124-160; src/worker.cc: thread-pool
+// push :27-36, rank-0 parameter_init + barrier :6-17) and the partitioner
+// (include/ps/partitioner.h: dense average split, sparse row-wise split).
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <unordered_map>
+#include <vector>
+
+#include "net.h"
+#include "store.h"
+
+namespace hetups {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t n) {
+    for (size_t i = 0; i < n; ++i)
+      threads_.emplace_back([this] { loop(); });
+  }
+  ~ThreadPool() { shutdown(); }
+
+  void submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(f));
+    }
+    cv_.notify_one();
+  }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (stop_) return;
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void loop() {
+    for (;;) {
+      std::function<void()> f;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return stop_ || !q_.empty(); });
+        if (q_.empty()) {
+          if (stop_) return;
+          continue;
+        }
+        f = std::move(q_.front());
+        q_.pop_front();
+      }
+      f();
+    }
+  }
+
+  std::vector<std::thread> threads_;
+  std::deque<std::function<void()>> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+// Tracks outstanding async operations per tensor id (reference Worker::wait
+// per node_name) and per data-query id (wait_data).
+class PendingTracker {
+ public:
+  void add(int32_t key, int n = 1) {
+    std::lock_guard<std::mutex> g(mu_);
+    pending_[key] += n;
+  }
+  void done(int32_t key) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (--pending_[key] <= 0) cv_.notify_all();
+  }
+  void wait(int32_t key) {
+    std::unique_lock<std::mutex> g(mu_);
+    cv_.wait(g, [&] { return pending_[key] <= 0; });
+    // surface async worker errors at the Wait() call site
+    auto it = errors_.find(key);
+    if (it != errors_.end()) {
+      std::string e = it->second;
+      errors_.erase(it);
+      throw std::runtime_error(e);
+    }
+  }
+  void fail(int32_t key, const std::string& what) {
+    std::lock_guard<std::mutex> g(mu_);
+    errors_[key] = what;
+    if (--pending_[key] <= 0) cv_.notify_all();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<int32_t, int> pending_;
+  std::unordered_map<int32_t, std::string> errors_;
+};
+
+struct TensorMeta {
+  ParamKind kind = ParamKind::kDense;
+  size_t len = 0;    // dense total length
+  size_t rows = 0;   // sparse rows
+  size_t width = 0;  // sparse width
+};
+
+class PsWorker {
+ public:
+  PsWorker(int rank, int num_workers, const std::string& sched_host,
+           int sched_port, int n_threads = 4)
+      : rank_(rank), num_workers_(num_workers), pool_(n_threads) {
+    sched_ = std::make_unique<Conn>(connect_to(sched_host, sched_port));
+    // register with the scheduler, receive the server address book
+    Message reg;
+    reg.head.type = static_cast<int32_t>(PsfType::kRegister);
+    int32_t meta[3] = {1, rank, 0};
+    reg.args.push_back(Arg::i32(meta, 3));
+    reg.args.push_back(Arg::str("127.0.0.1"));
+    sched_->send(reg);
+    Message book;
+    if (!sched_->recv(&book))
+      throw std::runtime_error("scheduler closed during registration");
+    std::istringstream ss(book.args[0].as_str());
+    std::string line;
+    while (std::getline(ss, line)) {
+      if (line.empty()) continue;
+      auto colon = line.rfind(':');
+      servers_.push_back(std::make_unique<Conn>(
+          connect_to(line.substr(0, colon), std::stoi(line.substr(colon + 1)))));
+    }
+    if (servers_.empty()) throw std::runtime_error("no servers in address book");
+  }
+
+  ~PsWorker() { finalize(); }
+
+  void finalize() {
+    if (finalized_) return;
+    finalized_ = true;
+    pool_.shutdown();
+    Message bye;
+    bye.head.type = static_cast<int32_t>(PsfType::kShutdown);
+    for (auto& s : servers_) {
+      try {
+        s->send(bye);
+      } catch (...) {
+      }
+      s->close();
+    }
+    try {
+      sched_->send(bye);
+    } catch (...) {
+    }
+    sched_->close();
+  }
+
+  int rank() const { return rank_; }
+  int nrank() const { return num_workers_; }
+  size_t num_servers() const { return servers_.size(); }
+
+  // -- partitioner (reference partitioner.h:18-24) -----------------------
+  // dense: average split of [0, len); sparse: row-wise average split.
+  std::pair<size_t, size_t> dense_range(size_t len, size_t s) const {
+    size_t S = servers_.size();
+    return {s * len / S, (s + 1) * len / S};
+  }
+  std::pair<size_t, size_t> row_range(size_t rows, size_t s) const {
+    size_t S = servers_.size();
+    return {s * rows / S, (s + 1) * rows / S};
+  }
+  size_t row_owner(size_t rows, size_t r) const {
+    size_t S = servers_.size();
+    // inverse of row_range: smallest s with (s+1)*rows/S > r
+    size_t s = (r * S) / rows;
+    while ((s + 1) * rows / S <= r) ++s;
+    while (s > 0 && s * rows / S > r) --s;
+    return s;
+  }
+
+  // -- tensor registration / init (reference worker.cc:6-17) -------------
+  void parameter_init(int32_t key, ParamKind kind, size_t len, size_t width,
+                      InitType itype, double a, double b, uint64_t seed,
+                      OptType otype, const float* lrs, size_t n_lr) {
+    {
+      std::lock_guard<std::mutex> g(meta_mu_);
+      TensorMeta& m = metas_[key];
+      m.kind = kind;
+      if (kind == ParamKind::kDense) {
+        m.len = len;
+        m.width = 1;
+      } else {
+        m.rows = len;
+        m.width = width;
+        m.len = len * width;
+      }
+    }
+    // synchronous init on every server shard (idempotent server-side, so no
+    // rank-0-only dance is needed; the reference barriers instead)
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      size_t shard = (kind == ParamKind::kDense)
+                         ? dense_range(len, s).second - dense_range(len, s).first
+                         : row_range(len, s).second - row_range(len, s).first;
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamInit);
+      req.head.tensor_id = key;
+      int64_t meta[6] = {static_cast<int64_t>(kind),
+                         static_cast<int64_t>(shard),
+                         static_cast<int64_t>(width),
+                         static_cast<int64_t>(itype),
+                         static_cast<int64_t>(otype),
+                         static_cast<int64_t>(n_lr)};
+      double ab[2] = {a, b};
+      uint64_t sd = seed + s * 131071u;
+      req.args.push_back(Arg::i64(meta, 6));
+      req.args.push_back(Arg::f64(ab, 2));
+      req.args.push_back(Arg::u64(&sd, 1));
+      req.args.push_back(Arg::f32(lrs, n_lr));
+      rpc(s, req);
+    }
+  }
+
+  const TensorMeta& meta(int32_t key) {
+    std::lock_guard<std::mutex> g(meta_mu_);
+    auto it = metas_.find(key);
+    if (it == metas_.end())
+      throw std::runtime_error("tensor " + std::to_string(key) +
+                               " not registered (InitTensor first)");
+    return it->second;
+  }
+
+  // -- dense ops ---------------------------------------------------------
+  // Async: returns immediately; caller's buffers must stay alive until
+  // wait(key) (same contract as the reference's Push/Pull + Wait).
+  void check_len(const TensorMeta& m, int32_t key, size_t len) const {
+    if (len != m.len)
+      throw std::runtime_error(
+          "tensor " + std::to_string(key) + ": buffer has " +
+          std::to_string(len) + " f32s but " + std::to_string(m.len) +
+          " were registered via InitTensor");
+  }
+
+  void push(int32_t key, const float* grad, size_t len) {
+    auto m = meta(key);
+    check_len(m, key, len);
+    pending_.add(key, static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      auto [lo, hi] = dense_range(m.len, s);
+      pool_.submit([=] {
+        guarded(key, [&] {
+          Message req;
+          req.head.type = static_cast<int32_t>(PsfType::kDensePush);
+          req.head.tensor_id = key;
+          req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          rpc(s, req);
+          record("push", (hi - lo) * 4);
+        });
+      });
+    }
+  }
+
+  void pull(int32_t key, float* out, size_t len) {
+    auto m = meta(key);
+    check_len(m, key, len);
+    pending_.add(key, static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      auto [lo, hi] = dense_range(m.len, s);
+      pool_.submit([=] {
+        guarded(key, [&] {
+          Message req;
+          req.head.type = static_cast<int32_t>(PsfType::kDensePull);
+          req.head.tensor_id = key;
+          Message rsp = rpc(s, req);
+          std::memcpy(out + lo, rsp.args[0].as_f32(), (hi - lo) * 4);
+          record("pull", (hi - lo) * 4);
+        });
+      });
+    }
+  }
+
+  void dd_pushpull(int32_t key, const float* grad, float* out, size_t len) {
+    auto m = meta(key);
+    check_len(m, key, len);
+    pending_.add(key, static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      auto [lo, hi] = dense_range(m.len, s);
+      pool_.submit([=] {
+        guarded(key, [&] {
+          Message req;
+          req.head.type = static_cast<int32_t>(PsfType::kDDPushPull);
+          req.head.tensor_id = key;
+          req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          Message rsp = rpc(s, req);
+          std::memcpy(out + lo, rsp.args[0].as_f32(), (hi - lo) * 4);
+          record("ddpushpull", (hi - lo) * 8);
+        });
+      });
+    }
+  }
+
+  // -- sparse ops --------------------------------------------------------
+  // Dedup-by-key then split per server (reference PSAgent.h:124-160).
+  struct ShardedKeys {
+    std::vector<std::vector<int64_t>> local;     // per-server local row ids
+    std::vector<std::vector<size_t>> positions;  // per-server original slots
+  };
+
+  ShardedKeys shard_rows(const TensorMeta& m, const int64_t* keys, size_t n,
+                         std::vector<int64_t>* uniq_out = nullptr,
+                         std::vector<size_t>* inv_out = nullptr) {
+    // dedup: uniq keys + inverse map original position -> uniq slot
+    std::unordered_map<int64_t, size_t> first;
+    std::vector<int64_t> uniq;
+    std::vector<size_t> inv(n);
+    for (size_t i = 0; i < n; ++i) {
+      auto it = first.find(keys[i]);
+      if (it == first.end()) {
+        first[keys[i]] = uniq.size();
+        inv[i] = uniq.size();
+        uniq.push_back(keys[i]);
+      } else {
+        inv[i] = it->second;
+      }
+    }
+    ShardedKeys sk;
+    sk.local.resize(servers_.size());
+    sk.positions.resize(servers_.size());
+    for (size_t u = 0; u < uniq.size(); ++u) {
+      size_t s = row_owner(m.rows, static_cast<size_t>(uniq[u]));
+      sk.local[s].push_back(uniq[u] -
+                            static_cast<int64_t>(row_range(m.rows, s).first));
+      sk.positions[s].push_back(u);
+    }
+    if (uniq_out) *uniq_out = std::move(uniq);
+    if (inv_out) *inv_out = std::move(inv);
+    return sk;
+  }
+
+  void sparse_push(int32_t key, const int64_t* keys, const float* vals,
+                   size_t n) {
+    auto m = meta(key);
+    // dedup with accumulation: duplicate rows in one push sum their grads
+    std::vector<int64_t> uniq;
+    std::vector<size_t> inv;
+    auto sk = shard_rows(m, keys, n, &uniq, &inv);
+    auto acc = std::make_shared<std::vector<float>>(uniq.size() * m.width, 0.0f);
+    for (size_t i = 0; i < n; ++i) {
+      float* dst = acc->data() + inv[i] * m.width;
+      const float* src = vals + i * m.width;
+      for (size_t j = 0; j < m.width; ++j) dst[j] += src[j];
+    }
+    pending_.add(key, static_cast<int>(servers_.size()));
+    auto sk_p = std::make_shared<ShardedKeys>(std::move(sk));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      pool_.submit([=] {
+        guarded(key, [&] {
+          const auto& loc = sk_p->local[s];
+          if (loc.empty()) return;
+          std::vector<float> shard_vals(loc.size() * m.width);
+          for (size_t i = 0; i < loc.size(); ++i)
+            std::memcpy(shard_vals.data() + i * m.width,
+                        acc->data() + sk_p->positions[s][i] * m.width,
+                        m.width * 4);
+          Message req;
+          req.head.type = static_cast<int32_t>(PsfType::kSparsePush);
+          req.head.tensor_id = key;
+          req.args.push_back(Arg::i64(loc.data(), loc.size()));
+          req.args.push_back(Arg::f32(shard_vals.data(), shard_vals.size()));
+          rpc(s, req);
+          record("sparse_push", shard_vals.size() * 4);
+        });
+      });
+    }
+  }
+
+  void sparse_pull(int32_t key, const int64_t* keys, float* out, size_t n) {
+    auto m = meta(key);
+    std::vector<int64_t> uniq;
+    auto inv = std::make_shared<std::vector<size_t>>();
+    auto sk = shard_rows(m, keys, n, &uniq, inv.get());
+    auto uniq_vals = std::make_shared<std::vector<float>>(uniq.size() * m.width);
+    auto sk_p = std::make_shared<ShardedKeys>(std::move(sk));
+    auto remain = std::make_shared<std::atomic<int>>(
+        static_cast<int>(servers_.size()));
+    pending_.add(key, static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      pool_.submit([=] {
+        guarded(key, [&] {
+          const auto& loc = sk_p->local[s];
+          if (!loc.empty()) {
+            Message req;
+            req.head.type = static_cast<int32_t>(PsfType::kSparsePull);
+            req.head.tensor_id = key;
+            req.args.push_back(Arg::i64(loc.data(), loc.size()));
+            Message rsp = rpc(s, req);
+            const float* rows = rsp.args[0].as_f32();
+            for (size_t i = 0; i < loc.size(); ++i)
+              std::memcpy(uniq_vals->data() + sk_p->positions[s][i] * m.width,
+                          rows + i * m.width, m.width * 4);
+            record("sparse_pull", loc.size() * m.width * 4);
+          }
+          // last shard scatters uniq -> caller positions
+          if (remain->fetch_sub(1) == 1) {
+            for (size_t i = 0; i < n; ++i)
+              std::memcpy(out + i * m.width,
+                          uniq_vals->data() + (*inv)[i] * m.width, m.width * 4);
+          }
+        });
+      });
+    }
+  }
+
+  void sd_pushpull(int32_t key, const int64_t* keys, const float* vals,
+                   size_t n, float* out_dense) {
+    sparse_push(key, keys, vals, n);
+    wait(key);
+    // dense view of a sparse table: pull all rows in order
+    auto m = meta(key);
+    pending_.add(key, static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      auto [lo, hi] = row_range(m.rows, s);
+      pool_.submit([=] {
+        guarded(key, [&] {
+          Message req;
+          req.head.type = static_cast<int32_t>(PsfType::kDensePull);
+          req.head.tensor_id = key;
+          Message rsp = rpc(s, req);
+          std::memcpy(out_dense + lo * m.width, rsp.args[0].as_f32(),
+                      (hi - lo) * m.width * 4);
+        });
+      });
+    }
+  }
+
+  void ss_pushpull(int32_t key, const int64_t* push_keys, const float* vals,
+                   const int64_t* pull_keys, float* out, size_t n) {
+    // BSP-correct ordering: apply the push, then pull (possibly different)
+    // rows. The reference overlaps these per-server (SSPushPull PSF); we
+    // conservatively order globally, which also avoids cross-server skew.
+    sparse_push(key, push_keys, vals, n);
+    wait(key);
+    sparse_pull(key, pull_keys, out, n);
+  }
+
+  // -- cache-table ops (used by the C++ embedding cache) ------------------
+  // Returns rows of `keys` whose server version > client version + bound.
+  // out_* are filled synchronously (callers run on the cache's own threads).
+  void sync_embedding(int32_t key, const int64_t* keys, const uint64_t* cvers,
+                      size_t n, uint64_t bound, std::vector<size_t>* out_pos,
+                      std::vector<float>* out_rows,
+                      std::vector<uint64_t>* out_vers) {
+    auto m = meta(key);
+    auto sk = shard_rows(m, keys, n);
+    out_pos->clear();
+    out_rows->clear();
+    out_vers->clear();
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      const auto& loc = sk.local[s];
+      if (loc.empty()) continue;
+      std::vector<uint64_t> shard_vers(loc.size());
+      for (size_t i = 0; i < loc.size(); ++i)
+        shard_vers[i] = cvers[sk.positions[s][i]];
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kSyncEmbedding);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::i64(loc.data(), loc.size()));
+      req.args.push_back(Arg::u64(shard_vers.data(), shard_vers.size()));
+      req.args.push_back(Arg::u64(&bound, 1));
+      Message rsp = rpc(s, req);
+      const int32_t* sel = rsp.args[0].as_i32();
+      size_t nsel = rsp.args[0].size() / 4;
+      const float* rows = rsp.args[1].as_f32();
+      const uint64_t* vers = rsp.args[2].as_u64();
+      for (size_t i = 0; i < nsel; ++i) {
+        out_pos->push_back(sk.positions[s][sel[i]]);
+        out_rows->insert(out_rows->end(), rows + i * m.width,
+                         rows + (i + 1) * m.width);
+        out_vers->push_back(vers[i]);
+      }
+      record("sync_embedding", nsel * m.width * 4);
+    }
+  }
+
+  void push_embedding(int32_t key, const int64_t* keys, const float* grads,
+                      const uint64_t* updates, size_t n) {
+    auto m = meta(key);
+    auto sk = shard_rows(m, keys, n);
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      const auto& loc = sk.local[s];
+      if (loc.empty()) continue;
+      std::vector<float> shard_grads(loc.size() * m.width);
+      std::vector<uint64_t> shard_ups(loc.size());
+      for (size_t i = 0; i < loc.size(); ++i) {
+        std::memcpy(shard_grads.data() + i * m.width,
+                    grads + sk.positions[s][i] * m.width, m.width * 4);
+        shard_ups[i] = updates[sk.positions[s][i]];
+      }
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kPushEmbedding);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::i64(loc.data(), loc.size()));
+      req.args.push_back(Arg::f32(shard_grads.data(), shard_grads.size()));
+      req.args.push_back(Arg::u64(shard_ups.data(), shard_ups.size()));
+      rpc(s, req);
+      record("push_embedding", shard_grads.size() * 4);
+    }
+  }
+
+  // -- data blobs (reference PushData/PullData) ---------------------------
+  using query_t = int64_t;
+
+  query_t push_data(int32_t key, const uint64_t* ids, size_t n,
+                    const float* vals, const int64_t* lens) {
+    return data_op(PsfType::kDataPush, key, ids, n, const_cast<float*>(vals),
+                   lens);
+  }
+
+  query_t pull_data(int32_t key, const uint64_t* ids, size_t n, float* vals,
+                    const int64_t* lens) {
+    return data_op(PsfType::kDataPull, key, ids, n, vals, lens);
+  }
+
+  void wait_data(query_t q) { pending_.wait(query_key(q)); }
+
+  // -- control -----------------------------------------------------------
+  void wait(int32_t key) { pending_.wait(key); }
+
+  void barrier() {
+    std::lock_guard<std::mutex> g(sched_mu_);
+    Message req;
+    req.head.type = static_cast<int32_t>(PsfType::kBarrier);
+    sched_->send(req);
+    Message rsp;
+    if (!sched_->recv(&rsp)) throw std::runtime_error("scheduler lost in barrier");
+  }
+
+  void clear(int32_t key) {
+    std::lock_guard<std::mutex> g(meta_mu_);
+    metas_.erase(key);
+  }
+
+  void clear_on_server(int32_t key) {
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamClear);
+      req.head.tensor_id = key;
+      rpc(s, req);
+    }
+  }
+
+  void parameter_save(int32_t key, const std::string& dir) {
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamSave);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::str(dir));
+      rpc(s, req);
+    }
+  }
+
+  void parameter_load(int32_t key, const std::string& dir) {
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamLoad);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::str(dir));
+      rpc(s, req);
+    }
+  }
+
+  // -- load recording (reference PSAgent::startRecord/getLoads) ----------
+  void start_record(const std::string& dir) {
+    std::lock_guard<std::mutex> g(loads_mu_);
+    record_dir_ = dir;
+    loads_.clear();
+  }
+
+  std::string get_loads() {
+    std::lock_guard<std::mutex> g(loads_mu_);
+    std::ostringstream os;
+    os << "{";
+    bool fst = true;
+    for (auto& kv : loads_) {
+      if (!fst) os << ", ";
+      fst = false;
+      os << "\"" << kv.first << "\": " << kv.second;
+    }
+    os << "}";
+    if (!record_dir_.empty()) {
+      FILE* f = std::fopen((record_dir_ + "/ps_loads_w" +
+                            std::to_string(rank_) + ".json").c_str(), "w");
+      if (f) {
+        std::string s = os.str();
+        std::fwrite(s.data(), 1, s.size(), f);
+        std::fclose(f);
+      }
+    }
+    return os.str();
+  }
+
+ private:
+  Message rpc(size_t server, Message& req) {
+    // serialize the whole round trip per server connection: concurrency
+    // comes from the pool issuing to different servers in parallel
+    auto& conn = *servers_[server];
+    std::lock_guard<std::mutex> g(server_mu_[server % kMaxServers]);
+    conn.send(req);
+    Message rsp;
+    if (!conn.recv(&rsp))
+      throw std::runtime_error("server " + std::to_string(server) + " closed");
+    if (rsp.head.flags == -1)
+      throw std::runtime_error("server error: " + rsp.args[0].as_str());
+    return rsp;
+  }
+
+  template <typename F>
+  void guarded(int32_t key, F&& f) {
+    try {
+      f();
+      pending_.done(key);
+    } catch (const std::exception& e) {
+      pending_.fail(key, e.what());
+    }
+  }
+
+  static int32_t query_key(query_t q) {
+    return static_cast<int32_t>(q % 1000000) + 1000000000;
+  }
+
+  query_t data_op(PsfType type, int32_t key, const uint64_t* ids, size_t n,
+                  float* vals, const int64_t* lens) {
+    query_t q = next_query_++;
+    // shard by id hash across servers
+    struct Shard {
+      std::vector<uint64_t> ids;
+      std::vector<int64_t> lens;
+      std::vector<size_t> offs;  // offsets into vals
+    };
+    auto shards = std::make_shared<std::vector<Shard>>(servers_.size());
+    size_t off = 0;
+    for (size_t i = 0; i < n; ++i) {
+      size_t s = ids[i] % servers_.size();
+      (*shards)[s].ids.push_back(ids[i]);
+      (*shards)[s].lens.push_back(lens[i]);
+      (*shards)[s].offs.push_back(off);
+      off += static_cast<size_t>(lens[i]);
+    }
+    pending_.add(query_key(q), static_cast<int>(servers_.size()));
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      pool_.submit([=] {
+        guarded(query_key(q), [&] {
+          auto& sh = (*shards)[s];
+          if (sh.ids.empty()) return;
+          Message req;
+          req.head.type = static_cast<int32_t>(type);
+          req.head.tensor_id = key;
+          req.args.push_back(Arg::u64(sh.ids.data(), sh.ids.size()));
+          req.args.push_back(Arg::i64(sh.lens.data(), sh.lens.size()));
+          if (type == PsfType::kDataPush) {
+            std::vector<float> payload;
+            for (size_t i = 0; i < sh.ids.size(); ++i)
+              payload.insert(payload.end(), vals + sh.offs[i],
+                             vals + sh.offs[i] + sh.lens[i]);
+            req.args.push_back(Arg::f32(payload.data(), payload.size()));
+            rpc(s, req);
+          } else {
+            Message rsp = rpc(s, req);
+            const float* rows = rsp.args[0].as_f32();
+            size_t roff = 0;
+            for (size_t i = 0; i < sh.ids.size(); ++i) {
+              std::memcpy(vals + sh.offs[i], rows + roff, sh.lens[i] * 4);
+              roff += static_cast<size_t>(sh.lens[i]);
+            }
+          }
+        });
+      });
+    }
+    return q;
+  }
+
+  static constexpr size_t kMaxServers = 64;
+
+  int rank_, num_workers_;
+  bool finalized_ = false;
+  std::unique_ptr<Conn> sched_;
+  std::mutex sched_mu_;
+  std::vector<std::unique_ptr<Conn>> servers_;
+  std::mutex server_mu_[kMaxServers];
+  ThreadPool pool_;
+  PendingTracker pending_;
+  std::mutex meta_mu_;
+  std::unordered_map<int32_t, TensorMeta> metas_;
+  std::atomic<query_t> next_query_{1};
+  std::mutex loads_mu_;
+  std::string record_dir_;
+  std::unordered_map<std::string, uint64_t> loads_;
+
+  void record(const char* op, size_t bytes) {
+    std::lock_guard<std::mutex> g(loads_mu_);
+    loads_[op] += bytes;
+  }
+};
+
+}  // namespace hetups

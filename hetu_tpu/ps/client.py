@@ -1,0 +1,239 @@
+"""Worker-side PS client: ctypes facade over the native agent.
+
+Capability parity with the reference's worker usage of ``libps.so``
+(gpu_ops/executor.py:69-100 loads the lib; tests/pstests/test_apis.py and
+ParameterServerCommunicate.py call InitTensor/Push/Pull/SparsePush/.../Wait/
+BarrierWorker on it directly). The reference hands ctypes the DLArray struct
+pointer; here arrays cross as numpy buffers (the TPU NDArray is a jax.Array,
+so device values stage through host numpy — the analogue of the reference's
+d2h staging in ParameterServerCommunicate.py:29-36).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..csrc.build import build
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i64p = ctypes.POINTER(ctypes.c_long)
+_u64p = ctypes.POINTER(ctypes.c_ulonglong)
+
+_INIT_TYPE = {"constant": 0, "uniform": 1, "normal": 2, "truncated_normal": 3}
+_OPT_TYPE = {"sgd": 0, "momentum": 1, "nesterov": 2, "adagrad": 3, "adam": 4}
+
+
+def _load_lib():
+    lib = ctypes.CDLL(build("libhetu_ps.so"))
+    lib.LastError.restype = ctypes.c_char_p
+    lib.getLoads.restype = ctypes.c_char_p
+    lib.PushData.restype = ctypes.c_long
+    lib.PullData.restype = ctypes.c_long
+    lib.rank.restype = ctypes.c_int
+    lib.nrank.restype = ctypes.c_int
+    lib.num_servers.restype = ctypes.c_int
+    return lib
+
+
+def _as_f32(arr) -> np.ndarray:
+    if hasattr(arr, "asnumpy"):  # NDArray
+        arr = arr.asnumpy()
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def _as_i64(arr) -> np.ndarray:
+    if hasattr(arr, "asnumpy"):
+        arr = arr.asnumpy()
+    return np.ascontiguousarray(arr, dtype=np.int64)
+
+
+class PSClient:
+    """One per worker process. Methods mirror the reference C API names."""
+
+    def __init__(self):
+        self._lib = _load_lib()
+        self._lib.Init()
+        self._check()
+        # pinned staging buffers per tensor id: async Push/Pull contract
+        # requires buffers to stay alive until Wait
+        self._staging: dict[int, list] = {}
+
+    @classmethod
+    def from_env(cls) -> "PSClient":
+        return cls()
+
+    def _check(self):
+        err = self._lib.LastError()
+        if err:
+            raise RuntimeError(err.decode())
+
+    def _stage(self, node: int, *arrays):
+        self._staging.setdefault(node, []).extend(arrays)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        self._lib.Finalize()
+
+    Finalize = close
+
+    @property
+    def rank(self) -> int:
+        return self._lib.rank()
+
+    @property
+    def nrank(self) -> int:
+        return self._lib.nrank()
+
+    @property
+    def num_servers(self) -> int:
+        return self._lib.num_servers()
+
+    # -- tensor init (reference InitTensor binding) -------------------------
+    def InitTensor(self, node, sparse, length, width, init_type, init_a,
+                   init_b=1.0, seed=123, opt_type="sgd", lrs=(0.1,)):
+        if isinstance(init_type, str):
+            init_type = _INIT_TYPE[init_type]
+        if isinstance(opt_type, str):
+            opt_type = _OPT_TYPE[opt_type]
+        lrs_arr = np.asarray(lrs, dtype=np.float32)
+        self._lib.InitTensor(
+            ctypes.c_int(int(node)), ctypes.c_int(int(bool(sparse))),
+            ctypes.c_long(int(length)), ctypes.c_long(int(width)),
+            ctypes.c_int(int(init_type)), ctypes.c_double(float(init_a)),
+            ctypes.c_double(float(init_b)), ctypes.c_ulonglong(int(seed)),
+            ctypes.c_int(int(opt_type)), lrs_arr.ctypes.data_as(_f32p),
+            ctypes.c_int(len(lrs_arr)))
+        self._check()
+
+    # -- dense --------------------------------------------------------------
+    def Push(self, node, grad):
+        g = _as_f32(grad)
+        self._stage(node, g)
+        self._lib.Push(ctypes.c_int(node), g.ctypes.data_as(_f32p),
+                       ctypes.c_long(g.size))
+
+    def Pull(self, node, out):
+        """out: numpy array filled in place after Wait(node); returns it."""
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        self._stage(node, out)
+        self._lib.Pull(ctypes.c_int(node), out.ctypes.data_as(_f32p),
+                       ctypes.c_long(out.size))
+        return out
+
+    def DDPushPull(self, node, grad, out):
+        g = _as_f32(grad)
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        self._stage(node, g)
+        self._stage(node, out)
+        self._lib.DDPushPull(ctypes.c_int(node), g.ctypes.data_as(_f32p),
+                             out.ctypes.data_as(_f32p), ctypes.c_long(g.size))
+        return out
+
+    # -- sparse -------------------------------------------------------------
+    def SparsePush(self, node, indices, values):
+        idx, vals = _as_i64(indices).ravel(), _as_f32(values)
+        self._stage(node, idx)
+        self._stage(node, vals)
+        self._lib.SparsePush(ctypes.c_int(node), idx.ctypes.data_as(_i64p),
+                             vals.ctypes.data_as(_f32p), ctypes.c_long(idx.size))
+
+    def SparsePull(self, node, indices, out):
+        idx = _as_i64(indices).ravel()
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        self._stage(node, idx)
+        self._stage(node, out)
+        self._lib.SparsePull(ctypes.c_int(node), idx.ctypes.data_as(_i64p),
+                             out.ctypes.data_as(_f32p), ctypes.c_long(idx.size))
+        return out
+
+    def SDPushPull(self, node, indices, values, out):
+        idx, vals = _as_i64(indices).ravel(), _as_f32(values)
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        self._stage(node, idx)
+        self._stage(node, vals)
+        self._stage(node, out)
+        self._lib.SDPushPull(ctypes.c_int(node), idx.ctypes.data_as(_i64p),
+                             vals.ctypes.data_as(_f32p), ctypes.c_long(idx.size),
+                             out.ctypes.data_as(_f32p))
+        return out
+
+    def SSPushPull(self, node, in_indices, values, out_indices, out):
+        iidx, vals = _as_i64(in_indices).ravel(), _as_f32(values)
+        oidx = _as_i64(out_indices).ravel()
+        assert iidx.size == oidx.size
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        self._stage(node, iidx)
+        self._stage(node, vals)
+        self._stage(node, oidx)
+        self._stage(node, out)
+        self._lib.SSPushPull(ctypes.c_int(node), iidx.ctypes.data_as(_i64p),
+                             vals.ctypes.data_as(_f32p),
+                             oidx.ctypes.data_as(_i64p),
+                             out.ctypes.data_as(_f32p), ctypes.c_long(iidx.size))
+        return out
+
+    # -- data blobs (reference PushData/PullData) ---------------------------
+    def PushData(self, node, ids, values, lens):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        vals = _as_f32(values)
+        lens = np.ascontiguousarray(lens, dtype=np.int64)
+        q = self._lib.PushData(ctypes.c_int(node), ids.ctypes.data_as(_u64p),
+                               ctypes.c_int(ids.size),
+                               vals.ctypes.data_as(_f32p),
+                               lens.ctypes.data_as(_i64p))
+        self._stage(-q - 1, (ids, vals, lens))
+        return q
+
+    def PullData(self, node, ids, out, lens):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        out = np.ascontiguousarray(out, dtype=np.float32)
+        lens = np.ascontiguousarray(lens, dtype=np.int64)
+        q = self._lib.PullData(ctypes.c_int(node), ids.ctypes.data_as(_u64p),
+                               ctypes.c_int(ids.size),
+                               out.ctypes.data_as(_f32p),
+                               lens.ctypes.data_as(_i64p))
+        self._stage(-q - 1, (ids, out, lens))
+        return q, out
+
+    def WaitData(self, query):
+        self._lib.WaitData(ctypes.c_long(query))
+        self._staging.pop(-query - 1, None)
+        self._check()
+
+    # -- control ------------------------------------------------------------
+    def Wait(self, node):
+        if hasattr(node, "value"):
+            node = node.value
+        self._lib.Wait(ctypes.c_int(int(node)))
+        self._staging.pop(int(node), None)
+        self._check()
+
+    def BarrierWorker(self):
+        self._lib.BarrierWorker()
+        self._check()
+
+    def Clear(self, node):
+        self._lib.Clear(ctypes.c_int(node))
+
+    def ClearOnServer(self, node):
+        self._lib.ClearOnServer(ctypes.c_int(node))
+        self._check()
+
+    def SaveParam(self, node, directory):
+        os.makedirs(directory, exist_ok=True)
+        self._lib.SaveParam(ctypes.c_int(node), str(directory).encode())
+        self._check()
+
+    def LoadParam(self, node, directory):
+        self._lib.LoadParam(ctypes.c_int(node), str(directory).encode())
+        self._check()
+
+    def startRecord(self, directory):
+        os.makedirs(directory, exist_ok=True)
+        self._lib.startRecord(str(directory).encode())
+
+    def getLoads(self):
+        import json
+        return json.loads(self._lib.getLoads().decode())

@@ -1,0 +1,57 @@
+"""Server/scheduler role entry points (ctypes over the native lib).
+
+Reference parity: ``server_init``/``scheduler_init`` in gpu_ops/executor.py:80-100
+load libps.so and call Init()/StartServer(); role and topology come from
+DMLC_* env vars (runner.py:186-190). Same here — the env var names are kept so
+reference cluster ymls (tests/pstests/local_s2_w2.yml) work unchanged.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from ..csrc.build import build
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(build("libhetu_ps.so"))
+        _lib.LastError.restype = ctypes.c_char_p
+    return _lib
+
+
+def _check(lib):
+    err = lib.LastError()
+    if err:
+        raise RuntimeError(err.decode())
+
+
+def start_scheduler_from_env():
+    lib = _load()
+    lib.Init()
+    _check(lib)
+
+
+def scheduler_wait():
+    """Block until every node has checked out (clean teardown)."""
+    lib = _load()
+    lib.SchedulerWait()
+
+
+def stop_scheduler():
+    lib = _load()
+    lib.Finalize()
+
+
+def start_server_from_env():
+    lib = _load()
+    lib.Init()
+    _check(lib)
+    lib.StartServer()
+
+
+def stop_server():
+    lib = _load()
+    lib.Finalize()
