@@ -1,0 +1,6 @@
+from . import load_data
+from .wdl_adult import wdl_adult
+from .wdl_criteo import wdl_criteo
+from .deepfm_criteo import dfm_criteo
+from .dcn_criteo import dcn_criteo
+from .dc_criteo import dc_criteo

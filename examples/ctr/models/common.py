@@ -1,0 +1,36 @@
+"""Shared building blocks for the CTR model zoo."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def dense_layer(x, in_dim, out_dim, name, activation=None, stddev=0.1,
+                bias=True, xavier=False):
+    if xavier:
+        w = init.xavier_normal([in_dim, out_dim], name=f"{name}_w")
+    else:
+        w = init.random_normal([in_dim, out_dim], stddev=stddev,
+                               name=f"{name}_w")
+    y = ht.matmul_op(x, w)
+    if bias:
+        b = init.zeros([out_dim], name=f"{name}_b") if xavier else \
+            init.random_normal([out_dim], stddev=stddev, name=f"{name}_b")
+        y = y + ht.broadcastto_op(b, y)
+    if activation == "relu":
+        y = ht.relu_op(y)
+    elif activation == "sigmoid":
+        y = ht.sigmoid_op(y)
+    return y
+
+
+def mlp(x, dims, name, stddev=0.1, out_activation=None):
+    for i in range(len(dims) - 1):
+        act = "relu" if i < len(dims) - 2 else out_activation
+        x = dense_layer(x, dims[i], dims[i + 1], f"{name}{i + 1}",
+                        activation=act, stddev=stddev, bias=False)
+    return x
+
+
+def bce_loss_and_train(y, y_, lr):
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=lr)
+    return loss, opt.minimize(loss)

@@ -1,0 +1,35 @@
+"""Deep Crossing on Criteo (reference examples/ctr/models/dc_criteo.py):
+stacked residual units over the concatenated embedding + dense features."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+from .common import bce_loss_and_train, dense_layer
+
+
+def _residual_unit(x, dim, hidden, layer_idx):
+    # scale-aware init: the reference's fixed stddev=0.1 blows up for wide
+    # residual stacks (5 layers x 400+ features compounds)
+    h = dense_layer(x, dim, hidden, f"res{layer_idx}_1", activation="relu",
+                    xavier=True)
+    h = dense_layer(h, hidden, dim, f"res{layer_idx}_2", xavier=True)
+    return ht.relu_op(h + x)
+
+
+def dc_criteo(dense_input, sparse_input, y_, feature_dimension=33762577,
+              embedding_size=8, learning_rate=0.001, n_slots=26, n_dense=13,
+              num_layers=5):
+    table = init.random_normal([feature_dimension, embedding_size],
+                               stddev=0.01, name="snd_order_embedding",
+                               is_embed=True, ctx=ht.cpu(0))
+    emb = ht.embedding_lookup_op(table, sparse_input)
+    emb = ht.array_reshape_op(emb, (-1, n_slots * embedding_size))
+    x = ht.concat_op(emb, dense_input, axis=1)
+    dim = n_slots * embedding_size + n_dense
+
+    for i in range(num_layers):
+        x = _residual_unit(x, dim, dim, i)
+
+    w_out = init.random_normal([dim, 1], stddev=0.1, name="W4")
+    y = ht.sigmoid_op(ht.matmul_op(x, w_out))
+    loss, train_op = bce_loss_and_train(y, y_, learning_rate)
+    return loss, y, y_, train_op

@@ -1,0 +1,39 @@
+"""Deep & Cross Network on Criteo (reference
+examples/ctr/models/dcn_criteo.py): explicit feature crosses
+x_{l+1} = x0 * (x_l w) + b + x_l alongside a deep tower."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+from .common import bce_loss_and_train, mlp
+
+
+def _cross_layer(x0, xl, width, layer_idx):
+    w = init.random_normal((width, 1), stddev=0.01,
+                           name=f"cross_w{layer_idx}")
+    b = init.random_normal((width,), stddev=0.01, name=f"cross_b{layer_idx}")
+    xlw = ht.matmul_op(xl, w)
+    y = ht.mul_op(x0, ht.broadcastto_op(xlw, x0))
+    return y + xl + ht.broadcastto_op(b, y)
+
+
+def dcn_criteo(dense_input, sparse_input, y_, feature_dimension=33762577,
+               embedding_size=128, learning_rate=0.003, n_slots=26,
+               n_dense=13, cross_layers=3):
+    table = init.random_normal([feature_dimension, embedding_size],
+                               stddev=0.01, name="snd_order_embedding",
+                               is_embed=True, ctx=ht.cpu(0))
+    emb = ht.embedding_lookup_op(table, sparse_input)
+    emb = ht.array_reshape_op(emb, (-1, n_slots * embedding_size))
+    x0 = ht.concat_op(emb, dense_input, axis=1)
+    width = n_slots * embedding_size + n_dense
+
+    xl = x0
+    for i in range(cross_layers):
+        xl = _cross_layer(x0, xl, width, i)
+
+    deep = mlp(x0, [width, 256, 256, 256], "W", stddev=0.01)
+    joint = ht.concat_op(xl, deep, axis=1)
+    w_out = init.random_normal([width + 256, 1], stddev=0.01, name="W4")
+    y = ht.sigmoid_op(ht.matmul_op(joint, w_out))
+    loss, train_op = bce_loss_and_train(y, y_, learning_rate)
+    return loss, y, y_, train_op

@@ -1,0 +1,40 @@
+"""DeepFM on Criteo (reference examples/ctr/models/deepfm_criteo.py):
+first-order embedding + FM second-order interaction (sum-square minus
+square-sum trick) + a DNN over the flattened second-order embeddings."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+from .common import bce_loss_and_train, mlp
+
+
+def dfm_criteo(dense_input, sparse_input, y_, feature_dimension=33762577,
+               embedding_size=128, learning_rate=0.01, n_slots=26,
+               n_dense=13):
+    # first-order terms
+    emb1 = init.random_normal([feature_dimension, 1], stddev=0.01,
+                              name="fst_order_embedding", is_embed=True,
+                              ctx=ht.cpu(0))
+    fm_w = init.random_normal([n_dense, 1], stddev=0.01,
+                              name="dense_parameter")
+    first_sparse = ht.embedding_lookup_op(emb1, sparse_input)
+    y1 = ht.matmul_op(dense_input, fm_w) + ht.reduce_sum_op(first_sparse,
+                                                            axes=1)
+
+    # second-order FM interaction: ((Σe)² - Σe²) / 2
+    emb2 = init.random_normal([feature_dimension, embedding_size],
+                              stddev=0.01, name="snd_order_embedding",
+                              is_embed=True, ctx=ht.cpu(0))
+    e = ht.embedding_lookup_op(emb2, sparse_input)
+    sum_e = ht.reduce_sum_op(e, axes=1)
+    square_of_sum = ht.mul_op(sum_e, sum_e)
+    sum_of_square = ht.reduce_sum_op(ht.mul_op(e, e), axes=1)
+    y2 = ht.reduce_sum_op((square_of_sum + -1 * sum_of_square) * 0.5,
+                          axes=1, keepdims=True)
+
+    # deep tower over the flattened embeddings
+    flat = ht.array_reshape_op(e, (-1, n_slots * embedding_size))
+    y3 = mlp(flat, [n_slots * embedding_size, 256, 256, 1], "W", stddev=0.01)
+
+    y = ht.sigmoid_op(y1 + y2 + y3)
+    loss, train_op = bce_loss_and_train(y, y_, learning_rate)
+    return loss, y, y_, train_op

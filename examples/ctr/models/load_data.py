@@ -1,0 +1,55 @@
+"""CTR dataset loaders (reference examples/ctr/models/load_data.py).
+
+Real Criteo/Adult downloads need egress; when the raw files are absent a
+deterministic synthetic dataset with the same shapes/dtypes is generated so
+every trainer and test runs offline (the CNN suite's MNIST fallback works the
+same way, hetu_tpu/data.py)."""
+import os
+
+import numpy as np
+
+
+def _synth_criteo(n_samples, feature_dimension, rng):
+    dense = rng.randn(n_samples, 13).astype(np.float32)
+    sparse = rng.randint(0, feature_dimension,
+                         (n_samples, 26)).astype(np.float32)
+    # labels correlate with dense features so training can learn
+    labels = (dense.sum(1, keepdims=True) > 0).astype(np.float32)
+    return dense, sparse, labels
+
+
+def load_criteo_data(path=None, feature_dimension=33762577, n_train=8192,
+                     n_test=2048, seed=0):
+    """Returns (train, test) tuples of (dense, sparse, labels)."""
+    if path and os.path.exists(path):
+        data = np.load(path)
+        return ((data["train_dense"], data["train_sparse"],
+                 data["train_labels"]),
+                (data["test_dense"], data["test_sparse"],
+                 data["test_labels"]))
+    rng = np.random.RandomState(seed)
+    return (_synth_criteo(n_train, feature_dimension, rng),
+            _synth_criteo(n_test, feature_dimension, rng))
+
+
+def load_adult_data(path=None, n_train=8192, n_test=2048, seed=0,
+                    dim_wide=809, embed_rows=50):
+    """Adult census: 8 categorical slots, 4 numeric, wide features, labels
+    one-hot over 2 classes (reference wdl_adult input layout)."""
+    if path and os.path.exists(path):
+        data = np.load(path)
+        return ((data["train_deep"], data["train_wide"],
+                 data["train_labels"]),
+                (data["test_deep"], data["test_wide"], data["test_labels"]))
+    rng = np.random.RandomState(seed)
+
+    def synth(n):
+        cat = [rng.randint(0, embed_rows, (n, 1)).astype(np.float32)
+               for _ in range(8)]
+        num = [rng.randn(n, 1).astype(np.float32) for _ in range(4)]
+        wide = rng.randn(n, dim_wide).astype(np.float32)
+        y = (wide[:, :1] + num[0] > 0).astype(np.int64).ravel()
+        labels = np.eye(2, dtype=np.float32)[y]
+        return cat + num, wide, labels
+
+    return synth(n_train), synth(n_test)

@@ -1,0 +1,40 @@
+"""Wide & Deep on the Adult census dataset (reference
+examples/ctr/models/wdl_adult.py): 8 categorical slots with per-slot
+embedding tables + 4 numeric fields feed the deep tower; the wide part
+concatenates raw wide features with the deep output."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def wdl_adult(X_deep, X_wide, y_, dim_wide=809, embed_rows=50, embed_dim=8):
+    n_cat, n_num = 8, 4
+    deep_in = n_cat * embed_dim + n_num
+
+    parts = []
+    for i in range(n_cat):
+        table = init.random_normal([embed_rows, embed_dim], stddev=0.1,
+                                   name=f"Embedding_deep_{i}", is_embed=True)
+        parts.append(ht.array_reshape_op(
+            ht.embedding_lookup_op(table, X_deep[i]), (-1, embed_dim)))
+    for i in range(n_num):
+        parts.append(ht.array_reshape_op(X_deep[n_cat + i], (-1, 1)))
+    deep = parts[0]
+    for p in parts[1:]:
+        deep = ht.concat_op(deep, p, 1)
+
+    w1 = init.random_normal([deep_in, 50], stddev=0.1, name="W1")
+    b1 = init.random_normal([50], stddev=0.1, name="b1")
+    w2 = init.random_normal([50, 20], stddev=0.1, name="W2")
+    b2 = init.random_normal([20], stddev=0.1, name="b2")
+    h = ht.matmul_op(deep, w1)
+    h = ht.relu_op(h + ht.broadcastto_op(b1, h))
+    h = ht.matmul_op(h, w2)
+    dmodel = ht.relu_op(h + ht.broadcastto_op(b2, h))
+
+    w_out = init.random_normal([dim_wide + 20, 2], stddev=0.1, name="W")
+    wmodel = ht.matmul_op(ht.concat_op(X_wide, dmodel, 1), w_out)
+
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(wmodel, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=5 / 128)
+    return loss, ht.softmax_op(wmodel), y_, opt.minimize(loss)

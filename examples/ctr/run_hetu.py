@@ -1,0 +1,132 @@
+"""CTR trainer (reference examples/ctr/run_hetu.py): train/validate
+subexecutors, loss/acc/AUC reporting, comm modes local / PS / Hybrid, with
+optional bounded-staleness cache and BSP.
+
+Run locally:            python run_hetu.py --model wdl_criteo
+Under a PS cluster:     heturun -c cluster.yml python run_hetu.py --model \
+                        wdl_criteo --comm Hybrid [--cache LFUOpt] [--bsp]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu import metrics as ht_metrics  # noqa: E402
+import models  # noqa: E402
+from models.load_data import load_adult_data, load_criteo_data  # noqa: E402
+
+
+def build(args):
+    batch = args.batch_size
+    if args.model == "wdl_adult":
+        (train_deep, train_wide, train_y), (test_deep, test_wide, test_y) = \
+            load_adult_data()
+        X_deep = [
+            ht.dataloader_op([
+                ht.Dataloader(train_deep[i], batch, "train"),
+                ht.Dataloader(test_deep[i], batch, "validate"),
+            ]) for i in range(12)]
+        X_wide = ht.dataloader_op([
+            ht.Dataloader(train_wide, batch, "train"),
+            ht.Dataloader(test_wide, batch, "validate")])
+        y_ = ht.dataloader_op([
+            ht.Dataloader(train_y, batch, "train"),
+            ht.Dataloader(test_y, batch, "validate")])
+        loss, y, labels, train_op = models.wdl_adult(X_deep, X_wide, y_)
+    else:
+        feature_dim = args.dim
+        (tr_dense, tr_sparse, tr_y), (te_dense, te_sparse, te_y) = \
+            load_criteo_data(feature_dimension=feature_dim)
+        dense = ht.dataloader_op([
+            ht.Dataloader(tr_dense, batch, "train"),
+            ht.Dataloader(te_dense, batch, "validate")])
+        sparse = ht.dataloader_op([
+            ht.Dataloader(tr_sparse, batch, "train"),
+            ht.Dataloader(te_sparse, batch, "validate")])
+        y_ = ht.dataloader_op([
+            ht.Dataloader(tr_y, batch, "train"),
+            ht.Dataloader(te_y, batch, "validate")])
+        model_fn = getattr(models, args.model)
+        loss, y, labels, train_op = model_fn(
+            dense, sparse, y_, feature_dimension=feature_dim)
+    return loss, y, labels, train_op
+
+
+def accuracy(y_val, pred):
+    if y_val.shape[1] == 1:
+        return np.equal(y_val, pred > 0.5).astype(np.float32).mean()
+    return np.equal(np.argmax(y_val, 1),
+                    np.argmax(pred, 1)).astype(np.float32).mean()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="wdl_criteo",
+                        choices=["wdl_adult", "wdl_criteo", "dfm_criteo",
+                                 "dcn_criteo", "dc_criteo"])
+    parser.add_argument("--comm", default=None,
+                        choices=[None, "PS", "Hybrid", "AllReduce"])
+    parser.add_argument("--cache", default=None,
+                        choices=[None, "LRU", "LFU", "LFUOpt"])
+    parser.add_argument("--bsp", action="store_true")
+    parser.add_argument("--bound", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--nepoch", type=int, default=1)
+    parser.add_argument("--dim", type=int,
+                        default=int(os.environ.get("HETU_CTR_DIM", 100000)),
+                        help="feature dimension (full Criteo: 33762577)")
+    parser.add_argument("--val", action="store_true")
+    parser.add_argument("--all", dest="val", action="store_true")
+    args = parser.parse_args()
+
+    if args.comm in ("PS", "Hybrid"):
+        ht.worker_init()
+
+    loss, y, labels, train_op = build(args)
+    executor = ht.Executor(
+        {"train": [loss, y, labels, train_op], "validate": [loss, y, labels]},
+        ctx=ht.tpu(0), comm_mode=args.comm, cstable_policy=args.cache,
+        bsp=args.bsp, cache_bound=args.bound)
+
+    n_train = executor.get_batch_num("train")
+    n_val = executor.get_batch_num("validate")
+    for ep in range(args.nepoch):
+        t0 = time.time()
+        tr_loss, tr_acc, tr_auc = [], [], []
+        for _ in range(n_train):
+            loss_val, pred, y_val, _ = executor.run(
+                "train", convert_to_numpy_ret_vals=True)
+            tr_loss.append(loss_val)
+            tr_acc.append(accuracy(y_val, pred))
+            if y_val.shape[1] == 1:
+                try:
+                    tr_auc.append(ht_metrics.auc(y_val.ravel(), pred.ravel()))
+                except ValueError:
+                    pass
+        msg = (f"epoch {ep}: train loss {np.mean(tr_loss):.4f} "
+               f"acc {np.mean(tr_acc):.4f}")
+        if tr_auc:
+            msg += f" auc {np.mean(tr_auc):.4f}"
+        msg += f" time {time.time() - t0:.2f}s"
+        if args.val:
+            va_loss, va_acc = [], []
+            for _ in range(n_val):
+                loss_val, pred, y_val = executor.run(
+                    "validate", convert_to_numpy_ret_vals=True)
+                va_loss.append(loss_val)
+                va_acc.append(accuracy(y_val, pred))
+            msg += (f" | val loss {np.mean(va_loss):.4f} "
+                    f"acc {np.mean(va_acc):.4f}")
+        print(msg, flush=True)
+
+    if args.comm in ("PS", "Hybrid"):
+        ht.worker_finish()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Neural Collaborative Filtering (reference examples/rec/hetu_ncf.py):
+GMF (elementwise product of user/item factors) fused with an MLP tower over
+concatenated latents; one embedding table per side carries both."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def neural_mf(user_input, item_input, y_, num_users, num_items,
+              embed_dim=8, layers=(64, 32, 16, 8), learning_rate=0.01,
+              embed_stddev=0.01):
+    width = embed_dim + layers[0] // 2
+    user_table = init.random_normal((num_users, width), stddev=embed_stddev,
+                                    name="user_embed", is_embed=True,
+                                    ctx=ht.cpu(0))
+    item_table = init.random_normal((num_items, width), stddev=embed_stddev,
+                                    name="item_embed", is_embed=True,
+                                    ctx=ht.cpu(0))
+    user_latent = ht.array_reshape_op(
+        ht.embedding_lookup_op(user_table, user_input), (-1, width))
+    item_latent = ht.array_reshape_op(
+        ht.embedding_lookup_op(item_table, item_input), (-1, width))
+
+    mf_user = ht.slice_op(user_latent, (0, 0), (-1, embed_dim))
+    mlp_user = ht.slice_op(user_latent, (0, embed_dim), (-1, -1))
+    mf_item = ht.slice_op(item_latent, (0, 0), (-1, embed_dim))
+    mlp_item = ht.slice_op(item_latent, (0, embed_dim), (-1, -1))
+
+    mf_vector = ht.mul_op(mf_user, mf_item)
+    x = ht.concat_op(mlp_user, mlp_item, axis=1)
+    for i in range(len(layers) - 1):
+        w = init.random_normal((layers[i], layers[i + 1]), stddev=0.1,
+                               name=f"W{i + 1}")
+        x = ht.relu_op(ht.matmul_op(x, w))
+    w_out = init.random_normal((embed_dim + layers[-1], 1), stddev=0.1,
+                               name="W_out")
+    y = ht.sigmoid_op(ht.matmul_op(ht.concat_op(mf_vector, x, axis=1), w_out))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=learning_rate)
+    return loss, y, opt.minimize(loss)

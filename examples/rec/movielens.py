@@ -1,0 +1,37 @@
+"""MovieLens data pipeline for NCF (reference examples/rec/movielens.py).
+
+Without egress the loader synthesizes implicit-feedback triples with the
+ml-1m shape: (user, item, label) with 4 negatives per positive."""
+import os
+
+import numpy as np
+
+
+def getdata(dataset="ml-1m", path=None, num_users=600, num_items=1200,
+            n_pos=20000, num_negatives=4, seed=0):
+    if path and os.path.exists(path):
+        data = np.load(path)
+        return (data["users"], data["items"], data["labels"],
+                int(data["num_users"]), int(data["num_items"]))
+    rng = np.random.RandomState(seed)
+    # each user has a latent preference over items: positives are sampled
+    # from the top half of their preference ranking, so NCF can learn
+    u_pref = rng.randn(num_users, 8)
+    i_pref = rng.randn(num_items, 8)
+    scores = u_pref @ i_pref.T
+    users, items, labels = [], [], []
+    for _ in range(n_pos):
+        u = rng.randint(num_users)
+        pos_pool = np.argsort(-scores[u])[:num_items // 2]
+        items.append(pos_pool[rng.randint(len(pos_pool))])
+        users.append(u)
+        labels.append(1.0)
+        for _ in range(num_negatives):
+            users.append(u)
+            items.append(rng.randint(num_items))
+            labels.append(0.0)
+    users = np.asarray(users, np.float32).reshape(-1, 1)
+    items = np.asarray(items, np.float32).reshape(-1, 1)
+    labels = np.asarray(labels, np.float32).reshape(-1, 1)
+    perm = rng.permutation(len(users))
+    return users[perm], items[perm], labels[perm], num_users, num_items

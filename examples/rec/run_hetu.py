@@ -1,0 +1,61 @@
+"""NCF trainer on MovieLens (reference examples/rec/run_hetu.py).
+
+Local:  python run_hetu.py
+PS:     heturun -c cluster.yml python run_hetu.py --comm Hybrid
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_ncf import neural_mf  # noqa: E402
+from movielens import getdata  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--comm", default=None,
+                        choices=[None, "PS", "Hybrid", "AllReduce"])
+    parser.add_argument("--cache", default=None,
+                        choices=[None, "LRU", "LFU", "LFUOpt"])
+    parser.add_argument("--bsp", action="store_true")
+    parser.add_argument("--batch-size", type=int, default=1024)
+    parser.add_argument("--nepoch", type=int, default=1)
+    args = parser.parse_args()
+
+    if args.comm in ("PS", "Hybrid"):
+        ht.worker_init()
+
+    users, items, labels, num_users, num_items = getdata()
+    user_in = ht.dataloader_op([ht.Dataloader(users, args.batch_size, "train")])
+    item_in = ht.dataloader_op([ht.Dataloader(items, args.batch_size, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(labels, args.batch_size, "train")])
+    loss, y, train_op = neural_mf(user_in, item_in, y_, num_users, num_items)
+
+    executor = ht.Executor({"train": [loss, y, y_, train_op]},
+                           ctx=ht.tpu(0), comm_mode=args.comm,
+                           cstable_policy=args.cache, bsp=args.bsp)
+    n_batches = executor.get_batch_num("train")
+    for ep in range(args.nepoch):
+        t0 = time.time()
+        losses, accs = [], []
+        for _ in range(n_batches):
+            loss_val, pred, y_val, _ = executor.run(
+                "train", convert_to_numpy_ret_vals=True)
+            losses.append(loss_val)
+            accs.append(np.equal(y_val, pred > 0.5).astype(np.float32).mean())
+        print(f"epoch {ep}: loss {np.mean(losses):.4f} "
+              f"acc {np.mean(accs):.4f} time {time.time() - t0:.2f}s",
+              flush=True)
+
+    if args.comm in ("PS", "Hybrid"):
+        ht.worker_finish()
+
+
+if __name__ == "__main__":
+    main()

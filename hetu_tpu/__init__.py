@@ -27,6 +27,10 @@ from .ndarray import (
     cpu, gpu, tpu, rcpu, rgpu, rtpu, array, sparse_array, empty,
     is_gpu_ctx, is_tpu_ctx, NDArray, ND_Sparse_Array, IndexedSlices, DLContext,
 )
+from .cstable import CacheSparseTable
+# re-bind the real PS package: `from .graph.ops import *` above leaks the
+# graph-level ops.ps MODULE under the name `ps`, shadowing hetu_tpu.ps
+from . import ps
 from . import optimizer as optim
 from . import lr_scheduler as lr
 from . import initializers as init

@@ -14,15 +14,13 @@ import sys
 _CSRC = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_CSRC, "build")
 
+# One library: the cache shares the PS worker agent's process globals
+# (the reference links hetu_cache against ps-lite the same way).
 _TARGETS = {
     "libhetu_ps.so": {
-        "srcs": ["ps/capi.cc"],
+        "srcs": ["ps/capi.cc", "cache/cache_capi.cc"],
         "deps": ["ps/net.h", "ps/store.h", "ps/server.h", "ps/scheduler.h",
-                 "ps/worker.h"],
-    },
-    "libhetu_cache.so": {
-        "srcs": ["cache/cache_capi.cc"],
-        "deps": ["cache/cache.h", "ps/net.h", "ps/store.h", "ps/worker.h"],
+                 "ps/worker.h", "cache/cache.h"],
     },
 }
 

@@ -62,6 +62,11 @@ hetups::PsWorker& worker() {
 
 }  // namespace
 
+namespace hetups {
+// Shared with the embedding cache (cache/cache_capi.cc).
+PsWorker* global_worker() { return g_worker.get(); }
+}  // namespace hetups
+
 extern "C" {
 
 // Returns-and-clears: the caller observes each failure once.
@@ -190,6 +195,17 @@ void SSPushPull(int node, const long* in_idx, const float* vals,
     worker().ss_pushpull(node, reinterpret_cast<const int64_t*>(in_idx), vals,
                           reinterpret_cast<const int64_t*>(out_idx), out,
                           static_cast<size_t>(nidx));
+  });
+}
+
+void AssignDense(int node, const float* data, long len) {
+  guard([&] { worker().assign_dense(node, data, static_cast<size_t>(len)); });
+}
+
+void AssignRows(int node, const long* idx, const float* vals, long nidx) {
+  guard([&] {
+    worker().assign_rows(node, reinterpret_cast<const int64_t*>(idx), vals,
+                         static_cast<size_t>(nidx));
   });
 }
 

@@ -50,6 +50,8 @@ enum class PsfType : int32_t {
   kParamClear = 31,
   kParamSave = 32,
   kParamLoad = 33,
+  kParamAssign = 34,       // raw value assignment (init push, no optimizer)
+  kParamAssignRows = 35,
   // bounded-staleness cache table (reference ps-lite psf/cachetable.h:22-43)
   kSyncEmbedding = 40,
   kPushEmbedding = 41,

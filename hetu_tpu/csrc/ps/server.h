@@ -133,7 +133,7 @@ class PsServer {
                     seed + static_cast<uint64_t>(rank_) * 0x9e3779b9u);
         alloc_slots(*p);
         if (p->kind == ParamKind::kCacheTable)
-          p->versions.assign(p->rows, 1);  // version 0 = "never seen" client-side
+          p->versions.assign(p->rows, 0);
         break;
       }
       case PsfType::kDensePush: {
@@ -225,6 +225,30 @@ class PsServer {
         rsp->args.push_back(Arg::f32(out.data(), out.size()));
         break;
       }
+      case PsfType::kParamAssign: {
+        // raw overwrite of this shard (host-side initializers push values
+        // through here so server optimizers never see them as gradients)
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        if (req.args[0].n_f32() != p->data.size())
+          throw std::runtime_error("ParamAssign size mismatch");
+        std::memcpy(p->data.data(), req.args[0].as_f32(),
+                    p->data.size() * 4);
+        break;
+      }
+      case PsfType::kParamAssignRows: {
+        Param* p = store_.get(key);
+        check(p, key);
+        std::unique_lock<std::shared_mutex> g(p->mu);
+        const int64_t* idx = req.args[0].as_i64();
+        size_t nidx = req.args[0].n_i64();
+        const float* vals = req.args[1].as_f32();
+        for (size_t i = 0; i < nidx; ++i)
+          std::memcpy(p->data.data() + static_cast<size_t>(idx[i]) * p->width,
+                      vals + i * p->width, p->width * 4);
+        break;
+      }
       case PsfType::kParamClear: {
         Param* p = store_.get(key);
         if (!p) break;
@@ -233,7 +257,7 @@ class PsServer {
         std::fill(p->accum.begin(), p->accum.end(), 0.0f);
         std::fill(p->accum2.begin(), p->accum2.end(), 0.0f);
         p->step = 0;
-        if (!p->versions.empty()) std::fill(p->versions.begin(), p->versions.end(), 1);
+        if (!p->versions.empty()) std::fill(p->versions.begin(), p->versions.end(), 0);
         break;
       }
       case PsfType::kParamSave: {
@@ -270,23 +294,23 @@ class PsServer {
         break;
       }
       case PsfType::kSyncEmbedding: {
-        // Bounded-staleness pull (reference hetu_client.cc:6-37 + PSFHandle
-        // cachetable: return only rows whose server version exceeds the
-        // client's version + bound).
-        // args: i64 local rows, u64 client versions, u64[bound]
+        // Bounded-staleness pull (reference PSFhandle_embedding.cc:30-65):
+        // return rows never seen by the client (cver == -1) or whose server
+        // version ran more than `bound` updates ahead of the client's.
+        // args: i64 local rows, i64 client versions, i64[bound]
         Param* p = store_.get(key);
         check(p, key);
         std::shared_lock<std::shared_mutex> g(p->mu);
         const int64_t* idx = req.args[0].as_i64();
-        const uint64_t* cver = req.args[1].as_u64();
-        uint64_t bound = req.args[2].as_u64()[0];
+        const int64_t* cver = req.args[1].as_i64();
+        int64_t bound = req.args[2].as_i64()[0];
         size_t nidx = req.args[0].n_i64();
         std::vector<int32_t> sel;
         std::vector<float> rows;
-        std::vector<uint64_t> vers;
+        std::vector<int64_t> vers;
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
-          if (p->versions[r] > cver[i] + bound) {
+          if (cver[i] == -1 || p->versions[r] - cver[i] > bound) {
             sel.push_back(static_cast<int32_t>(i));
             rows.insert(rows.end(), p->data.begin() + r * p->width,
                         p->data.begin() + (r + 1) * p->width);
@@ -295,11 +319,12 @@ class PsServer {
         }
         rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
         rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
-        rsp->args.push_back(Arg::u64(vers.data(), vers.size()));
+        rsp->args.push_back(Arg::i64(vers.data(), vers.size()));
         break;
       }
       case PsfType::kPushEmbedding: {
-        // args: i64 local rows, f32 grads, u64 per-row update counts
+        // args: i64 local rows, f32 grads, i64 per-row update counts
+        // (reference PSFhandle_embedding.cc:5-28: accumulate + ver += updates)
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
@@ -307,11 +332,11 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         const float* grads = req.args[1].as_f32();
-        const uint64_t* ups = req.args[2].as_u64();
+        const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
-          p->versions[r] += ups[i];  // reference optimizer.h:63-75 ApplyCache
+          p->versions[r] += ups[i];
         }
         break;
       }
@@ -325,22 +350,22 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         const float* grads = req.args[1].as_f32();
-        const uint64_t* ups = req.args[2].as_u64();
+        const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
           p->versions[r] += ups[i];
         }
         const int64_t* sidx = req.args[3].as_i64();
-        const uint64_t* cver = req.args[4].as_u64();
-        uint64_t bound = req.args[5].as_u64()[0];
+        const int64_t* cver = req.args[4].as_i64();
+        int64_t bound = req.args[5].as_i64()[0];
         size_t ns = req.args[3].n_i64();
         std::vector<int32_t> sel;
         std::vector<float> rows;
-        std::vector<uint64_t> vers;
+        std::vector<int64_t> vers;
         for (size_t i = 0; i < ns; ++i) {
           size_t r = static_cast<size_t>(sidx[i]);
-          if (p->versions[r] > cver[i] + bound) {
+          if (cver[i] == -1 || p->versions[r] - cver[i] > bound) {
             sel.push_back(static_cast<int32_t>(i));
             rows.insert(rows.end(), p->data.begin() + r * p->width,
                         p->data.begin() + (r + 1) * p->width);
@@ -349,7 +374,7 @@ class PsServer {
         }
         rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
         rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
-        rsp->args.push_back(Arg::u64(vers.data(), vers.size()));
+        rsp->args.push_back(Arg::i64(vers.data(), vers.size()));
         break;
       }
       case PsfType::kDataPush: {

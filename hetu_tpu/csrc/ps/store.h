@@ -44,8 +44,10 @@ struct Param {
   std::vector<float> accum2;  // adam v
   uint64_t step = 0;          // adam bias-correction step
 
-  // cache-table row versions (reference embedding.h:19-40 Line::version)
-  std::vector<uint64_t> versions;
+  // cache-table row versions (reference embedding.h:19-40 Line::version);
+  // signed: the CLIENT uses -1 as the "never synced, always pull" sentinel
+  // (reference PSFhandle_embedding.cc:49); server rows start at 0
+  std::vector<int64_t> versions;
 
   mutable std::shared_mutex mu;
 };

@@ -455,35 +455,78 @@ class PsWorker {
     sparse_pull(key, pull_keys, out, n);
   }
 
-  // -- cache-table ops (used by the C++ embedding cache) ------------------
-  // Returns rows of `keys` whose server version > client version + bound.
-  // out_* are filled synchronously (callers run on the cache's own threads).
-  void sync_embedding(int32_t key, const int64_t* keys, const uint64_t* cvers,
-                      size_t n, uint64_t bound, std::vector<size_t>* out_pos,
-                      std::vector<float>* out_rows,
-                      std::vector<uint64_t>* out_vers) {
+  // -- raw assignment (host-side init values; reference initializers push
+  // through InitTensor's server-side init — here explicit values bypass the
+  // optimizer entirely) -------------------------------------------------
+  void assign_dense(int32_t key, const float* data, size_t len) {
+    auto m = meta(key);
+    check_len(m, key, len);
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      auto [lo, hi] = (m.kind == ParamKind::kDense)
+                          ? dense_range(m.len, s)
+                          : std::pair<size_t, size_t>(
+                                row_range(m.rows, s).first * m.width,
+                                row_range(m.rows, s).second * m.width);
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamAssign);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::f32(data + lo, hi - lo));
+      rpc(s, req);
+    }
+  }
+
+  void assign_rows(int32_t key, const int64_t* keys, const float* vals,
+                   size_t n) {
     auto m = meta(key);
     auto sk = shard_rows(m, keys, n);
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      const auto& loc = sk.local[s];
+      if (loc.empty()) continue;
+      std::vector<float> shard_vals(loc.size() * m.width);
+      for (size_t i = 0; i < loc.size(); ++i)
+        std::memcpy(shard_vals.data() + i * m.width,
+                    vals + sk.positions[s][i] * m.width, m.width * 4);
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kParamAssignRows);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::i64(loc.data(), loc.size()));
+      req.args.push_back(Arg::f32(shard_vals.data(), shard_vals.size()));
+      rpc(s, req);
+    }
+  }
+
+  // -- cache-table ops (used by the C++ embedding cache) ------------------
+  // Bounded-staleness pull (reference hetu_client.cc:6-37): returns rows of
+  // `keys` the client has never seen (cver == -1) or whose server version ran
+  // more than `bound` updates ahead. out_* are filled synchronously (callers
+  // run on the cache's own worker thread).
+  void sync_embedding(int32_t key, const uint64_t* keys, const int64_t* cvers,
+                      size_t n, int64_t bound, std::vector<size_t>* out_pos,
+                      std::vector<float>* out_rows,
+                      std::vector<int64_t>* out_vers) {
+    auto m = meta(key);
+    std::vector<int64_t> ikeys(keys, keys + n);
+    auto sk = shard_rows(m, ikeys.data(), n);
     out_pos->clear();
     out_rows->clear();
     out_vers->clear();
     for (size_t s = 0; s < servers_.size(); ++s) {
       const auto& loc = sk.local[s];
       if (loc.empty()) continue;
-      std::vector<uint64_t> shard_vers(loc.size());
+      std::vector<int64_t> shard_vers(loc.size());
       for (size_t i = 0; i < loc.size(); ++i)
         shard_vers[i] = cvers[sk.positions[s][i]];
       Message req;
       req.head.type = static_cast<int32_t>(PsfType::kSyncEmbedding);
       req.head.tensor_id = key;
       req.args.push_back(Arg::i64(loc.data(), loc.size()));
-      req.args.push_back(Arg::u64(shard_vers.data(), shard_vers.size()));
-      req.args.push_back(Arg::u64(&bound, 1));
+      req.args.push_back(Arg::i64(shard_vers.data(), shard_vers.size()));
+      req.args.push_back(Arg::i64(&bound, 1));
       Message rsp = rpc(s, req);
       const int32_t* sel = rsp.args[0].as_i32();
       size_t nsel = rsp.args[0].size() / 4;
       const float* rows = rsp.args[1].as_f32();
-      const uint64_t* vers = rsp.args[2].as_u64();
+      const int64_t* vers = rsp.args[2].as_i64();
       for (size_t i = 0; i < nsel; ++i) {
         out_pos->push_back(sk.positions[s][sel[i]]);
         out_rows->insert(out_rows->end(), rows + i * m.width,
@@ -494,15 +537,16 @@ class PsWorker {
     }
   }
 
-  void push_embedding(int32_t key, const int64_t* keys, const float* grads,
-                      const uint64_t* updates, size_t n) {
+  void push_embedding(int32_t key, const uint64_t* keys, const float* grads,
+                      const int64_t* updates, size_t n) {
     auto m = meta(key);
-    auto sk = shard_rows(m, keys, n);
+    std::vector<int64_t> ikeys(keys, keys + n);
+    auto sk = shard_rows(m, ikeys.data(), n);
     for (size_t s = 0; s < servers_.size(); ++s) {
       const auto& loc = sk.local[s];
       if (loc.empty()) continue;
       std::vector<float> shard_grads(loc.size() * m.width);
-      std::vector<uint64_t> shard_ups(loc.size());
+      std::vector<int64_t> shard_ups(loc.size());
       for (size_t i = 0; i < loc.size(); ++i) {
         std::memcpy(shard_grads.data() + i * m.width,
                     grads + sk.positions[s][i] * m.width, m.width * 4);
@@ -513,9 +557,64 @@ class PsWorker {
       req.head.tensor_id = key;
       req.args.push_back(Arg::i64(loc.data(), loc.size()));
       req.args.push_back(Arg::f32(shard_grads.data(), shard_grads.size()));
-      req.args.push_back(Arg::u64(shard_ups.data(), shard_ups.size()));
+      req.args.push_back(Arg::i64(shard_ups.data(), shard_ups.size()));
       rpc(s, req);
       record("push_embedding", shard_grads.size() * 4);
+    }
+  }
+
+  // Combined push+sync in ONE round trip per server (reference
+  // kPushSyncEmbedding, PSFhandle_embedding.cc:67-81).
+  void push_sync_embedding(int32_t key, const uint64_t* push_keys,
+                           const float* grads, const int64_t* updates,
+                           size_t n_push, const uint64_t* sync_keys,
+                           const int64_t* cvers, size_t n_sync, int64_t bound,
+                           std::vector<size_t>* out_pos,
+                           std::vector<float>* out_rows,
+                           std::vector<int64_t>* out_vers) {
+    auto m = meta(key);
+    std::vector<int64_t> ipush(push_keys, push_keys + n_push);
+    std::vector<int64_t> isync(sync_keys, sync_keys + n_sync);
+    auto skp = shard_rows(m, ipush.data(), n_push);
+    auto sks = shard_rows(m, isync.data(), n_sync);
+    out_pos->clear();
+    out_rows->clear();
+    out_vers->clear();
+    for (size_t s = 0; s < servers_.size(); ++s) {
+      const auto& locp = skp.local[s];
+      const auto& locs = sks.local[s];
+      if (locp.empty() && locs.empty()) continue;
+      std::vector<float> shard_grads(locp.size() * m.width);
+      std::vector<int64_t> shard_ups(locp.size());
+      for (size_t i = 0; i < locp.size(); ++i) {
+        std::memcpy(shard_grads.data() + i * m.width,
+                    grads + skp.positions[s][i] * m.width, m.width * 4);
+        shard_ups[i] = updates[skp.positions[s][i]];
+      }
+      std::vector<int64_t> shard_vers(locs.size());
+      for (size_t i = 0; i < locs.size(); ++i)
+        shard_vers[i] = cvers[sks.positions[s][i]];
+      Message req;
+      req.head.type = static_cast<int32_t>(PsfType::kPushSyncEmbedding);
+      req.head.tensor_id = key;
+      req.args.push_back(Arg::i64(locp.data(), locp.size()));
+      req.args.push_back(Arg::f32(shard_grads.data(), shard_grads.size()));
+      req.args.push_back(Arg::i64(shard_ups.data(), shard_ups.size()));
+      req.args.push_back(Arg::i64(locs.data(), locs.size()));
+      req.args.push_back(Arg::i64(shard_vers.data(), shard_vers.size()));
+      req.args.push_back(Arg::i64(&bound, 1));
+      Message rsp = rpc(s, req);
+      const int32_t* sel = rsp.args[0].as_i32();
+      size_t nsel = rsp.args[0].size() / 4;
+      const float* rows = rsp.args[1].as_f32();
+      const int64_t* vers = rsp.args[2].as_i64();
+      for (size_t i = 0; i < nsel; ++i) {
+        out_pos->push_back(sks.positions[s][sel[i]]);
+        out_rows->insert(out_rows->end(), rows + i * m.width,
+                         rows + (i + 1) * m.width);
+        out_vers->push_back(vers[i]);
+      }
+      record("push_sync_embedding", (shard_grads.size() + nsel * m.width) * 4);
     }
   }
 

@@ -39,6 +39,7 @@ from .ops.comm import AllReduceCommunicateOp, DispatchOp, PipelineSendOp, Pipeli
 from .ops.ps import ParameterServerCommunicateOp
 
 _NO_OUTPUT = "<no-output>"
+_PS_RESIDENT = "<ps-resident-parameter>"
 
 
 class HetuConfig:
@@ -121,6 +122,7 @@ class TraceContext:
         self.op_state_updates: dict[int, Any] = {}
         self.param_updates: dict[int, Any] = {}
         self.slot_updates: dict[int, Any] = {}
+        self.ps_grad_outputs: dict[int, Any] = {}
         self.grad_cache: dict[int, dict[int, Any]] = {}
         self._in_grad_retrace = False
 
@@ -156,10 +158,16 @@ class TraceContext:
         raise NotImplementedError("pipeline ops require the pipeline executor")
 
     def ps_push_pull(self, op, grad):
-        raise NotImplementedError("PS ops require comm_mode='PS'/'Hybrid' runtime")
+        """PS comm op inside the trace: capture the gradient as an extra
+        program output; the host pushes it to the server post-step (the
+        reference instead issues the RPC from the interpreter on the d2h
+        stream, ParameterServerCommunicate.py:38-50)."""
+        self.ps_grad_outputs[id(op)] = grad
+        return None
 
     def ps_sparse_pull(self, op, vals):
-        raise NotImplementedError("PS ops require comm_mode='PS'/'Hybrid' runtime")
+        raise AssertionError(
+            "ParameterServerSparsePullOp values are staged by the executor")
 
     # -- autodiff ----------------------------------------------------------
     def gradient_of(self, gctx: GradientContext, x: Op):
@@ -202,6 +210,11 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
     if id(node) in env:
         return
     input_vals = [env[id(i)] for i in node.inputs]
+    if any(v is _PS_RESIDENT for v in input_vals):
+        raise ValueError(
+            f"{node.name} reads a PS-resident embedding table directly; only "
+            "embedding_lookup_op / parameterServerSparsePull_op may touch "
+            "PS-hosted tables (their rows are staged by the executor)")
     if node.stateful:
         state_in = tc.op_state_in[id(node)]
         out, new_state = node.compute_stateful(input_vals, state_in, tc)
@@ -230,6 +243,35 @@ class SubExecutor:
         self.optimizer_nodes = [n for n in self.topo if n.is_optimizer]
         self._compiled: dict[tuple, Any] = {}
 
+        # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
+        ps = executor.ps_runtime
+        self.ps_staged_ops = []    # lookup/sparse-pull ops fed by host pulls
+        self.ps_sparse_vars = []   # PS-resident tables appearing in the topo
+        self.ps_dense_vars = []    # PS-hosted dense params fed per step
+        self.ps_comm_ops = []      # gradient push ops, in topo order
+        if ps is not None:
+            for n in self.topo:
+                embed = getattr(n, "embed_node", None)
+                if embed is not None and id(embed) in ps.params \
+                        and ps.params[id(embed)].sparse:
+                    self.ps_staged_ops.append(n)
+                if isinstance(n, ParameterServerCommunicateOp) \
+                        and getattr(n, "ps_param_node", None) is not None:
+                    self.ps_comm_ops.append(n)
+                if n.is_placeholder and id(n) in ps.params:
+                    if ps.params[id(n)].sparse:
+                        self.ps_sparse_vars.append(n)
+                    else:
+                        self.ps_dense_vars.append(n)
+            for op in self.ps_staged_ops:
+                idx_node = op.inputs[1]
+                if not (idx_node in self.feed_nodes
+                        or idx_node in self.dataloader_nodes):
+                    raise ValueError(
+                        f"PS-hosted lookup {op.name!r}: the index input "
+                        f"{idx_node.name!r} must be a feed or dataloader "
+                        "node (its value is needed host-side to pull rows)")
+
     # ------------------------------------------------------------------
     def _signature(self, feed_vals, batch_vals):
         def sig(v):
@@ -244,6 +286,17 @@ class SubExecutor:
         return (tuple(sig(v) for v in feed_vals),
                 tuple(sig(v) for v in batch_vals), opt_tokens)
 
+    def _host_value(self, node, feed_dict, batch_host):
+        """Host-side numpy value of a feed/dataloader node (pre device_put)."""
+        if node in feed_dict:
+            v = feed_dict[node]
+            if hasattr(v, "asnumpy"):
+                v = v.asnumpy()
+            return np.asarray(v)
+        if id(node) in batch_host:
+            return batch_host[id(node)]
+        raise ValueError(f"no host value for {node.name!r}")
+
     def _build(self):
         ex = self.executor
         param_nodes = ex.param_nodes
@@ -256,13 +309,27 @@ class SubExecutor:
         opt_nodes = self.optimizer_nodes
         config = self.config
 
-        def step_fn(params_t, slots_t, opstate_t, rng, step, feeds_t, batches_t):
+        ps_staged_ops = self.ps_staged_ops
+        ps_sparse_vars = self.ps_sparse_vars
+        ps_dense_vars = self.ps_dense_vars
+        ps_comm_ops = self.ps_comm_ops
+
+        def step_fn(params_t, slots_t, opstate_t, rng, step, feeds_t, batches_t,
+                    ps_staged_t, ps_dense_t):
             env: dict[int, Any] = {}
             for node, val in zip(param_nodes, params_t):
                 env[id(node)] = val
             for node, val in zip(feed_nodes, feeds_t):
                 env[id(node)] = val
             for node, val in zip(dl_nodes, batches_t):
+                env[id(node)] = val
+            # PS-resident embeddings: staged rows stand in for the lookup
+            # output; the table itself never exists on device
+            for node, val in zip(ps_staged_ops, ps_staged_t):
+                env[id(node)] = val
+            for node in ps_sparse_vars:
+                env[id(node)] = _PS_RESIDENT
+            for node, val in zip(ps_dense_vars, ps_dense_t):
                 env[id(node)] = val
             op_state_in = {id(n): s for n, s in zip(stateful_nodes, opstate_t)}
             tc = TraceContext(config, topo, training, env, rng, step, op_state_in)
@@ -278,7 +345,8 @@ class SubExecutor:
                     continue
                 _eval_node(node, env, tc)
             outputs = tuple(
-                jnp.zeros(()) if env[id(n)] is _NO_OUTPUT else env[id(n)]
+                jnp.zeros(()) if (env[id(n)] is _NO_OUTPUT or env[id(n)] is None)
+                else env[id(n)]
                 for n in eval_nodes)
             new_params = tuple(tc.param_updates.get(id(n), env[id(n)])
                                for n in param_nodes)
@@ -286,7 +354,8 @@ class SubExecutor:
                               for n in opt_nodes)
             new_opstate = tuple(tc.op_state_updates.get(id(n), op_state_in[id(n)])
                                 for n in stateful_nodes)
-            return outputs, new_params, new_slots, new_opstate
+            ps_grads = tuple(tc.ps_grad_outputs[id(op)] for op in ps_comm_ops)
+            return outputs, new_params, new_slots, new_opstate, ps_grads
 
         donate = (0, 1, 2) if training else ()
         return jax.jit(step_fn, donate_argnums=donate)
@@ -301,10 +370,25 @@ class SubExecutor:
             if node not in feed_dict:
                 raise ValueError(f"Missing feed for placeholder {node.name!r}")
             feed_vals.append(ex._prepare_input(feed_dict[node]))
-        batch_vals = [ex._prepare_input(n.get_batch(self.name))
+        batch_host = {id(n): np.asarray(n.get_batch(self.name))
+                      for n in self.dataloader_nodes}
+        batch_vals = [ex._prepare_input(batch_host[id(n)])
                       for n in self.dataloader_nodes]
 
-        key = self._signature(feed_vals, batch_vals)
+        # -- PS pre-step: pull this batch's embedding rows ------------------
+        ps = ex.ps_runtime
+        staged_idx: dict[int, np.ndarray] = {}
+        ps_staged_vals = []
+        for op in self.ps_staged_ops:
+            idx = self._host_value(op.inputs[1], feed_dict, batch_host)
+            staged_idx[id(op)] = idx
+            rows = ps.stage_lookup(ps.params[id(op.embed_node)], idx)
+            ps_staged_vals.append(ex._prepare_input(rows))
+        ps_dense_vals = [ex._prepare_input(ps.params[id(n)].host_value)
+                         for n in self.ps_dense_vars]
+
+        key = self._signature(feed_vals, batch_vals) + (
+            tuple(tuple(v.shape) for v in ps_staged_vals),)
         fn = self._compiled.get(key)
         if fn is None:
             fn = self._build()
@@ -316,9 +400,17 @@ class SubExecutor:
         step = ex.state["step"]
         rng = jax.random.fold_in(ex.rng_root, step)
 
-        outputs, new_params, new_slots, new_opstate = fn(
+        outputs, new_params, new_slots, new_opstate, ps_grads = fn(
             params_t, slots_t, opstate_t, rng, jnp.asarray(step, jnp.int32),
-            tuple(feed_vals), tuple(batch_vals))
+            tuple(feed_vals), tuple(batch_vals), tuple(ps_staged_vals),
+            tuple(ps_dense_vals))
+
+        # -- PS post-step: push gradients (reference push/pull, ASP/BSP) ----
+        for op, grad in zip(self.ps_comm_ops, ps_grads):
+            p = ps.params[id(op.ps_param_node)]
+            idx = (staged_idx[id(op.staged_lookup)]
+                   if getattr(op, "staged_lookup", None) is not None else None)
+            ps.push_grad(p, np.asarray(grad), idx, step=step)
 
         if self.training:
             for node, val in zip(ex.param_nodes, new_params):
@@ -363,6 +455,14 @@ class Executor:
         self.comm_mode = config.comm_mode
 
         full_topo = find_topo_sort(all_nodes)
+        # any variable read through an embedding lookup is a sparse embedding
+        # for comm-strategy purposes (keeps insert_comm_ops and PSRuntime's
+        # classification in agreement)
+        if config.comm_mode in ("PS", "Hybrid"):
+            for node in full_topo:
+                embed = getattr(node, "embed_node", None)
+                if embed is not None and getattr(embed, "trainable", False):
+                    embed.is_embed = True
         # comm-op insertion (the reference's OptimizerOp.backward_hook,
         # optimizer.py:125-139) — rewrite optimizer grad inputs per strategy.
         for node in full_topo:
@@ -370,8 +470,18 @@ class Executor:
                 node.insert_comm_ops(config)
         full_topo = find_topo_sort(all_nodes)
 
+        # -- PS/Hybrid runtime (reference ParameterServerCommunicate.py) ----
+        self.ps_runtime = None
+        if config.comm_mode in ("PS", "Hybrid"):
+            from .ps_runtime import PSRuntime
+            self.ps_runtime = PSRuntime(config, full_topo)
+            self._rewire_ps_gradients(full_topo)
+
+        ps_resident = (set(self.ps_runtime.params.keys())
+                       if self.ps_runtime else set())
         self.param_nodes = [n for n in full_topo
-                            if n.is_placeholder and not getattr(n, "is_feed", True)]
+                            if n.is_placeholder and not getattr(n, "is_feed", True)
+                            and id(n) not in ps_resident]
         self.rng_root = jax.random.PRNGKey(config.seed)
 
         # -- parameter initialization (reference initializers.py) ----------
@@ -393,8 +503,10 @@ class Executor:
         op_state = {}
         for node in full_topo:
             if node.is_optimizer:
+                # PS-resident params keep their optimizer state server-side
                 slots[id(node)] = node.init_slots(
-                    {id(v): params[id(v)] for v in node.vars})
+                    {id(v): params[id(v)] for v in node.vars
+                     if id(v) in params})
             if node.stateful:
                 op_state[id(node)] = jax.tree.map(jnp.asarray, node.state_init())
         self.state = {"params": params, "slots": slots, "op_state": op_state,
@@ -406,6 +518,38 @@ class Executor:
         }
 
     # ------------------------------------------------------------------
+    def _rewire_ps_gradients(self, topo):
+        """Point each PS comm op's gradient at the lookup OUTPUT rather than
+        the table variable, so the traced grad is (batch_rows, width) instead
+        of a full-table scatter (the reference's IndexedSlices analogue)."""
+        for node in topo:
+            if not isinstance(node, ParameterServerCommunicateOp):
+                continue
+            grad_node = node.inputs[0]
+            if not getattr(grad_node, "is_gradient", False):
+                continue
+            var = grad_node.x
+            p = self.ps_runtime.params.get(id(var))
+            if p is None:
+                continue
+            node.ps_param_node = var
+            if not p.sparse:
+                continue  # dense PS params are fed whole; grad wrt var is fine
+            if len(p.lookup_ops) != 1:
+                raise NotImplementedError(
+                    f"PS-hosted embedding {var.name!r} feeds "
+                    f"{len(p.lookup_ops)} lookup ops; exactly one is "
+                    "supported per table (split the table or share the "
+                    "lookup node)")
+            lookup = p.lookup_ops[0]
+            node.staged_lookup = lookup
+            grad_node.x = lookup
+            grad_node.inputs = [grad_node.gctx.loss, lookup]
+            xs = grad_node.gctx.xs
+            for i, x in enumerate(xs):
+                if x is var:
+                    xs[i] = lookup
+
     def _prepare_input(self, value):
         if isinstance(value, NDArray):
             value = value.handle
@@ -451,6 +595,8 @@ class Executor:
     # -- checkpoint (reference executor.py:355-413; adds optimizer state) ---
     def save(self, file_path: str):
         os.makedirs(file_path, exist_ok=True)
+        if self.ps_runtime is not None:
+            self.ps_runtime.save(file_path)
         for node, fname in zip(self.param_nodes, self._param_file_names()):
             np.save(os.path.join(file_path, fname + ".npy"),
                     np.asarray(self.state["params"][id(node)]))
@@ -465,6 +611,8 @@ class Executor:
             pickle.dump(aux, f)
 
     def load(self, file_path: str):
+        if self.ps_runtime is not None:
+            self.ps_runtime.load(file_path)
         for node, fname in zip(self.param_nodes, self._param_file_names()):
             path = os.path.join(file_path, fname + ".npy")
             if os.path.exists(path):
@@ -508,8 +656,17 @@ class Executor:
         return out
 
     def fetch_dense_parameter_value(self, nodes):
-        """Reference executor.py:1236 — current parameter values."""
-        return [NDArray(self.state["params"][id(n)]) for n in nodes]
+        """Reference executor.py:1236 — current parameter values (PS-hosted
+        dense params are pulled from the server)."""
+        out = []
+        for n in nodes:
+            p = (self.ps_runtime.params.get(id(n))
+                 if self.ps_runtime is not None else None)
+            if p is not None:
+                out.append(NDArray(self.ps_runtime.pull_dense_value(p)))
+            else:
+                out.append(NDArray(self.state["params"][id(n)]))
+        return out
 
 
 # ---------------------------------------------------------------------------

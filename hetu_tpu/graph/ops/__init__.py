@@ -50,3 +50,10 @@ from .ps import (
     ParameterServerCommunicateOp, ParameterServerSparsePullOp,
 )
 from ..node import Variable, placeholder_op, Op, PlaceholderOp, find_topo_sort
+
+# star-export only the op API, not the submodules themselves (the `ps`
+# submodule would otherwise shadow the top-level hetu_tpu.ps package)
+import types as _types
+
+__all__ = [_k for _k, _v in list(globals().items())
+           if not _k.startswith("_") and not isinstance(_v, _types.ModuleType)]

@@ -38,6 +38,7 @@ class ParameterServerSparsePullOp(Op):
 
     def __init__(self, node_embed, node_index, ctx=None):
         super().__init__([node_embed, node_index], ctx)
+        self.embed_node = node_embed  # staged like embedding_lookup_op
 
     def compute(self, input_vals, tc):
         return tc.ps_sparse_pull(self, input_vals)

@@ -1,0 +1,284 @@
+"""PS/Hybrid execution: bridges the jitted XLA step to the host-resident
+parameter server.
+
+Reference behavior being matched (``gpu_ops/ParameterServerCommunicate.py``,
+``EmbeddingLookUp.py``):
+  - sparse embedding tables live on the PS, never on the accelerator; each
+    step pulls only the batch's rows (SparsePull / cache lookup, forward_hook
+    :122-231) and pushes only their gradients (SSPushPull / cache push-pull)
+  - dense params under comm_mode='PS' live on the PS; workers push lr-scaled
+    gradients and pull fresh values (DDPushPull, worker-side ``_mult_lr``
+    :24-25, :52-60)
+  - ASP by default; BSP adds a worker barrier per step (:42-46)
+  - optional bounded-staleness client cache (``cstable_policy``)
+
+TPU-native redesign: the reference interleaves PS RPCs *inside* the op
+interpreter via a d2h stream + events. Here the jitted step is a pure XLA
+program; PS traffic happens at its boundary:
+  - pre-step (host): pull batch rows for every PS-hosted embedding lookup,
+    feed them as extra inputs
+  - in-trace: the lookup op returns the staged rows; gradient nodes are
+    rewired from the table variable to the lookup output, so the grad leaves
+    the program as a (batch_rows, width) tensor, not a full-table scatter
+  - post-step (host): push row gradients (and dense grads) to the PS
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .node import Op, PlaceholderOp, find_topo_sort
+from .ops.ps import ParameterServerCommunicateOp, ParameterServerSparsePullOp
+
+
+_INIT_SPEC_BY_CLASS = {
+    # initializer class name -> (ps init_type, (a_attr, b_attr))
+    "ConstantInit": ("constant", ("constant", None)),
+    "ZerosInit": ("constant", ("constant", None)),
+    "OnesInit": ("constant", ("constant", None)),
+    "UniformInit": ("uniform", ("low", "high")),
+    "NormalInit": ("normal", ("mean", "stddev")),
+    "TruncatedNormalInit": ("truncated_normal", ("mean", "stddev")),
+}
+
+
+def _ps_init_spec(node: PlaceholderOp):
+    """Map a Variable's initializer onto the server-side init RPC
+    (reference initializers.py:28-39 init_on_ps). Returns None when the value
+    must be computed host-side and pushed instead (e.g. Xavier variants)."""
+    init = node.initializer
+    if init is None:
+        return None
+    spec = _INIT_SPEC_BY_CLASS.get(type(init).__name__)
+    if spec is None:
+        return None
+    itype, (a_attr, b_attr) = spec
+    a = float(getattr(init, a_attr, 0.0)) if a_attr else 0.0
+    b = float(getattr(init, b_attr, 1.0)) if b_attr else 1.0
+    return itype, a, b
+
+
+class PSParam:
+    """One PS-hosted parameter."""
+
+    def __init__(self, node: PlaceholderOp, ps_id: int, sparse: bool):
+        self.node = node
+        self.ps_id = ps_id
+        self.sparse = sparse
+        self.shape = tuple(node.shape)
+        self.cache = None            # CacheSparseTable when cstable_policy set
+        self.lookup_ops: list[Op] = []
+        self.host_value: Optional[np.ndarray] = None  # dense params only
+
+
+class PSRuntime:
+    """Owns the PS-hosted parameters of one Executor."""
+
+    def __init__(self, config, topo: list[Op]):
+        import os
+        from .. import ps as ps_pkg
+        self.config = config
+        if ps_pkg._worker is None and os.environ.get("DMLC_PS_ROOT_URI"):
+            # auto-bootstrap like the reference HetuConfig (executor.py:69)
+            ps_pkg.worker_init()
+        self.comm = ps_pkg.get_worker_communicate()
+        self.bsp = bool(config.bsp)
+
+        # -- identify PS-hosted params (reference context.py:146-148) -------
+        embed_vars = set()
+        lookups_by_var: dict[int, list[Op]] = {}
+        for op in topo:
+            embed = getattr(op, "embed_node", None)
+            if embed is not None and isinstance(embed, PlaceholderOp):
+                embed_vars.add(id(embed))
+                lookups_by_var.setdefault(id(embed), []).append(op)
+        self.params: dict[int, PSParam] = {}
+        next_id = 0
+        for op in topo:
+            if not (isinstance(op, PlaceholderOp) and op.trainable):
+                continue
+            sparse = getattr(op, "is_embed", False) or id(op) in embed_vars
+            if config.comm_mode == "Hybrid" and not sparse:
+                continue  # dense params ride AllReduce in Hybrid
+            if config.comm_mode == "PS" or sparse:
+                p = PSParam(op, next_id, sparse)
+                p.lookup_ops = lookups_by_var.get(id(op), [])
+                self.params[id(op)] = p
+                next_id += 1
+
+        # optimizer config for the server (worker-side lr pre-scaling is used
+        # for SGD, like the reference; stateful optimizers run server-side)
+        self._opt_nodes = [n for n in topo if n.is_optimizer]
+        self._server_opt = self._deduce_server_opt()
+        self._init_params()
+
+    # ------------------------------------------------------------------
+    def _deduce_server_opt(self):
+        import warnings
+        for opt_node in self._opt_nodes:
+            o = opt_node.optimizer
+            name = type(o).__name__
+            scheduled = hasattr(o.learning_rate, "get") or hasattr(
+                o.learning_rate, "get_traced")
+            lr = float(o.lr_value(0))
+            if getattr(o, "l2reg", 0.0):
+                raise NotImplementedError(
+                    "l2reg is not applied server-side; PS-hosted params would "
+                    "silently skip regularization — use l2reg=0 with "
+                    "comm_mode PS/Hybrid or keep the param device-resident")
+            if name == "SGDOptimizer":
+                # prescale: the worker multiplies by -lr(step) each push, so
+                # lr schedules are honored (reference _mult_lr)
+                return {"otype": "sgd", "lrs": (lr,), "prescale": True,
+                        "opt": o}
+            if scheduled:
+                raise NotImplementedError(
+                    f"{name} with an lr scheduler: server-side optimizer "
+                    "state is configured once at init, so the schedule would "
+                    "be silently frozen — use SGDOptimizer (worker-side lr) "
+                    "for PS-hosted params or a fixed lr")
+            if name == "MomentumOptimizer":
+                return {"otype": "nesterov" if o.nesterov else "momentum",
+                        "lrs": (lr, o.momentum), "prescale": False, "opt": o}
+            if name == "AdaGradOptimizer":
+                return {"otype": "adagrad", "lrs": (lr, o.eps),
+                        "prescale": False, "opt": o}
+            if name in ("AdamOptimizer", "AdamWOptimizer"):
+                return {"otype": "adam",
+                        "lrs": (lr, o.beta1, o.beta2, o.epsilon),
+                        "prescale": False, "opt": o}
+        return {"otype": "sgd", "lrs": (0.01,), "prescale": True, "opt": None}
+
+    def _prescale_lr(self, step: int) -> float:
+        o = self._server_opt.get("opt")
+        if o is None:
+            return 0.01
+        return float(o.lr_value(step))
+
+    def _init_params(self):
+        cfg = self.config
+        if cfg.cstable_policy and not self._server_opt["prescale"]:
+            raise NotImplementedError(
+                "cstable_policy requires worker-side lr-scaled SGD: the "
+                "cache applies raw pushed grads to its local rows, which "
+                "diverges from a stateful server optimizer (the reference "
+                "has the same restriction, ParameterServerCommunicate.py)")
+        for p in self.params.values():
+            opt = self._server_opt
+            if p.sparse:
+                rows, width = int(p.shape[0]), int(np.prod(p.shape[1:]))
+                kind = 2 if cfg.cstable_policy else 1
+            else:
+                rows, width = int(np.prod(p.shape)), 1
+                kind = 0
+            spec = _ps_init_spec(p.node)
+            if spec is not None:
+                itype, a, b = spec
+                self.comm.InitTensor(p.ps_id, kind, rows, width, itype, a, b,
+                                     seed=cfg.seed + p.ps_id,
+                                     opt_type=opt["otype"], lrs=opt["lrs"])
+            else:
+                # host-side init (explicit value or derived initializer like
+                # Xavier): init zeros on the server, rank 0 pushes the value
+                self.comm.InitTensor(p.ps_id, kind, rows, width, "constant",
+                                     0.0, 1.0, seed=cfg.seed,
+                                     opt_type=opt["otype"], lrs=opt["lrs"])
+                if self.comm.rank == 0:
+                    import jax
+                    value = np.asarray(
+                        p.node.instantiate(jax.random.PRNGKey(cfg.seed)),
+                        dtype=np.float32)
+                    # raw assignment: the value must not pass through the
+                    # server optimizer (Adam would treat it as a gradient)
+                    if p.sparse:
+                        self.comm.SparseAssign(
+                            p.ps_id, np.arange(rows, dtype=np.int64),
+                            value.reshape(rows, width))
+                    else:
+                        self.comm.Assign(p.ps_id, value.ravel())
+                self.comm.BarrierWorker()
+            if p.sparse and cfg.cstable_policy:
+                from ..cstable import CacheSparseTable
+                limit = max(1, int(rows * 0.1))
+                p.cache = CacheSparseTable(limit, rows, width, p.ps_id,
+                                           policy=cfg.cstable_policy,
+                                           bound=cfg.cache_bound)
+            if not p.sparse:
+                buf = np.zeros(rows, np.float32)
+                self.comm.Pull(p.ps_id, buf)
+                self.comm.Wait(p.ps_id)
+                p.host_value = buf.reshape(p.shape)
+
+    # ------------------------------------------------------------------
+    # pre-step: stage embedding rows / dense values
+    # ------------------------------------------------------------------
+    def stage_lookup(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
+        """Pull the batch's rows (reference EmbeddingLookUp.py:27-40)."""
+        width = int(np.prod(p.shape[1:]))
+        flat = np.ascontiguousarray(idx, dtype=np.int64).ravel()
+        dest = np.zeros((flat.size, width), np.float32)
+        if p.cache is not None:
+            p.cache.embedding_lookup(flat.astype(np.uint64), dest, sync=True)
+        else:
+            self.comm.SparsePull(p.ps_id, flat, dest)
+            self.comm.Wait(p.ps_id)
+        return dest.reshape(tuple(idx.shape) + tuple(p.shape[1:]))
+
+    # ------------------------------------------------------------------
+    # post-step: push gradients
+    # ------------------------------------------------------------------
+    def push_grad(self, p: PSParam, grad: np.ndarray,
+                  idx: Optional[np.ndarray], step: int = 0):
+        opt = self._server_opt
+        if p.sparse:
+            width = int(np.prod(p.shape[1:]))
+            flat_idx = np.ascontiguousarray(idx, dtype=np.int64).ravel()
+            g = np.asarray(grad, np.float32).reshape(flat_idx.size, width)
+            if opt["prescale"]:
+                g = -self._prescale_lr(step) * g
+            if p.cache is not None:
+                p.cache.embedding_update(flat_idx.astype(np.uint64), g,
+                                         sync=True)
+            else:
+                self.comm.SparsePush(p.ps_id, flat_idx, g)
+                self.comm.Wait(p.ps_id)
+        else:
+            g = np.asarray(grad, np.float32).ravel()
+            if opt["prescale"]:
+                g = -self._prescale_lr(step) * g
+            out = np.empty_like(p.host_value).ravel()
+            self.comm.DDPushPull(p.ps_id, g, out)
+            self.comm.Wait(p.ps_id)
+            p.host_value = out.reshape(p.shape)
+        if self.bsp:
+            self.comm.BarrierWorker()
+
+    # ------------------------------------------------------------------
+    def save(self, directory: str):
+        """Server-side checkpoint of PS params (reference executor.py:355)."""
+        if self.comm.rank == 0:
+            for p in self.params.values():
+                self.comm.SaveParam(p.ps_id, directory)
+        self.comm.BarrierWorker()
+
+    def load(self, directory: str):
+        if self.comm.rank == 0:
+            for p in self.params.values():
+                self.comm.LoadParam(p.ps_id, directory)
+        self.comm.BarrierWorker()
+        for p in self.params.values():
+            if not p.sparse:
+                buf = np.zeros(int(np.prod(p.shape)), np.float32)
+                self.comm.Pull(p.ps_id, buf)
+                self.comm.Wait(p.ps_id)
+                p.host_value = buf.reshape(p.shape)
+
+    def pull_dense_value(self, p: PSParam) -> np.ndarray:
+        buf = np.zeros(int(np.prod(p.shape)), np.float32)
+        self.comm.Pull(p.ps_id, buf)
+        self.comm.Wait(p.ps_id)
+        return buf.reshape(p.shape)
+
+    def pull_sparse_rows(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
+        return self.stage_lookup(p, idx)

@@ -1,0 +1,206 @@
+"""Fused causal attention for TPU.
+
+The reference's attention is unfused BatchMatMul + Softmax + BatchMatMul
+(examples/nlp/hetu_transformer.py:56+), materializing the (S, S) score matrix
+in HBM. This module computes attention blockwise with an online softmax so
+only (block_q, block_k) tiles ever exist:
+
+- forward: a Pallas kernel — q/k/v tiles stream HBM->VMEM, scores hit the
+  MXU, the running (max, sum) rescale keeps the softmax exact. Falls back to
+  interpreter mode off-TPU so the same code runs in CPU-mesh tests.
+- backward: blockwise `lax.scan` recomputation in XLA (flash-style: no (S,S)
+  materialization; each dq/dk/dv tile recomputes its probability block).
+
+Public entry: ``flash_attention(q, k, v, causal=True)`` with shapes
+(batch, heads, seq, head_dim), differentiable via custom_vjp.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_len):
+    # grid: (batch*heads, q_blocks); refs carry one q block and the full k/v
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
+    block_q = q.shape[0]
+    q_start = qi * block_q
+
+    num_kb = seq_len // block_k
+
+    def body(kj, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    # causal: skip key blocks entirely above the diagonal
+    upper = num_kb if not causal else (q_start + block_q) // block_k
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(l)
+
+
+def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+    grid = (bh, s // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            # trailing singleton keeps the block's last-two dims TPU-tileable
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
+
+# ---------------------------------------------------------------------------
+# blockwise backward (XLA): flash-style recomputation, no (S, S) tensor
+# ---------------------------------------------------------------------------
+
+def _bwd_blockwise(res, do, *, scale, causal, block_k):
+    q, k, v, o, lse = res
+    b, h, s, d = q.shape
+    nkb = s // block_k
+    do_f = do.astype(jnp.float32)
+    q_f = q.astype(jnp.float32)
+    # delta_i = sum_j dO_ij O_ij  (rowwise), standard flash backward
+    delta = jnp.sum(do_f * o.astype(jnp.float32), axis=-1)  # (b,h,s)
+
+    q_pos = jnp.arange(s)
+
+    def one_kblock(kj):
+        ks = kj * block_k
+        k_blk = jax.lax.dynamic_slice_in_dim(k, ks, block_k, 2)
+        v_blk = jax.lax.dynamic_slice_in_dim(v, ks, block_k, 2)
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", q_f,
+                           k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = q_pos[:, None] >= (ks + jnp.arange(block_k))[None, :]
+            s_blk = jnp.where(mask, s_blk, _NEG_INF)
+        p = jnp.exp(s_blk - lse[..., None])                    # (b,h,s,bk)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do_f)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_f, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq_part = jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk.astype(jnp.float32))
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, q_f)
+        return dq_part, dk_blk, dv_blk
+
+    def scan_body(dq_acc, kj):
+        dq_part, dk_blk, dv_blk = one_kblock(kj)
+        return dq_acc + dq_part, (dk_blk, dv_blk)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        scan_body, jnp.zeros(q.shape, jnp.float32), jnp.arange(nkb))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Fused causal attention. q/k/v: (batch, heads, seq, head_dim)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _resolve(q, scale, block_q, block_k):
+    s = q.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq_len {s} must divide blocks ({block_q},{block_k})")
+    return scale, block_q, block_k
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    scale, block_q, block_k = _resolve(q, scale, block_q, block_k)
+    out, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                           interpret=not _on_tpu())
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    q = res[0]
+    scale, block_q, block_k = _resolve(q, scale, block_q, block_k)
+    return _bwd_blockwise(res, do, scale=scale, causal=causal,
+                          block_k=block_k)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha_reference(q, k, v, causal=True, scale=None):
+    """Unfused reference (the reference framework's BatchMatMul+Softmax
+    attention) — used as the numerical oracle in tests."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        n = q.shape[2]
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

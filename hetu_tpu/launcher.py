@@ -1,0 +1,127 @@
+"""Yaml-driven local PS-cluster launcher.
+
+Capability parity with the reference's ``python/hetu/launcher.py``: a yaml
+file carries the shared DMLC_* env block plus a ``launch`` section with
+scheduler/server/worker counts; roles run as local processes
+(``python -m hetu_tpu.launcher cfg.yml -n 2 --sched`` starts PS roles only,
+``launch(target, args)`` also forks workers running ``target``).
+
+Uses the ``spawn`` start method: worker targets import JAX, and forking a
+JAX-threaded parent deadlocks.
+"""
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import os
+import signal
+import sys
+
+import yaml
+
+_procs: list = []
+
+
+def _signal_handler(sig, frame):
+    print("SIGINT caught, stopping cluster")
+    for proc in _procs:
+        proc.terminate()
+    sys.exit(0)
+
+
+def _apply_shared_env(settings):
+    for k, v in settings.get("shared", {}).items():
+        os.environ[k] = str(v)
+
+
+def start_sched(env=None):
+    os.environ.update(env or {})
+    os.environ["DMLC_ROLE"] = "scheduler"
+    from hetu_tpu.ps import server as srv
+    srv.start_scheduler_from_env()
+    srv.scheduler_wait()
+    srv.stop_scheduler()
+
+
+def start_server(server_id=0, env=None):
+    os.environ.update(env or {})
+    os.environ["DMLC_ROLE"] = "server"
+    os.environ.setdefault("SERVER_ID", str(server_id))
+    import signal as _signal
+    import threading
+    from hetu_tpu.ps import server as srv
+    srv.start_server_from_env()
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    _signal.signal(_signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    srv.stop_server()
+
+
+def start_worker(target, args, worker_id=0, env=None):
+    os.environ.update(env or {})
+    os.environ["DMLC_ROLE"] = "worker"
+    os.environ.setdefault("WORKER_ID", str(worker_id))
+    import hetu_tpu as ht
+    ht.worker_init()
+    try:
+        target(args)
+    finally:
+        ht.worker_finish()
+
+
+def launch(target, args):
+    """Launch the yaml-described local cluster and run ``target(args)`` in
+    every worker process (reference launcher.py:18-38)."""
+    settings = yaml.safe_load(open(args.config).read())
+    _apply_shared_env(settings)
+    env = dict(os.environ)
+    ctx = multiprocessing.get_context("spawn")
+    n_workers = int(settings["launch"]["worker"])
+    args.num_local_worker = n_workers
+    if settings["launch"].get("scheduler", 0):
+        _procs.append(ctx.Process(target=start_sched, args=(env,)))
+    for i in range(int(settings["launch"]["server"])):
+        _procs.append(ctx.Process(target=start_server, args=(i, env)))
+    workers = []
+    for i in range(n_workers):
+        p = ctx.Process(target=start_worker, args=(target, args, i, env))
+        _procs.append(p)
+        workers.append(p)
+    signal.signal(signal.SIGINT, _signal_handler)
+    for proc in _procs:
+        proc.start()
+    for proc in workers:
+        proc.join()
+    # workers done: tear down PS roles
+    for proc in _procs:
+        if proc not in workers:
+            proc.terminate()
+            proc.join(timeout=10)
+
+
+def main():
+    signal.signal(signal.SIGINT, _signal_handler)
+    parser = argparse.ArgumentParser(
+        description="launch PS roles (scheduler/servers) from a yaml config")
+    parser.add_argument("config")
+    parser.add_argument("-n", type=int, default=1, help="number of servers")
+    parser.add_argument("--sched", action="store_true",
+                        help="also launch the scheduler")
+    args = parser.parse_args()
+    settings = yaml.safe_load(open(args.config).read())
+    _apply_shared_env(settings)
+    env = dict(os.environ)
+    ctx = multiprocessing.get_context("spawn")
+    if args.sched:
+        _procs.append(ctx.Process(target=start_sched, args=(env,)))
+    for i in range(args.n):
+        _procs.append(ctx.Process(target=start_server, args=(i, env)))
+    for proc in _procs:
+        proc.start()
+    for proc in _procs:
+        proc.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,10 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16   # compute dtype
     remat: bool = True
+    # attention implementation: "auto" picks ring when the mesh shards the
+    # sequence (sp>1), the fused Pallas kernel on TPU for block-divisible
+    # sequences, and the unfused dot-product form otherwise
+    attn_impl: str = "auto"     # auto | dot | flash | ring
 
     @property
     def head_dim(self):
@@ -152,27 +156,67 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
-def _attention(h, p, cfg: TransformerConfig, mesh):
-    B, T, D = h.shape
-    nh, hd = cfg.n_heads, cfg.head_dim
-    qkv = jnp.einsum("btd,de->bte", h, p["wqkv"].astype(h.dtype),
-                     preferred_element_type=jnp.float32).astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    # heads over tp; q keeps the sequence sharded (sp), k/v gather over sp
-    q = q.reshape(B, T, nh, hd)
-    # gather k/v over the sequence (sp) axis only — heads stay tp-sharded
-    k = _constrain(k, mesh, "dp", None, "tp").reshape(B, T, nh, hd)
-    v = _constrain(v, mesh, "dp", None, "tp").reshape(B, T, nh, hd)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+def _resolve_attn_impl(cfg: TransformerConfig, mesh, T):
+    impl = cfg.attn_impl
+    if impl != "auto":
+        return impl
+    if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        return "ring"
+    if jax.default_backend() == "tpu" and T % 128 == 0:
+        return "flash"
+    return "dot"
+
+
+def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl):
+    """q/k/v: (B, nh, T, hd) -> (B, nh, T, hd). Three paths:
+    - ring: sequence-parallel exact attention over the sp axis (shard_map +
+      ppermute ring, hetu_tpu/parallel/ring_attention.py)
+    - flash: fused Pallas online-softmax kernel (hetu_tpu/kernels)
+    - dot: unfused reference form (the reference framework's
+      BatchMatMul+Softmax attention)"""
+    hd = q.shape[-1]
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+        from jax.experimental.shard_map import shard_map
+        spec = P("dp", "tp", "sp", None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        return fn(q, k, v)
+    if impl == "flash":
+        from ..kernels.flash_attention import flash_attention
+        return flash_attention(q, k, v, True)
+    T = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)
-    # causal mask over absolute positions (valid under sp-sharded q rows)
     qpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
     scores = jnp.where(kpos <= qpos, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _attention(h, p, cfg: TransformerConfig, mesh):
+    B, T, D = h.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    impl = _resolve_attn_impl(cfg, mesh, T)
+    qkv = jnp.einsum("btd,de->bte", h, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
-    out = out.reshape(B, T, D)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+    if impl == "ring":
+        # k/v stay sequence-sharded: the ring rotates chunks over ICI
+        k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+    else:
+        # Ulysses-style: gather k/v over sp, heads stay tp-sharded
+        k = _constrain(k, mesh, "dp", None, "tp").reshape(
+            B, T, nh, hd).transpose(0, 2, 1, 3)
+        v = _constrain(v, mesh, "dp", None, "tp").reshape(
+            B, T, nh, hd).transpose(0, 2, 1, 3)
+    out = _attention_core(q, k, v, cfg, mesh, impl)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     return jnp.einsum("btd,de->bte", out, p["wo"].astype(h.dtype),
                       preferred_element_type=jnp.float32).astype(h.dtype)
 

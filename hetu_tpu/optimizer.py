@@ -185,7 +185,11 @@ class OptimizerOp(Op):
 
     # -- executor protocol --------------------------------------------------
     def init_slots(self, params_by_id):
-        return tuple(self.optimizer.slot_init(params_by_id[id(v)]) for v in self.vars)
+        # vars missing from the map are PS-resident: the server owns their
+        # optimizer slots (reference ps/server/optimizer.h)
+        return tuple(self.optimizer.slot_init(params_by_id[id(v)])
+                     if id(v) in params_by_id else ()
+                     for v in self.vars)
 
     def apply_updates(self, env, slots, tc):
         lr = self.optimizer.lr_value(tc.step)

@@ -1,0 +1,102 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context capability beyond the reference (its longest-sequence support is
+a plain BatchMatMul transformer, SURVEY §5): the sequence axis is sharded
+over the mesh's ``sp`` axis; k/v chunks rotate around the ring via
+``lax.ppermute`` over ICI while each device accumulates its q-chunk's output
+with a log-sum-exp merge — no device ever holds the full sequence, and
+compute overlaps the rotation (XLA schedules the ppermute DMA against the
+local block's matmuls).
+
+Differentiable: autodiff flows through ppermute (its transpose is the
+reverse rotation). Each step is rematerialized (jax.checkpoint) so the
+backward's live set is one k/v chunk, matching flash-attention scaling.
+
+Usage inside shard_map (q/k/v already sequence-sharded on ``axis_name``):
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One (local_q x chunk_k) attention block.
+
+    Returns (out, lse): ``out`` is the chunk-local softmax(s) @ v (normalized
+    within the chunk) and ``lse`` its log-sum-exp, so two results combine
+    exactly as out_new = Σ out_c * exp(lse_c - logaddexp(lse...))."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                  # (b,h,q)
+    # rows with no visible keys: exp(-inf - -inf) guards via max clamp
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    num = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = num / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+    return out, lse
+
+
+def _merge(acc_num, acc_lse, num, lse):
+    """Log-sum-exp merge of two partial attention results."""
+    new_lse = jnp.logaddexp(acc_lse, lse)
+    a = jnp.exp(acc_lse - new_lse)
+    b = jnp.exp(lse - new_lse)
+    return acc_num * a[..., None] + num * b[..., None], new_lse
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: float | None = None):
+    """q/k/v: (batch, heads, local_seq, head_dim), sequence-sharded over
+    ``axis_name``. Returns the local output chunk."""
+    n_dev = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    local_s = q.shape[2]
+    b, h = q.shape[0], q.shape[1]
+
+    q_pos = my_idx * local_s + jnp.arange(local_s)            # absolute rows
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def step_compute(q, k_chunk, src_idx, acc_num, acc_lse):
+        k_pos = src_idx * local_s + jnp.arange(local_s)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((local_s, local_s), bool)
+        num, lse = _block_attend(q, k_chunk[0], k_chunk[1], scale,
+                                 mask[None, None])
+        return _merge(acc_num, acc_lse, num, lse)
+
+    def body(carry, _):
+        kv, src_idx, acc_num, acc_lse = carry
+        acc_num, acc_lse = step_compute(q, kv, src_idx, acc_num, acc_lse)
+        # rotate: receive the previous device's chunk (ring over ICI)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        kv_next = jax.lax.ppermute(kv, axis_name, perm)
+        src_next = jax.lax.ppermute(src_idx, axis_name, perm)
+        return (kv_next, src_next, acc_num, acc_lse), None
+
+    # derive the accumulators from q so they carry the same device-varying
+    # manual axes as the per-step outputs (scan requires matching carry types
+    # under shard_map)
+    acc_num = jnp.zeros_like(q, jnp.float32) + 0.0 * q.astype(jnp.float32)
+    acc_lse = jnp.sum(0.0 * q.astype(jnp.float32), axis=-1) + _NEG_INF
+    kv0 = jnp.stack([k.astype(jnp.float32), v.astype(jnp.float32)])
+    src0 = jnp.asarray(my_idx, jnp.int32)
+    (_, _, acc_num, acc_lse), _ = jax.lax.scan(
+        body, (kv0, src0, acc_num, acc_lse), None, length=n_dev)
+
+    # rows with zero visible keys (none under causal with self-block) -> 0
+    safe = acc_lse > _NEG_INF / 2
+    out = jnp.where(safe[..., None], acc_num, 0.0)
+    return out.astype(q.dtype)

@@ -35,10 +35,14 @@ def server_finish():
     stop_server()
 
 
-def worker_init():
+def _register_worker(worker):
     global _worker
+    _worker = worker
+
+
+def worker_init():
     from .client import PSClient
-    _worker = PSClient.from_env()
+    PSClient.from_env()  # registers itself via _register_worker
 
 
 def worker_finish():

@@ -59,6 +59,11 @@ class PSClient:
         # pinned staging buffers per tensor id: async Push/Pull contract
         # requires buffers to stay alive until Wait
         self._staging: dict[int, list] = {}
+        # register as the process-wide worker communicator so components that
+        # resolve it via ht.get_worker_communicate() (e.g. CacheSparseTable)
+        # find this agent regardless of how it was constructed
+        from . import _register_worker
+        _register_worker(self)
 
     @classmethod
     def from_env(cls) -> "PSClient":
@@ -93,13 +98,15 @@ class PSClient:
     # -- tensor init (reference InitTensor binding) -------------------------
     def InitTensor(self, node, sparse, length, width, init_type, init_a,
                    init_b=1.0, seed=123, opt_type="sgd", lrs=(0.1,)):
+        """sparse: 0/False = dense, 1/True = sparse 2D, 2 = cache table
+        (versioned rows for bounded-staleness sync)."""
         if isinstance(init_type, str):
             init_type = _INIT_TYPE[init_type]
         if isinstance(opt_type, str):
             opt_type = _OPT_TYPE[opt_type]
         lrs_arr = np.asarray(lrs, dtype=np.float32)
         self._lib.InitTensor(
-            ctypes.c_int(int(node)), ctypes.c_int(int(bool(sparse))),
+            ctypes.c_int(int(node)), ctypes.c_int(int(sparse)),
             ctypes.c_long(int(length)), ctypes.c_long(int(width)),
             ctypes.c_int(int(init_type)), ctypes.c_double(float(init_a)),
             ctypes.c_double(float(init_b)), ctypes.c_ulonglong(int(seed)),
@@ -130,6 +137,21 @@ class PSClient:
         self._lib.DDPushPull(ctypes.c_int(node), g.ctypes.data_as(_f32p),
                              out.ctypes.data_as(_f32p), ctypes.c_long(g.size))
         return out
+
+    def Assign(self, node, value):
+        """Raw overwrite (host-computed init values; bypasses the server's
+        optimizer so Adam/Momentum slots never see the init as a grad)."""
+        v = _as_f32(value)
+        self._lib.AssignDense(ctypes.c_int(node), v.ctypes.data_as(_f32p),
+                              ctypes.c_long(v.size))
+        self._check()
+
+    def SparseAssign(self, node, indices, values):
+        idx, vals = _as_i64(indices).ravel(), _as_f32(values)
+        self._lib.AssignRows(ctypes.c_int(node), idx.ctypes.data_as(_i64p),
+                             vals.ctypes.data_as(_f32p),
+                             ctypes.c_long(idx.size))
+        self._check()
 
     # -- sparse -------------------------------------------------------------
     def SparsePush(self, node, indices, values):

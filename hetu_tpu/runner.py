@@ -1,0 +1,175 @@
+"""``heturun`` — cluster launcher (reference ``python/runner.py`` +
+``bin/heturun``).
+
+Usage: ``heturun -c cluster.yml python train.py [args...]``
+
+The yaml lists nodes with host/servers/workers/chief (reference
+runner.py:158-184). On a single machine, PS roles run as local processes and
+workers as subprocesses with WORKER_ID env. Across machines, remote roles are
+started over ``ssh`` (the reference uses paramiko + mpirun; TPU pods use one
+process per host, so workers get ``jax.distributed`` coordinator env vars
+instead of an MPI world).
+"""
+from __future__ import annotations
+
+import argparse
+import multiprocessing
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import yaml
+
+_procs: list = []
+_shells: list = []
+
+
+def _signal_handler(sig, frame):
+    for p in _shells:
+        p.terminate()
+    for p in _procs:
+        p.terminate()
+    sys.exit(0)
+
+
+def _get_available_port(addr: str) -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((addr, 0))
+        return s.getsockname()[1]
+
+
+def parse_cluster(path):
+    settings = yaml.safe_load(open(path).read())
+    attributes = {"host", "servers", "workers", "chief"}
+    hosts, servers, workers = [], {}, {}
+    chief = None
+    for node in settings["nodes"]:
+        assert set(node.keys()) <= attributes, \
+            f"invalid node attributes: {set(node.keys())} / {attributes}"
+        hosts.append(node["host"])
+        if node.get("servers", 0):
+            servers[node["host"]] = int(node["servers"])
+        if node.get("workers", 0):
+            workers[node["host"]] = int(node["workers"])
+        if node.get("chief", False):
+            assert chief is None, "there should be only one chief"
+            chief = node["host"]
+    assert chief, "there should be one chief"
+    return hosts, servers, workers, chief
+
+
+def _sched_entry(env):
+    from hetu_tpu.launcher import start_sched
+    start_sched(env)
+
+
+def _server_entry(server_id, env):
+    from hetu_tpu.launcher import start_server
+    start_server(server_id, env)
+
+
+def main(argv=None):
+    signal.signal(signal.SIGINT, _signal_handler)
+    parser = argparse.ArgumentParser(prog="heturun")
+    parser.add_argument("-c", "--config", required=True,
+                        help="cluster yaml (nodes: host/servers/workers/chief)")
+    parser.add_argument("-i", "--identify", default="",
+                        help="SSH identity file for multi-machine launch")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command, e.g. python train.py")
+    args = parser.parse_args(argv)
+    hosts, servers, workers, chief = parse_cluster(args.config)
+    num_servers = sum(servers.values())
+    num_workers = sum(workers.values())
+    enable_ps = num_servers > 0
+    chief_address = (socket.gethostbyname(socket.gethostname())
+                     if len(hosts) > 1 else "127.0.0.1")
+    port = _get_available_port(chief_address)
+    print(f"Cluster: {{ chief: {chief}, servers({num_servers}): {servers}, "
+          f"workers({num_workers}): {workers} }}")
+
+    env = dict(os.environ)
+    if enable_ps:
+        env.update({
+            "DMLC_PS_ROOT_URI": chief_address,
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_SERVER": str(num_servers),
+            "DMLC_NUM_WORKER": str(num_workers),
+        })
+
+    ctx = multiprocessing.get_context("spawn")
+    if len(hosts) == 1:
+        if enable_ps:
+            _procs.append(ctx.Process(target=_sched_entry, args=(env,)))
+            for i in range(num_servers):
+                _procs.append(ctx.Process(target=_server_entry, args=(i, env)))
+            for p in _procs:
+                p.start()
+        for w in range(num_workers):
+            wenv = dict(env)
+            wenv["WORKER_ID"] = str(w)
+            if enable_ps:
+                wenv["DMLC_ROLE"] = "worker"
+            # multi-chip single host: each worker is one jax process
+            wenv["HETU_NUM_WORKER"] = str(num_workers)
+            _shells.append(subprocess.Popen(args.command, env=wenv))
+        rc = 0
+        for p in _shells:
+            rc |= p.wait()
+        for p in _procs:
+            p.terminate()
+            p.join(timeout=10)
+        sys.exit(rc)
+    else:
+        # multi-machine: ssh remote roles; workers get jax.distributed
+        # coordinator env (reference: paramiko remote PS + mpirun -host)
+        ssh_opts = ["-o", "StrictHostKeyChecking=no"]
+        if args.identify:
+            ssh_opts += ["-i", args.identify]
+        coord = f"{chief_address}:{_get_available_port(chief_address)}"
+        env_exports = " ".join(
+            f"{k}={v}" for k, v in env.items() if k.startswith("DMLC_"))
+        sid = 0
+        if enable_ps:
+            _procs.append(ctx.Process(target=_sched_entry, args=(env,)))
+            for p in _procs:
+                p.start()
+        pidx = 0
+        total_procs = sum(workers.values())
+        for host in hosts:
+            for _ in range(servers.get(host, 0)):
+                cmd = (f"{env_exports} SERVER_ID={sid} DMLC_ROLE=server "
+                       f"python -m hetu_tpu.launcher_remote_server")
+                _shells.append(subprocess.Popen(
+                    ["ssh", *ssh_opts, host, cmd]))
+                sid += 1
+            for _ in range(workers.get(host, 0)):
+                wcmd = (f"{env_exports} WORKER_ID={pidx} DMLC_ROLE=worker "
+                        f"HETU_NUM_WORKER={num_workers} "
+                        f"JAX_COORDINATOR_ADDRESS={coord} "
+                        f"JAX_NUM_PROCESSES={total_procs} "
+                        f"JAX_PROCESS_ID={pidx} " + " ".join(args.command))
+                if host == chief:
+                    _shells.append(subprocess.Popen(
+                        args.command, env={**env, "WORKER_ID": str(pidx),
+                                           "DMLC_ROLE": "worker",
+                                           "HETU_NUM_WORKER": str(num_workers),
+                                           "JAX_COORDINATOR_ADDRESS": coord,
+                                           "JAX_NUM_PROCESSES": str(total_procs),
+                                           "JAX_PROCESS_ID": str(pidx)}))
+                else:
+                    _shells.append(subprocess.Popen(
+                        ["ssh", *ssh_opts, host, wcmd]))
+                pidx += 1
+        rc = 0
+        for p in _shells:
+            rc |= p.wait()
+        for p in _procs:
+            p.terminate()
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Embedding-cache tests against a live local PS cluster.
+
+Mirrors the reference's tests/hetu_cache/hetu_cache_test.py strategy
+(SURVEY.md §4.4): CacheSparseTable policies exercised against a local
+parameter server, with bounded-staleness propagation checked across workers.
+"""
+import numpy as np
+
+from test_ps import run_cluster
+
+NROWS = 64
+WIDTH = 8
+
+
+def _mk_table(client, node_id, policy, bound, limit=16, init_a=1.0):
+    client.InitTensor(node_id, sparse=2, length=NROWS, width=WIDTH,
+                      init_type="constant", init_a=init_a)
+    from hetu_tpu.cstable import CacheSparseTable
+    return CacheSparseTable(limit, NROWS, WIDTH, node_id, policy=policy,
+                            bound=bound)
+
+
+def _lookup_update_roundtrip(client, rank, tmpdir):
+    # single worker: lookup pulls initial values; update applies locally and
+    # (bound=0) pushes every batch; a fresh lookup of evicted rows re-pulls
+    table = _mk_table(client, 10, "LRU", bound=0, limit=8)
+    keys = np.arange(4, dtype=np.uint64)
+    dest = np.zeros((4, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.0)
+
+    grads = np.full((4, WIDTH), 0.5, np.float32)
+    table.embedding_update(keys, grads, sync=True)
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.5)
+
+    # server saw the push (bound=0): bypass the cache and read raw
+    table.bypass()
+    dest2 = np.zeros((4, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest2, sync=True)
+    np.testing.assert_allclose(dest2, 1.5)
+
+
+def _policies(client, rank, tmpdir):
+    for node_id, policy in ((11, "LRU"), (12, "LFU"), (13, "LFUOpt")):
+        table = _mk_table(client, node_id, policy, bound=0, limit=8)
+        # touch more keys than the limit: evictions must stay correct
+        for lo in range(0, NROWS, 8):
+            keys = np.arange(lo, lo + 8, dtype=np.uint64)
+            dest = np.zeros((8, WIDTH), np.float32)
+            table.embedding_lookup(keys, dest, sync=True)
+            np.testing.assert_allclose(dest, 1.0, err_msg=policy)
+            table.embedding_update(
+                keys, np.full((8, WIDTH), 0.25, np.float32), sync=True)
+        assert len(table) <= 8
+        # all rows were updated exactly once -> server value 1.25 everywhere
+        table.bypass()
+        dest = np.zeros((NROWS, WIDTH), np.float32)
+        table.embedding_lookup(np.arange(NROWS, dtype=np.uint64), dest,
+                               sync=True)
+        np.testing.assert_allclose(dest, 1.25, err_msg=policy)
+
+
+def _dedup_keys(client, rank, tmpdir):
+    table = _mk_table(client, 14, "LRU", bound=0)
+    # duplicate keys in one lookup get one line; update accumulates per slot
+    keys = np.array([3, 3, 3, 5], np.uint64)
+    dest = np.zeros((4, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.0)
+    table.embedding_update(keys, np.ones((4, WIDTH), np.float32), sync=True)
+    out = table.lookup(3)
+    np.testing.assert_allclose(out["data"], 4.0)  # 1.0 + 3 dup grads
+
+
+def _staleness_propagation(client, rank, tmpdir):
+    # bound=0: every lookup syncs rows the server advanced past the local
+    # version, so worker 1 observes worker 0's pushed update
+    table = _mk_table(client, 15, "LRU", bound=0)
+    keys = np.arange(8, dtype=np.uint64)
+    dest = np.zeros((8, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.0)
+    client.BarrierWorker()
+    if rank == 0:
+        table.embedding_update(keys, np.full((8, WIDTH), 2.0, np.float32),
+                               sync=True)
+    client.BarrierWorker()
+    table.embedding_lookup(keys, dest, sync=True)
+    expected = 3.0  # both workers see 1.0 + 2.0 after the push
+    np.testing.assert_allclose(dest, expected)
+
+
+def _bounded_staleness_skips_fresh_rows(client, rank, tmpdir):
+    # large bound: a second lookup transfers NO rows (version gap <= bound)
+    table = _mk_table(client, 16, "LRU", bound=1000)
+    table.perf_enabled(True)
+    keys = np.arange(8, dtype=np.uint64)
+    dest = np.zeros((8, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)   # cold: pulls all 8
+    table.embedding_lookup(keys, dest, sync=True)   # warm: pulls none
+    perf = table.perf
+    assert perf[0]["num_transfered"] == 8, perf[0]
+    assert perf[1]["num_transfered"] == 0, perf[1]
+    assert table.overall_miss_rate(include_cold_start=True) >= 0
+
+
+def _push_pull_combined(client, rank, tmpdir):
+    table = _mk_table(client, 17, "LFU", bound=0)
+    keys = np.arange(8, dtype=np.uint64)
+    dest = np.zeros((8, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)
+    grads = np.full((8, WIDTH), 0.5, np.float32)
+    out = table.embedding_push_pull(keys, dest, keys, grads, sync=True)
+    np.testing.assert_allclose(out, 1.5)
+
+
+def test_cache_lookup_update_roundtrip(tmp_path):
+    run_cluster(_lookup_update_roundtrip, tmp_path, n_workers=1)
+
+
+def test_cache_policies(tmp_path):
+    run_cluster(_policies, tmp_path, n_workers=1)
+
+
+def test_cache_dedup_keys(tmp_path):
+    run_cluster(_dedup_keys, tmp_path, n_workers=1)
+
+
+def test_cache_staleness_propagation(tmp_path):
+    run_cluster(_staleness_propagation, tmp_path, n_workers=2)
+
+
+def test_cache_bounded_staleness(tmp_path):
+    run_cluster(_bounded_staleness_skips_fresh_rows, tmp_path, n_workers=1)
+
+
+def test_cache_push_pull(tmp_path):
+    run_cluster(_push_pull_combined, tmp_path, n_workers=1)

@@ -7,10 +7,35 @@ import sys
 import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", "examples", "cnn"))
 import hetu_tpu as ht
-import models  # noqa: E402
+
+
+def _import_example_models(example):
+    """Import examples/<example>/models under the bare name ``models``,
+    purging any previously-imported zoo (cnn/ctr both use the name)."""
+    import importlib
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "examples", example)
+    path = os.path.normpath(path)
+    target = os.path.join(path, "models")
+    current = sys.modules.get("models")
+    if current is not None and \
+            os.path.normpath(os.path.dirname(current.__file__)) != target:
+        for k in [k for k in sys.modules
+                  if k == "models" or k.startswith("models.")]:
+            sys.modules.pop(k)
+    if path in sys.path:
+        sys.path.remove(path)
+    sys.path.insert(0, path)
+    return importlib.import_module("models")
+
+
+models = None
+
+
+def setup_module():
+    global models
+    models = _import_example_models("cnn")
 
 
 def _train_two_steps(model_fn, x_shape, num_class=10, lr=0.01, **kwargs):

@@ -27,6 +27,11 @@ def _env(role, idx, port, n_workers=2, n_servers=2):
         "DMLC_NUM_WORKER": str(n_workers),
         "DMLC_NUM_SERVER": str(n_servers),
         "DMLC_ROLE": role,
+        # keep spawned roles off the real TPU (sitecustomize pins axon; the
+        # env alone is not authoritative — worker bodies also config-update)
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=8"),
     }
     if role == "server":
         env["SERVER_ID"] = str(idx)
@@ -56,6 +61,8 @@ def _run_server(idx, port, n_workers, n_servers, stopfile):
 
 def _worker_body(rank, port, n_workers, n_servers, fn, tmpdir, result_q):
     os.environ.update(_env("worker", rank, port, n_workers, n_servers))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     from hetu_tpu.ps.client import PSClient
     client = PSClient.from_env()
     try:

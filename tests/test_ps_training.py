@@ -1,0 +1,152 @@
+"""End-to-end PS/Hybrid training through the Executor against a live local
+PS cluster (reference: examples/ctr run with --comm PS/Hybrid, SURVEY §2.5).
+
+The embedding table lives on the parameter server; each step the executor
+pulls the batch's rows, runs the jitted XLA step, and pushes row gradients.
+"""
+import numpy as np
+
+from test_ps import run_cluster
+
+NROWS = 40
+WIDTH = 8
+SLOTS = 4
+BATCH = 16
+
+
+def _build_model(ht):
+    embed = ht.init.random_normal((NROWS, WIDTH), stddev=0.1, name="embed",
+                                  is_embed=True)
+    idx = ht.Variable(name="idx", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    vec = ht.embedding_lookup_op(embed, idx)            # (B, SLOTS, WIDTH)
+    flat = ht.array_reshape_op(vec, (-1, SLOTS * WIDTH))
+    w = ht.init.xavier_uniform((SLOTS * WIDTH, 1), name="w")
+    prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+    return embed, idx, y_, loss, prob
+
+
+def _gen_batch(rng):
+    bidx = rng.randint(0, NROWS, (BATCH, SLOTS)).astype(np.float32)
+    # label = majority of slots drawn from the upper half of the id range:
+    # learnable as a per-row score summed across slots (unlike parity)
+    by = ((bidx >= NROWS // 2).sum(axis=1) > SLOTS // 2)
+    by = by.reshape(BATCH, 1).astype(np.float32)
+    return bidx, by
+
+
+def _hybrid_training(client, rank, tmpdir):
+    import hetu_tpu as ht
+    embed, idx, y_, loss, prob = _build_model(ht)
+    opt = ht.optim.SGDOptimizer(0.1)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op], "validate": [loss, prob]},
+                     ctx=ht.cpu(0), comm_mode="Hybrid")
+    rng = np.random.RandomState(7 + rank)
+    losses = []
+    for _ in range(200):
+        bidx, by = _gen_batch(rng)
+        out = ex.run("train", feed_dict={idx: bidx, y_: by})
+        losses.append(float(out[0].asnumpy()))
+    client.BarrierWorker()
+    # learning happened (embedding rows + dense weights both moved)
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) - 0.02, (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
+    # validate subexecutor shares the PS tables
+    bidx, by = _gen_batch(rng)
+    vloss = float(ex.run("validate", feed_dict={idx: bidx, y_: by})[0].asnumpy())
+    assert np.isfinite(vloss)
+
+
+def _ps_mode_dense(client, rank, tmpdir):
+    # comm_mode='PS': dense params live on the server too (DDPushPull path)
+    import hetu_tpu as ht
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    w = ht.init.random_normal((4, 2), stddev=0.5, name="w")
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+    opt = ht.optim.SGDOptimizer(0.2)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), comm_mode="PS")
+    rng = np.random.RandomState(3 + rank)
+    true_w = np.array([[2.0, -1.0], [-1.0, 2.0], [0.5, 0.5], [1.0, -2.0]],
+                      np.float32)
+    losses = []
+    for _ in range(50):
+        bx = rng.randn(BATCH, 4).astype(np.float32)
+        logits = bx @ true_w
+        by = np.eye(2, dtype=np.float32)[logits.argmax(1)]
+        out = ex.run("train", feed_dict={x: bx, y_: by})
+        losses.append(float(out[0].asnumpy()))
+    client.BarrierWorker()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) - 0.05
+    # both workers see the same server-resident weights
+    value = ex.fetch_dense_parameter_value([w])[0].asnumpy()
+    np.save(f"{tmpdir}/w_{rank}.npy", value)
+    client.BarrierWorker()
+
+
+def _hybrid_with_cache(client, rank, tmpdir):
+    import hetu_tpu as ht
+    embed, idx, y_, loss, prob = _build_model(ht)
+    opt = ht.optim.SGDOptimizer(0.1)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="Hybrid", cstable_policy="LFUOpt",
+                     cache_bound=2)
+    rng = np.random.RandomState(11 + rank)
+    losses = []
+    for _ in range(200):  # bounded staleness converges slower than exact PS
+        bidx, by = _gen_batch(rng)
+        out = ex.run("train", feed_dict={idx: bidx, y_: by})
+        losses.append(float(out[0].asnumpy()))
+    client.BarrierWorker()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) - 0.02, (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
+
+
+def _ps_checkpoint(client, rank, tmpdir):
+    import hetu_tpu as ht
+    embed, idx, y_, loss, prob = _build_model(ht)
+    opt = ht.optim.SGDOptimizer(0.1)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="Hybrid")
+    rng = np.random.RandomState(5)
+    for _ in range(5):
+        bidx, by = _gen_batch(rng)
+        ex.run("train", feed_dict={idx: bidx, y_: by})
+    client.BarrierWorker()
+    ckpt = f"{tmpdir}/ckpt"
+    ex.save(ckpt)
+    before = ex.ps_runtime.pull_sparse_rows(
+        ex.ps_runtime.params[id(embed)], np.arange(NROWS))
+    for _ in range(3):
+        bidx, by = _gen_batch(rng)
+        ex.run("train", feed_dict={idx: bidx, y_: by})
+    client.BarrierWorker()
+    ex.load(ckpt)
+    after = ex.ps_runtime.pull_sparse_rows(
+        ex.ps_runtime.params[id(embed)], np.arange(NROWS))
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_hybrid_training(tmp_path):
+    run_cluster(_hybrid_training, tmp_path, n_workers=2, timeout=300)
+
+
+def test_ps_mode_dense_training(tmp_path):
+    run_cluster(_ps_mode_dense, tmp_path, n_workers=2, timeout=300)
+    a = np.load(f"{tmp_path}/w_0.npy")
+    b = np.load(f"{tmp_path}/w_1.npy")
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_hybrid_training_with_cache(tmp_path):
+    run_cluster(_hybrid_with_cache, tmp_path, n_workers=2, timeout=300)
+
+
+def test_ps_checkpoint_save_load(tmp_path):
+    run_cluster(_ps_checkpoint, tmp_path, n_workers=1, timeout=300)
