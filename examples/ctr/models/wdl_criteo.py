@@ -10,17 +10,17 @@ from .common import bce_loss_and_train, mlp
 
 def wdl_criteo(dense_input, sparse_input, y_, feature_dimension=33762577,
                embedding_size=128, learning_rate=0.01, n_slots=26,
-               n_dense=13):
+               n_dense=13, stddev=0.01):
     table = init.random_normal([feature_dimension, embedding_size],
-                               stddev=0.01, name="snd_order_embedding",
+                               stddev=stddev, name="snd_order_embedding",
                                is_embed=True, ctx=ht.cpu(0))
     emb = ht.embedding_lookup_op(table, sparse_input)
     emb = ht.array_reshape_op(emb, (-1, n_slots * embedding_size))
 
-    deep = mlp(dense_input, [n_dense, 256, 256, 256], "W", stddev=0.01)
+    deep = mlp(dense_input, [n_dense, 256, 256, 256], "W", stddev=stddev)
     joint = ht.concat_op(emb, deep, axis=1)
     w_out = init.random_normal([256 + n_slots * embedding_size, 1],
-                               stddev=0.01, name="W4")
+                               stddev=stddev, name="W4")
     y = ht.sigmoid_op(ht.matmul_op(joint, w_out))
     loss, train_op = bce_loss_and_train(y, y_, learning_rate)
     return loss, y, y_, train_op

@@ -165,9 +165,10 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);  // before any mutation
+        begin_update(*p);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
@@ -180,6 +181,7 @@ class PsServer {
         std::shared_lock<std::shared_mutex> g(p->mu);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);
         std::vector<float> out(nidx * p->width);
         for (size_t i = 0; i < nidx; ++i)
           std::memcpy(out.data() + i * p->width,
@@ -193,9 +195,10 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);  // before any mutation
+        begin_update(*p);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
@@ -208,15 +211,19 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        const int64_t* oidx = req.args[2].as_i64();
+        size_t no = req.args[2].n_i64();
+        // validate BOTH sides before any mutation: a rejected request must
+        // leave the param untouched or a client retry double-applies
+        check_rows(*p, idx, nidx);
+        check_rows(*p, oidx, no);
+        begin_update(*p);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
                        vals + i * p->width, p->width);
-        const int64_t* oidx = req.args[2].as_i64();
-        size_t no = req.args[2].n_i64();
         std::vector<float> out(no * p->width);
         for (size_t i = 0; i < no; ++i)
           std::memcpy(out.data() + i * p->width,
@@ -243,6 +250,7 @@ class PsServer {
         std::unique_lock<std::shared_mutex> g(p->mu);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           std::memcpy(p->data.data() + static_cast<size_t>(idx[i]) * p->width,
@@ -305,6 +313,7 @@ class PsServer {
         const int64_t* cver = req.args[1].as_i64();
         int64_t bound = req.args[2].as_i64()[0];
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);
         std::vector<int32_t> sel;
         std::vector<float> rows;
         std::vector<int64_t> vers;
@@ -328,9 +337,10 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        check_rows(*p, idx, nidx);  // before any mutation
+        begin_update(*p);
         const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
@@ -346,9 +356,16 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
+        const int64_t* sidx = req.args[3].as_i64();
+        const int64_t* cver = req.args[4].as_i64();
+        int64_t bound = req.args[5].as_i64()[0];
+        size_t ns = req.args[3].n_i64();
+        // validate BOTH sides before any mutation (rejected => untouched)
+        check_rows(*p, idx, nidx);
+        check_rows(*p, sidx, ns);
+        begin_update(*p);
         const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
@@ -356,10 +373,6 @@ class PsServer {
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
           p->versions[r] += ups[i];
         }
-        const int64_t* sidx = req.args[3].as_i64();
-        const int64_t* cver = req.args[4].as_i64();
-        int64_t bound = req.args[5].as_i64()[0];
-        size_t ns = req.args[3].n_i64();
         std::vector<int32_t> sel;
         std::vector<float> rows;
         std::vector<int64_t> vers;
@@ -417,6 +430,16 @@ class PsServer {
     if (!p)
       throw std::runtime_error("param " + std::to_string(key) +
                                " not initialized (call InitTensor first)");
+  }
+
+  // Client-supplied row ids come straight from user data; an out-of-range id
+  // must become an error response to the worker, not an OOB read/write here.
+  static void check_rows(const Param& p, const int64_t* idx, size_t nidx) {
+    for (size_t i = 0; i < nidx; ++i)
+      if (idx[i] < 0 || static_cast<size_t>(idx[i]) >= p.rows)
+        throw std::runtime_error(
+            "row id " + std::to_string(idx[i]) + " out of range [0, " +
+            std::to_string(p.rows) + ")");
   }
 
   std::string shard_path(const std::string& dir, int32_t key) const {

@@ -339,6 +339,12 @@ class PsWorker {
     sk.local.resize(servers_.size());
     sk.positions.resize(servers_.size());
     for (size_t u = 0; u < uniq.size(); ++u) {
+      // ids come straight from user data; an out-of-range id would index
+      // past sk.local below (row_owner returns an invalid server slot)
+      if (uniq[u] < 0 || static_cast<size_t>(uniq[u]) >= m.rows)
+        throw std::runtime_error(
+            "row id " + std::to_string(uniq[u]) + " out of range [0, " +
+            std::to_string(m.rows) + ")");
       size_t s = row_owner(m.rows, static_cast<size_t>(uniq[u]));
       sk.local[s].push_back(uniq[u] -
                             static_cast<int64_t>(row_range(m.rows, s).first));

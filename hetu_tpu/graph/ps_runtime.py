@@ -186,8 +186,12 @@ class PSRuntime:
                                      opt_type=opt["otype"], lrs=opt["lrs"])
                 if self.comm.rank == 0:
                     import jax
+                    # per-param key (fold in ps_id): same-shape derived-init
+                    # params must not share initial values, matching the
+                    # device path's per-param fold_in (executor.py)
                     value = np.asarray(
-                        p.node.instantiate(jax.random.PRNGKey(cfg.seed)),
+                        p.node.instantiate(jax.random.fold_in(
+                            jax.random.PRNGKey(cfg.seed), p.ps_id)),
                         dtype=np.float32)
                     # raw assignment: the value must not pass through the
                     # server optimizer (Adam would treat it as a gradient)

@@ -68,8 +68,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             p, v_blk, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
-    # causal: skip key blocks entirely above the diagonal
-    upper = num_kb if not causal else (q_start + block_q) // block_k
+    # causal: skip key blocks entirely above the diagonal (ceil division —
+    # flooring would drop the diagonal block whenever block_q < block_k)
+    upper = (num_kb if not causal
+             else (q_start + block_q + block_k - 1) // block_k)
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)

@@ -1,0 +1,13 @@
+"""Remote PS-server entry point for multi-machine launches.
+
+``hetu_tpu.runner`` starts remote servers over ssh as
+``SERVER_ID=<i> DMLC_ROLE=server python -m hetu_tpu.launcher_remote_server``
+(reference: runner.py spawns remote ps-lite servers via paramiko,
+python/runner.py:36-60). All topology comes from the DMLC_* env exported on
+the ssh command line; this module just blocks serving until killed.
+"""
+from hetu_tpu.launcher import start_server
+
+if __name__ == "__main__":
+    import os
+    start_server(server_id=int(os.environ.get("SERVER_ID", "0")))

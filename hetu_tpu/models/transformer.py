@@ -177,7 +177,7 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl):
     hd = q.shape[-1]
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         spec = P("dp", "tp", "sp", None)
         fn = shard_map(
             functools.partial(ring_attention, axis_name="sp", causal=True),

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from hetu_tpu.kernels.flash_attention import flash_attention, mha_reference
 from hetu_tpu.parallel.ring_attention import ring_attention
@@ -43,6 +43,19 @@ def test_flash_backward_matches_reference():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("block_q,block_k", [(64, 128), (32, 256), (128, 64)])
+def test_flash_causal_uneven_blocks(block_q, block_k):
+    """block_q != block_k regression: the causal key-block bound must use
+    ceil division — flooring drops the diagonal block when block_q < block_k
+    and the first query rows silently output zeros."""
+    q, k, v = _rand_qkv(np.random.RandomState(3))
+    out = flash_attention(q, k, v, causal=True,
+                          block_q=block_q, block_k=block_k)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_flash_nondivisible_raises():

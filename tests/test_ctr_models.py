@@ -51,19 +51,29 @@ def _train_criteo_model(model_name, steps=20, **kwargs):
     loss, y, labels, train_op = model_fn(dense, sparse, y_,
                                          feature_dimension=DIM,
                                          embedding_size=16, **kwargs)
-    ex = ht.Executor({"train": [loss, y, labels, train_op]}, ctx=ht.cpu(0))
+    # explicit seed: the default comes from numpy's global RNG, making
+    # convergence assertions depend on which tests ran earlier
+    ex = ht.Executor({"train": [loss, y, labels, train_op]}, ctx=ht.cpu(0),
+                     seed=42)
     losses = []
     for _ in range(steps):
         out = ex.run("train", convert_to_numpy_ret_vals=True)
-        losses.append(float(out[0]))
+        losses.append(float(np.mean(out[0])))
     assert np.all(np.isfinite(losses)), losses
     return losses
+
+
+# wdl_criteo's reference-scale 0.01 inits vanish through its 3-layer MLP
+# (activations shrink ~100x by the output); near-Xavier stddev + a larger lr
+# make 30-step convergence observable without changing the model defaults
+_TRAIN_KWARGS = {"wdl_criteo": dict(stddev=0.06, learning_rate=0.05)}
 
 
 @pytest.mark.parametrize("model_name", ["wdl_criteo", "dfm_criteo",
                                         "dcn_criteo", "dc_criteo"])
 def test_criteo_model_trains(model_name):
-    losses = _train_criteo_model(model_name, steps=30)
+    losses = _train_criteo_model(model_name, steps=30,
+                                 **_TRAIN_KWARGS.get(model_name, {}))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), (
         model_name, losses[:5], losses[-5:])
 
@@ -79,8 +89,9 @@ def test_wdl_adult_trains():
     X_wide = ht.dataloader_op([ht.Dataloader(tr_wide, 32, "train")])
     y_ = ht.dataloader_op([ht.Dataloader(tr_y, 32, "train")])
     loss, y, labels, train_op = models.wdl_adult(X_deep, X_wide, y_)
-    ex = ht.Executor({"train": [loss, y, labels, train_op]}, ctx=ht.cpu(0))
-    losses = [float(ex.run("train", convert_to_numpy_ret_vals=True)[0])
+    ex = ht.Executor({"train": [loss, y, labels, train_op]}, ctx=ht.cpu(0),
+                     seed=42)
+    losses = [float(np.mean(ex.run("train", convert_to_numpy_ret_vals=True)[0]))
               for _ in range(20)]
     assert np.all(np.isfinite(losses))
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
@@ -100,13 +111,13 @@ def test_ncf_trains():
     # logits ~1e-4, needing thousands of batches before loss visibly moves
     loss, y, train_op = neural_mf(user_in, item_in, y_, nu, ni,
                                   learning_rate=0.3, embed_stddev=0.3)
-    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0))
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=42)
     n = ex.get_batch_num("train")
     losses = []
     for _ in range(4):  # NCF needs a few epochs before the factors separate
         for _ in range(n):
-            losses.append(
-                float(ex.run("train", convert_to_numpy_ret_vals=True)[0]))
+            losses.append(float(np.mean(
+                ex.run("train", convert_to_numpy_ret_vals=True)[0])))
     assert np.all(np.isfinite(losses))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.01
 
@@ -126,8 +137,8 @@ def _wdl_hybrid_worker(client, rank, tmpdir):
     loss, y, labels, train_op = models.wdl_criteo(
         dense, sparse, y_, feature_dimension=DIM, embedding_size=16)
     ex = ht.Executor({"train": [loss, y, labels, train_op]}, ctx=ht.cpu(0),
-                     comm_mode="Hybrid")
-    losses = [float(ex.run("train", convert_to_numpy_ret_vals=True)[0])
+                     comm_mode="Hybrid", seed=42)
+    losses = [float(np.mean(ex.run("train", convert_to_numpy_ret_vals=True)[0]))
               for _ in range(20)]
     assert np.all(np.isfinite(losses)), losses
 

@@ -272,10 +272,36 @@ def _loads_recording(client, rank, tmpdir):
     assert loads.get("push", 0) == 64 * 4
 
 
+def _oob_row_ids(client, rank, tmpdir):
+    # out-of-range embedding ids (straight from user data) must come back as
+    # a clean error, not corrupt the server's heap
+    client.InitTensor(9, sparse=True, length=NITEM, width=ITEM_LEN,
+                      init_type="constant", init_a=0.0)
+    client.BarrierWorker()
+    bad = np.array([NITEM + 5], np.int64)
+    vals = np.ones((1, ITEM_LEN), np.float32)
+    try:
+        client.SparsePush(9, bad, vals)
+        client.Wait(9)
+        raise AssertionError("OOB row id did not raise")
+    except RuntimeError as e:
+        assert "out of range" in str(e), e
+    client.BarrierWorker()
+    # the server survived and the table is untouched
+    idx = np.arange(NITEM, dtype=np.int64)
+    out = client.SparsePull(9, idx, np.empty((NITEM, ITEM_LEN), np.float32))
+    client.Wait(9)
+    np.testing.assert_allclose(out, 0.0)
+
+
 # ---------------------------------------------------------------------------
 
 def test_ps_dense_ops(tmp_path):
     run_cluster(_dense_ops, tmp_path)
+
+
+def test_ps_oob_row_ids(tmp_path):
+    run_cluster(_oob_row_ids, tmp_path)
 
 
 def test_ps_random_init_consistency(tmp_path):
