@@ -1,0 +1,101 @@
+"""Data + model parallel MLP on the graph API.
+
+Port of the reference's ``examples/runner/parallel/data_model_pipeline_mlp.py``
+(Dispatch.py:35-49): an MLP whose middle matmul is tensor-parallel over a
+2-worker x 2-way model-parallel DeviceGroup, with the batch data-parallel
+across the workers. The reference runs one MPI rank per GPU and rewrites the
+graph into split/concat + P2P sends (context.py:184-274); here the tuple
+DeviceGroup becomes a (dp, tp) ``jax.sharding.Mesh`` and each ``ht.dispatch``
+marker becomes a GSPMD sharding constraint, so XLA inserts the collectives.
+
+Run (any host — provisions a virtual 4-device CPU mesh if needed):
+    python data_model_pipeline_mlp.py --split middle
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..', '..', '..'))
+from hetu_tpu.utils import ensure_devices
+
+
+def fc(x, shape, name, with_relu=True, ctx=None):
+    import hetu_tpu as ht
+    weight = ht.init.random_normal(
+        shape=shape, stddev=0.04, name=name + '_weight', ctx=ctx)
+    bias = ht.init.random_normal(
+        shape=shape[-1:], stddev=0.04, name=name + '_bias', ctx=ctx)
+    x = ht.matmul_op(x, weight)
+    x = x + ht.broadcastto_op(bias, x)
+    if with_relu:
+        x = ht.relu_op(x)
+    return x
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--steps', type=int, default=8)
+    parser.add_argument('--warmup', type=int, default=2)
+    parser.add_argument('--batch-size', type=int, default=128)
+    parser.add_argument('--learning-rate', type=float, default=0.00001)
+    parser.add_argument('--split', type=str, default='left',
+                        choices=('left', 'middle', 'right'))
+    args = parser.parse_args()
+
+    ensure_devices(4)
+    import hetu_tpu as ht
+
+    datasets = ht.data.mnist()
+    train_set_x, train_set_y = datasets[0]
+
+    # model parallel: 2 workers (dp) x 2-way tensor parallel (tp)
+    x = ht.Variable(name="dataloader_x", trainable=False)
+    activation = fc(x, (784, 256), 'mlp_fc1', with_relu=True)
+    weight = ht.init.random_normal(shape=(256, 512), stddev=0.04,
+                                   name='mlp_fc2_weight')
+    with ht.context([(ht.tpu(0), ht.tpu(1)), (ht.tpu(2), ht.tpu(3))]):
+        if args.split == 'left':
+            activation = ht.dispatch(activation, (2, 1))
+            weight = ht.dispatch(weight, (1, 1), duplicate=2)
+        elif args.split == 'right':
+            activation = ht.dispatch(activation, (1, 1), duplicate=2)
+            weight = ht.dispatch(weight, (1, 2))
+        else:
+            activation = ht.dispatch(activation, (1, 2))
+            weight = ht.dispatch(weight, (2, 1))
+        activation = ht.matmul_op(activation, weight)
+        activation = ht.dispatch(activation, (1, 1))
+
+    activation = ht.relu_op(activation)
+    y_pred = fc(activation, (512, 10), 'mlp_fc3', with_relu=False)
+    y_ = ht.Variable(name="dataloader_y", trainable=False)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y_pred, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=args.learning_rate)
+    train_op = opt.minimize(loss)
+
+    executor = ht.Executor([loss, train_op])
+    mesh = executor.config.mesh
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    n = train_set_x.shape[0]
+    start = None
+    for step in range(args.steps):
+        if step == args.warmup:
+            start = time.time()
+        lo = (step * args.batch_size) % max(1, n - args.batch_size)
+        loss_val, _ = executor.run(feed_dict={
+            x: train_set_x[lo:lo + args.batch_size],
+            y_: train_set_y[lo:lo + args.batch_size]},
+            convert_to_numpy_ret_vals=True)
+        print('step:', step, 'loss:', float(np.mean(loss_val)))
+    if start is not None:
+        print("time elapsed for {} steps: {}s".format(
+            args.steps - args.warmup, round(time.time() - start, 3)))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,8 +73,20 @@ class HetuConfig:
                 f"mesh must be a jax.sharding.Mesh, got {type(mesh).__name__}")
         self.mesh = mesh
         self.placeholder_to_arr_map = {}
+        self.param_specs: dict[int, P] = {}  # placeholder id -> PartitionSpec
+        self.has_dispatch = any(
+            isinstance(n, DispatchOp)
+            for n in find_topo_sort(self.eval_node_list))
         if self.mesh is None:
             self.mesh = self._deduce_mesh()
+        if self.has_dispatch and (
+                self.mesh is None or self.mp_axis not in self.mesh.axis_names):
+            raise ValueError(
+                "the graph contains ht.dispatch(...) tensor-parallel markers "
+                "but no model-parallel mesh axis exists; place the model-"
+                "parallel subgraph in a tuple DeviceGroup context (e.g. "
+                "ctx=[(tpu(0), tpu(1)), (tpu(2), tpu(3))] for 2 workers x "
+                f"2-way TP) or pass mesh= with a {self.mp_axis!r} axis")
         self.device = self._deduce_device()
 
     # -- device & mesh deduction -------------------------------------------
@@ -87,7 +99,41 @@ class HetuConfig:
             return DeviceGroup(list(self.ctx)).flat()
         return []
 
+    def _find_mp_group(self) -> Optional[DeviceGroup]:
+        """Largest model-parallel (tuple-containing) DeviceGroup attached to
+        the executor ctx or any graph node (reference context.py tuple syntax:
+        ``[(d0, d1), (d2, d3)]`` = 2 workers x 2-way model parallel)."""
+        best = None
+        candidates = []
+        if isinstance(self.ctx, DeviceGroup):
+            candidates.append(self.ctx)
+        for n in find_topo_sort(self.eval_node_list):
+            if isinstance(n.raw_ctx, DeviceGroup):
+                candidates.append(n.raw_ctx)
+        for g in candidates:
+            if g.is_mp and (best is None
+                            or g.mp_device_num > best.mp_device_num):
+                best = g
+        return best
+
     def _deduce_mesh(self) -> Optional[Mesh]:
+        mp_group = self._find_mp_group()
+        if mp_group is not None:
+            sizes = {len(c) for c in mp_group if isinstance(c, tuple)}
+            if len(sizes) != 1 or not all(
+                    isinstance(c, tuple) for c in mp_group):
+                raise ValueError(
+                    f"model-parallel DeviceGroup {mp_group} must consist of "
+                    "uniform tuples: [(d0, d1), (d2, d3)] = 2 workers x 2-way")
+            tp = sizes.pop()
+            dp = mp_group.worker_num
+            devs = [c.jax_device() for c in mp_group.flat()]
+            if len(set(devs)) != dp * tp:
+                raise ValueError(
+                    f"model-parallel DeviceGroup {mp_group} resolves to "
+                    f"{len(set(devs))} distinct devices, need {dp}x{tp}")
+            return Mesh(np.array(devs).reshape(dp, tp),
+                        (self.dp_axis, self.mp_axis))
         if self.comm_mode not in ("AllReduce", "Hybrid"):
             return None
         ctxs = self._ctx_list()
@@ -98,6 +144,12 @@ class HetuConfig:
         if len(devs) <= 1:
             return None
         return Mesh(np.array(devs), (self.dp_axis,))
+
+    @property
+    def dp_size(self) -> int:
+        if self.mesh is None or self.dp_axis not in self.mesh.axis_names:
+            return 1
+        return self.mesh.shape[self.dp_axis]
 
     def _deduce_device(self):
         ctxs = self._ctx_list()
@@ -125,30 +177,42 @@ class TraceContext:
         self.ps_grad_outputs: dict[int, Any] = {}
         self.grad_cache: dict[int, dict[int, Any]] = {}
         self._in_grad_retrace = False
+        # Fold the node's position WITHIN this topo, not its process-global
+        # id: global ids depend on how many nodes earlier code constructed,
+        # which made RNG streams (dropout etc.) vary with test order.
+        self._node_index = {id(n): i for i, n in enumerate(topo)}
 
     # -- RNG ---------------------------------------------------------------
     def next_rng(self, node: Op):
-        return jax.random.fold_in(self.rng_key, node.id)
+        return jax.random.fold_in(
+            self.rng_key, self._node_index.get(id(node), node.id))
 
     # -- collectives (GSPMD) ----------------------------------------------
-    def allreduce(self, x):
+    def allreduce(self, x, param_node=None):
         mesh = self.config.mesh
         if mesh is None:
             return x
-        # Constrain the gradient to be replicated: GSPMD inserts the psum
-        # over the dp axis (the MPI+NCCL module's job in the reference).
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        # Constrain the gradient to the target parameter's own spec: GSPMD
+        # inserts the psum over the dp axis (the MPI+NCCL module's job in the
+        # reference); a tp-sharded parameter's gradient stays tp-sharded.
+        spec = (self.config.param_specs.get(id(param_node), P())
+                if param_node is not None else P())
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
     def apply_dispatch(self, op: DispatchOp, x):
         mesh = self.config.mesh
         if mesh is None or self.config.mp_axis not in mesh.axis_names:
-            return x
-        dims: list = [None] * x.ndim
-        for i, p in enumerate(op.parts):
-            if p > 1:
-                dims[i] = self.config.mp_axis
-                break
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*dims)))
+            raise ValueError(
+                f"{op.name}: dispatch requires a mesh with a "
+                f"{self.config.mp_axis!r} axis (HetuConfig should have "
+                "raised at construction)")
+        if len(op.parts) != x.ndim:
+            raise ValueError(
+                f"{op.name}: parts {op.parts} does not match input rank "
+                f"{x.ndim}")
+        spec = op.partition_spec(mesh, self.config.dp_axis,
+                                 self.config.mp_axis)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
     # -- pipeline / PS hooks (installed by their runtimes) ------------------
     def pipeline_send(self, op, x):
@@ -369,7 +433,8 @@ class SubExecutor:
         for node in self.feed_nodes:
             if node not in feed_dict:
                 raise ValueError(f"Missing feed for placeholder {node.name!r}")
-            feed_vals.append(ex._prepare_input(feed_dict[node]))
+            feed_vals.append(ex._prepare_input(feed_dict[node],
+                                               batch=getattr(node, "batch", True)))
         batch_host = {id(n): np.asarray(n.get_batch(self.name))
                       for n in self.dataloader_nodes}
         batch_vals = [ex._prepare_input(batch_host[id(n)])
@@ -384,7 +449,8 @@ class SubExecutor:
             staged_idx[id(op)] = idx
             rows = ps.stage_lookup(ps.params[id(op.embed_node)], idx)
             ps_staged_vals.append(ex._prepare_input(rows))
-        ps_dense_vals = [ex._prepare_input(ps.params[id(n)].host_value)
+        ps_dense_vals = [ex._prepare_input(ps.params[id(n)].host_value,
+                                           batch=False)
                          for n in self.ps_dense_vars]
 
         key = self._signature(feed_vals, batch_vals) + (
@@ -484,16 +550,28 @@ class Executor:
                             and id(n) not in ps_resident]
         self.rng_root = jax.random.PRNGKey(config.seed)
 
+        # -- tensor-parallel parameter shardings ----------------------------
+        # a dispatch marker directly on a trainable Variable pins that
+        # parameter's layout for its whole lifetime (init, updates, ckpt) —
+        # the weight is *stored* split over the model axis, never gathered
+        if config.mesh is not None \
+                and config.mp_axis in config.mesh.axis_names:
+            for node in full_topo:
+                if isinstance(node, DispatchOp) \
+                        and getattr(node.inputs[0], "trainable", False):
+                    config.param_specs[id(node.inputs[0])] = \
+                        node.partition_spec(config.mesh, config.dp_axis,
+                                            config.mp_axis)
+
         # -- parameter initialization (reference initializers.py) ----------
-        sharding = (NamedSharding(config.mesh, P())
-                    if config.mesh is not None else None)
         params = {}
         for i, node in enumerate(self.param_nodes):
             init_rng = jax.random.fold_in(self.rng_root, 2**20 + i)
             value = node.instantiate(init_rng)
             value = jnp.asarray(value, dtype=node.dtype)
-            if sharding is not None:
-                value = jax.device_put(value, sharding)
+            if config.mesh is not None:
+                spec = config.param_specs.get(id(node), P())
+                value = jax.device_put(value, NamedSharding(config.mesh, spec))
             elif config.device is not None:
                 value = jax.device_put(value, config.device)
             params[id(node)] = value
@@ -550,7 +628,15 @@ class Executor:
                 if x is var:
                     xs[i] = lookup
 
-    def _prepare_input(self, value):
+    def _prepare_input(self, value, batch=True):
+        """Stage one host value onto the device/mesh.
+
+        ``batch`` says whether dim 0 is a batch dimension to shard over the
+        dp axis (feeds/dataloader batches: yes by default, overridable per
+        placeholder via ``ht.Variable(..., batch=False)``; whole parameters:
+        no). An earlier divisibility heuristic sharded any conveniently-
+        shaped feed, silently corrupting non-batch inputs.
+        """
         if isinstance(value, NDArray):
             value = value.handle
         if isinstance(value, ND_Sparse_Array):
@@ -560,9 +646,12 @@ class Executor:
         if arr.dtype == np.float64:
             arr = arr.astype(np.float32)
         mesh = self.config.mesh
-        if mesh is not None and arr.ndim >= 1 and arr.shape[0] % mesh.size == 0:
-            return jax.device_put(
-                arr, NamedSharding(mesh, P(self.config.dp_axis)))
+        if mesh is not None:
+            dp = self.config.dp_size
+            if batch and arr.ndim >= 1 and dp > 1 and arr.shape[0] % dp == 0:
+                return jax.device_put(
+                    arr, NamedSharding(mesh, P(self.config.dp_axis)))
+            return jax.device_put(arr, NamedSharding(mesh, P()))
         if self.config.device is not None:
             return jax.device_put(arr, self.config.device)
         return jnp.asarray(arr)
@@ -618,8 +707,9 @@ class Executor:
             if os.path.exists(path):
                 value = jnp.asarray(np.load(path), dtype=node.dtype)
                 if self.config.mesh is not None:
+                    spec = self.config.param_specs.get(id(node), P())
                     value = jax.device_put(
-                        value, NamedSharding(self.config.mesh, P()))
+                        value, NamedSharding(self.config.mesh, spec))
                 elif self.config.device is not None:
                     value = jax.device_put(value, self.config.device)
                 self.state["params"][id(node)] = value

@@ -156,11 +156,15 @@ class PlaceholderOp(Op):
     is_placeholder = True
 
     def __init__(self, name, value=None, initializer=None, trainable=None,
-                 dtype=np.float32, ctx=None, **kwargs):
+                 dtype=np.float32, ctx=None, batch=None, **kwargs):
         super().__init__([], ctx, name)
         self.initializer = initializer
         self.dtype = np.dtype(dtype)
         self.is_embed = bool(kwargs.get("is_embed", False))
+        # is dim 0 a batch dimension (shardable over dp)? Fed placeholders
+        # default to yes (reference: each DP worker feeds its own shard);
+        # pass batch=False for non-batch feeds like constant masks.
+        self.batch = True if batch is None else bool(batch)
         if value is not None and not isinstance(value, np.ndarray):
             value = np.asarray(value, dtype=self.dtype)
         self.value = value
@@ -195,10 +199,11 @@ class PlaceholderOp(Op):
 
 
 def Variable(name, value=None, initializer=None, trainable=None, dtype=np.float32,
-             ctx=None, **kwargs):
+             ctx=None, batch=None, **kwargs):
     """Create a variable/placeholder node (reference gpu_ops/Variable.py)."""
     return PlaceholderOp(name, value=value, initializer=initializer,
-                         trainable=trainable, dtype=dtype, ctx=ctx, **kwargs)
+                         trainable=trainable, dtype=dtype, ctx=ctx,
+                         batch=batch, **kwargs)
 
 
 placeholder_op = Variable

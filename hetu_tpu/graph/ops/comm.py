@@ -22,19 +22,23 @@ class AllReduceCommunicateOp(Op):
 
     Under GSPMD data parallelism the psum is inserted by XLA when the
     (batch-sharded) gradient meets the (replicated) parameter update; this op
-    pins that contract with an explicit replication constraint.
+    pins that contract with an explicit replication constraint. With tensor
+    parallelism the target parameter may itself be sharded over the model
+    axis, so the constraint is the parameter's own spec (reduce over dp,
+    stay split over tp) — ``param_node`` carries that association.
     """
 
-    def __init__(self, node, comm=None, ctx=None):
+    def __init__(self, node, comm=None, ctx=None, param_node=None):
         super().__init__([node], ctx)
         self.comm = comm
+        self.param_node = param_node
 
     def compute(self, input_vals, tc):
-        return tc.allreduce(input_vals[0])
+        return tc.allreduce(input_vals[0], self.param_node)
 
 
-def allreduceCommunicate_op(node, comm=None, ctx=None):
-    return AllReduceCommunicateOp(node, comm, ctx)
+def allreduceCommunicate_op(node, comm=None, ctx=None, param_node=None):
+    return AllReduceCommunicateOp(node, comm, ctx, param_node)
 
 
 class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
@@ -109,6 +113,41 @@ class DispatchOp(Op):
         super().__init__([node], ctx)
         self.parts = tuple(int(p) for p in parts)
         self.duplicate = int(duplicate)
+        split_dims = [i for i, p in enumerate(self.parts) if p > 1]
+        if len(split_dims) > 1:
+            raise NotImplementedError(
+                f"dispatch parts {self.parts}: at most one partitioned "
+                "dimension is supported (the reference restricts dispatch to "
+                "1->N / N->1 transitions the same way, Dispatch.py:35-49)")
+        self.split_dim = split_dims[0] if split_dims else None
+
+    def partition_spec(self, mesh, dp_axis, mp_axis):
+        """PartitionSpec this marker denotes on ``mesh``.
+
+        The partitioned dim maps onto the model axis. For non-parameter
+        inputs dim 0 is the (dp-sharded) batch dim, so it keeps the dp axis —
+        reference semantics: dispatch splits *within* a worker's model-
+        parallel group while data parallelism replicates across groups.
+        """
+        from jax.sharding import PartitionSpec as P
+        # trainable (not is_placeholder): a fed placeholder IS batch data,
+        # only a stored parameter has no batch dimension
+        is_param = getattr(self.inputs[0], "trainable", False)
+        ndim = len(self.parts)
+        dims: list = [None] * ndim
+        if self.split_dim is not None:
+            tp_size = mesh.shape[mp_axis]
+            if self.parts[self.split_dim] != tp_size:
+                raise ValueError(
+                    f"dispatch parts {self.parts} split {self.parts[self.split_dim]}-way "
+                    f"but the model-parallel axis has {tp_size} devices")
+            dims[self.split_dim] = mp_axis
+        if not is_param and ndim >= 1 and dp_axis in mesh.axis_names:
+            if dims[0] is None:
+                dims[0] = dp_axis
+            elif dims[0] == mp_axis:
+                dims[0] = (dp_axis, mp_axis)
+        return P(*dims)
 
     def compute(self, input_vals, tc):
         return tc.apply_dispatch(self, input_vals[0])

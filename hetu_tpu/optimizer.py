@@ -175,7 +175,8 @@ class OptimizerOp(Op):
         for var, grad in zip(self.vars, self.inputs):
             sparse = getattr(var, "is_embed", False)
             if mode == "AllReduce" or (mode == "Hybrid" and not sparse):
-                new_inputs.append(allreduceCommunicate_op(grad))
+                new_inputs.append(allreduceCommunicate_op(grad,
+                                                          param_node=var))
             elif mode == "PS" or (mode == "Hybrid" and sparse):
                 new_inputs.append(parameterServerCommunicate_op(
                     grad, ps_id=var.name, optimizer=self.optimizer))

@@ -1,0 +1,26 @@
+"""Shared utilities for examples, tests and the driver entry points."""
+from __future__ import annotations
+
+import jax
+
+
+def ensure_devices(n_devices: int) -> None:
+    """Ensure >= n_devices jax devices exist, forcing a virtual CPU mesh if
+    the host has fewer real chips (the reference requires a physical GPU per
+    rank; the TPU build validates multi-chip layouts on virtual devices,
+    SURVEY.md §4's local-process-cluster strategy).
+
+    Works whether or not backends are initialized: clear first, then
+    reconfigure — ``jax_num_cpu_devices`` refuses updates while a backend is
+    live, and a sitecustomize may pin another platform, so the config updates
+    are authoritative, not env vars.
+    """
+    if len(jax.devices()) >= n_devices:
+        return
+    import jax.extend.backend as jax_backend
+    jax_backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    assert len(jax.devices()) >= n_devices, (
+        f"virtual CPU mesh provisioning failed: need {n_devices}, "
+        f"got {len(jax.devices())}")
