@@ -1,0 +1,97 @@
+"""GPipe pipeline-parallel MLP on the graph API.
+
+Port of the reference's ``examples/runner/parallel/gpipe.py``: one MLP layer
+per pipeline stage (``with ht.context(...)`` per stage),
+``Executor([loss, train_op], gpipe=True)``, and ``run()`` on a list of
+microbatch feed_dicts. The reference runs one MPI rank per GPU with NCCL
+send/recv between stages (SubExecutor4Gpipe, gpu_ops/executor.py:435-767);
+here each stage compiles to jitted XLA programs on its own device and JAX's
+async dispatch overlaps the microbatch fill/drain.
+
+Run (any host — provisions a virtual 4-device CPU mesh if needed):
+    python gpipe.py --stages 4 --micro-batches-num 8
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..', '..', '..'))
+from hetu_tpu.utils import ensure_devices
+
+
+def fc(x, shape, name, with_relu=True):
+    import hetu_tpu as ht
+    weight = ht.init.random_normal(shape, stddev=0.04, name=name + '_weight')
+    bias = ht.init.random_normal(shape[-1:], stddev=0.04, name=name + '_bias')
+    x = ht.matmul_op(x, weight)
+    x = x + ht.broadcastto_op(bias, x)
+    if with_relu:
+        x = ht.relu_op(x)
+    return x
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--epochs', type=int, default=4)
+    parser.add_argument('--warmup', type=int, default=1)
+    parser.add_argument('--stages', type=int, default=4)
+    parser.add_argument('--batch-size', type=int, default=256)
+    parser.add_argument('--micro-batches-num', type=int, default=8)
+    parser.add_argument('--learning-rate', type=float, default=0.1)
+    args = parser.parse_args()
+
+    ensure_devices(args.stages)
+    import hetu_tpu as ht
+
+    datasets = ht.data.mnist()
+    train_set_x, train_set_y = datasets[0]
+
+    # pipeline parallel: one fc layer per stage
+    with ht.context(ht.tpu(0)):
+        x = ht.Variable(name="dataloader_x", trainable=False)
+        activation = fc(x, (784, 512), 'mlp_fc1', with_relu=True)
+
+    for i in range(1, args.stages - 1):
+        with ht.context(ht.tpu(i)):
+            activation = fc(activation, (512, 512), 'mlp_fc%d' % (i + 1),
+                            with_relu=True)
+
+    with ht.context(ht.tpu(args.stages - 1)):
+        y_pred = fc(activation, (512, 10), 'mlp_fc_out', with_relu=False)
+        y_ = ht.Variable(name="dataloader_y", trainable=False)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(y_pred, y_), [0])
+        opt = ht.optim.SGDOptimizer(learning_rate=args.learning_rate)
+        train_op = opt.minimize(loss)
+        executor = ht.Executor([loss, train_op], gpipe=True)
+
+    M = args.micro_batches_num
+    steps = train_set_x.shape[0] // (M * args.batch_size)
+    start_time = None
+    for epoch in range(args.epochs):
+        loss_vals = []
+        if epoch == args.warmup:
+            start_time = time.time()
+        for step in range(steps):
+            feed_dicts_list = []
+            for i in range(M):
+                lo = (step * M + i) * args.batch_size
+                hi = lo + args.batch_size
+                feed_dicts_list.append({x: train_set_x[lo:hi],
+                                        y_: train_set_y[lo:hi]})
+            ret = executor.run(feed_dict=feed_dicts_list,
+                               convert_to_numpy_ret_vals=True)
+            loss_vals.extend(float(np.mean(v)) for v in ret[0])
+        print('epoch: {}, mean loss: {:.4f}, min loss: {:.4f}, max loss: '
+              '{:.4f}'.format(epoch, np.mean(loss_vals), np.min(loss_vals),
+                              np.max(loss_vals)))
+    if start_time is not None:
+        print("time elapsed for {} epochs: {}s".format(
+            args.epochs - args.warmup, round(time.time() - start_time, 3)))
+
+
+if __name__ == "__main__":
+    main()

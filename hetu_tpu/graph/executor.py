@@ -590,10 +590,16 @@ class Executor:
         self.state = {"params": params, "slots": slots, "op_state": op_state,
                       "step": 0}
 
-        self.subexecutors = {
-            name: SubExecutor(name, nodes, self)
-            for name, nodes in self.eval_node_dict.items()
-        }
+        self.subexecutors = {}
+        for name, nodes in self.eval_node_dict.items():
+            if config.gpipe:
+                # every target pipelines (forward-only for validation
+                # entries): params commit to per-stage devices, so a plain
+                # single-device SubExecutor could not touch them anyway
+                from .gpipe import SubExecutor4Gpipe
+                self.subexecutors[name] = SubExecutor4Gpipe(name, nodes, self)
+            else:
+                self.subexecutors[name] = SubExecutor(name, nodes, self)
 
     # ------------------------------------------------------------------
     def _rewire_ps_gradients(self, topo):
@@ -656,9 +662,15 @@ class Executor:
             return jax.device_put(arr, self.config.device)
         return jnp.asarray(arr)
 
+    @property
+    def rank(self) -> int:
+        """Reference examples gate printing on ``executor.rank``; the
+        single-program TPU build is logically rank 0 of one process."""
+        return jax.process_index()
+
     def run(self, name="default", eval_node_list=None, feed_dict=None,
             convert_to_numpy_ret_vals=False, **kwargs):
-        if isinstance(name, dict):  # run(feed_dict) legacy form
+        if isinstance(name, (dict, list, tuple)):  # run(feed_dict) legacy form
             feed_dict, name = name, "default"
         sub = self.subexecutors[name]
         return sub.run(feed_dict=feed_dict,

@@ -1,0 +1,125 @@
+"""Graph-API GPipe pipeline (Executor(..., gpipe=True)) on virtual devices.
+
+Reference: ``SubExecutor4Gpipe`` (gpu_ops/executor.py:435-767) and the
+``examples/runner/parallel/gpipe.py`` user surface: per-stage
+``ht.context(...)`` blocks, run() on a LIST of microbatch feed_dicts,
+optimizer applied once after all microbatches. Correctness oracle (which the
+reference never had): the pipeline step must match a single-device step on
+the concatenated batch exactly.
+"""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+
+
+def _build_mlp(stage_ctxs):
+    """4-layer MLP, one layer per stage context (None = single device)."""
+    rng = np.random.RandomState(0)
+    dims = [20, 32, 32, 16, 10]
+    ws = [(rng.randn(dims[i], dims[i + 1]) * 0.2).astype(np.float32)
+          for i in range(4)]
+
+    def fc(h, i, ctx):
+        w = ht.Variable(f"w{i}", value=ws[i].copy(), ctx=ctx)
+        h = ht.matmul_op(h, w, ctx=ctx)
+        return ht.relu_op(h, ctx=ctx) if i < 3 else h
+
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y", trainable=False)
+    h = x
+    var_nodes = []
+    for i in range(4):
+        ctx = stage_ctxs[i] if stage_ctxs else None
+        h = fc(h, i, ctx)
+    last_ctx = stage_ctxs[-1] if stage_ctxs else None
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(h, y_, ctx=last_ctx), [0], ctx=last_ctx)
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return x, y_, loss, train_op
+
+
+def _data(n, seed):
+    rng = np.random.RandomState(seed)
+    xv = rng.randn(n, 20).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return xv, yv
+
+
+def test_gpipe_matches_single_device():
+    M, mb = 4, 8
+    xv, yv = _data(M * mb, seed=3)
+
+    # oracle: one device, full concatenated batch, mean loss
+    x, y_, loss, train_op = _build_mlp(None)
+    ex1 = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=5)
+    oracle_losses, oracle_params = [], None
+    for _ in range(3):
+        lv, _ = ex1.run("train", feed_dict={x: xv, y_: yv},
+                        convert_to_numpy_ret_vals=True)
+        oracle_losses.append(float(np.mean(lv)))
+    oracle_params = [np.asarray(v) for v in ex1.state["params"].values()]
+
+    # pipeline: 4 stages on 4 devices, M microbatches
+    ctxs = [ht.cpu(i) for i in range(4)]
+    x, y_, loss, train_op = _build_mlp(ctxs)
+    exp = ht.Executor({"train": [loss, train_op]}, gpipe=True, seed=5)
+    pipe_losses = []
+    for _ in range(3):
+        fdl = [{x: xv[m * mb:(m + 1) * mb], y_: yv[m * mb:(m + 1) * mb]}
+               for m in range(M)]
+        ret = exp.run("train", feed_dict=fdl, convert_to_numpy_ret_vals=True)
+        # per-microbatch losses; their mean is the full-batch mean
+        pipe_losses.append(float(np.mean([np.mean(v) for v in ret[0]])))
+    pipe_params = [np.asarray(v) for v in exp.state["params"].values()]
+
+    np.testing.assert_allclose(oracle_losses, pipe_losses,
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(oracle_params, pipe_params):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_stage_devices_distinct():
+    ctxs = [ht.cpu(i) for i in range(4)]
+    x, y_, loss, train_op = _build_mlp(ctxs)
+    exp = ht.Executor({"train": [loss, train_op]}, gpipe=True, seed=5)
+    sub = exp.subexecutors["train"]
+    devs = [st.device for st in sub.stages]
+    assert len(set(devs)) == 4, devs
+    # params live on their stage's device after a step
+    xv, yv = _data(8, seed=1)
+    exp.run("train", feed_dict=[{x: xv, y_: yv}])
+    for st in sub.stages:
+        for node in st.param_nodes:
+            assert exp.state["params"][id(node)].devices() == {st.device}
+
+
+def test_gpipe_validate_entry_pipelines():
+    """A forward-only eval target must also run through the stage pipeline:
+    after a train step the params are committed to per-stage devices."""
+    ctxs = [ht.cpu(i) for i in range(4)]
+    x, y_, loss, train_op = _build_mlp(ctxs)
+    exp = ht.Executor({"train": [loss, train_op], "validate": [loss]},
+                      gpipe=True, seed=5)
+    xv, yv = _data(16, seed=2)
+    fdl = [{x: xv[:8], y_: yv[:8]}, {x: xv[8:], y_: yv[8:]}]
+    exp.run("train", feed_dict=fdl)
+    ret = exp.run("validate", feed_dict=fdl, convert_to_numpy_ret_vals=True)
+    vals = [float(np.mean(v)) for v in ret[0]]
+    assert len(vals) == 2 and np.all(np.isfinite(vals))
+    # validation must not advance training state
+    assert exp.state["step"] == 1
+
+
+def test_gpipe_without_stage_contexts_raises():
+    x, y_, loss, train_op = _build_mlp(None)
+    with pytest.raises(ValueError, match="context"):
+        ht.Executor({"train": [loss, train_op]}, gpipe=True, ctx=ht.cpu(0))
+
+
+def test_gpipe_microbatch_list_required():
+    ctxs = [ht.cpu(i) for i in range(4)]
+    x, y_, loss, train_op = _build_mlp(ctxs)
+    exp = ht.Executor({"train": [loss, train_op]}, gpipe=True)
+    with pytest.raises(ValueError, match="microbatch"):
+        exp.run("train", feed_dict=None)
