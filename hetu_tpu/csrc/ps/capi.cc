@@ -17,7 +17,10 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <atomic>
+#include <chrono>
 #include <string>
+#include <thread>
 
 #include "scheduler.h"
 #include "server.h"
@@ -27,7 +30,8 @@ namespace {
 
 std::unique_ptr<hetups::Scheduler> g_scheduler;
 std::unique_ptr<hetups::PsServer> g_server;
-std::unique_ptr<hetups::Conn> g_server_sched_conn;  // server's scheduler link
+std::shared_ptr<hetups::Conn> g_server_sched_conn;  // server's scheduler link
+std::shared_ptr<std::atomic<bool>> g_server_hb_stop;  // keepalive kill switch
 std::unique_ptr<hetups::PsWorker> g_worker;
 std::string g_last_error;
 std::string g_loads;
@@ -37,10 +41,7 @@ const char* env_or(const char* k, const char* dflt) {
   return v ? v : dflt;
 }
 
-int env_int(const char* k, int dflt) {
-  const char* v = std::getenv(k);
-  return v ? std::atoi(v) : dflt;
-}
+using hetups::env_int_or;  // shared with net.h (empty value -> default)
 
 template <typename F>
 void guard(F&& f) {
@@ -81,9 +82,9 @@ void Init() {
   guard([] {
     std::string role = env_or("DMLC_ROLE", "worker");
     std::string root = env_or("DMLC_PS_ROOT_URI", "127.0.0.1");
-    int root_port = env_int("DMLC_PS_ROOT_PORT", 13200);
-    int n_workers = env_int("DMLC_NUM_WORKER", 1);
-    int n_servers = env_int("DMLC_NUM_SERVER", 1);
+    int root_port = env_int_or("DMLC_PS_ROOT_PORT", 13200);
+    int n_workers = env_int_or("DMLC_NUM_WORKER", 1);
+    int n_servers = env_int_or("DMLC_NUM_SERVER", 1);
     if (role == "scheduler") {
       if (g_scheduler) return;
       g_scheduler = std::make_unique<hetups::Scheduler>(root_port, n_servers,
@@ -91,13 +92,13 @@ void Init() {
       g_scheduler->start();
     } else if (role == "server") {
       if (g_server) return;
-      int id = env_int("SERVER_ID", 0);
-      int port = env_int("DMLC_PS_SERVER_PORT", 13201 + 2 * id);
+      int id = env_int_or("SERVER_ID", 0);
+      int port = env_int_or("DMLC_PS_SERVER_PORT", 13201 + 2 * id);
       std::string host = env_or("DMLC_PS_SERVER_URI", "127.0.0.1");
       g_server = std::make_unique<hetups::PsServer>(id, host, port);
       g_server->start();
       // register the listen address with the scheduler
-      g_server_sched_conn = std::make_unique<hetups::Conn>(
+      g_server_sched_conn = std::make_shared<hetups::Conn>(
           hetups::connect_to(root, root_port));
       hetups::Message reg;
       reg.head.type = static_cast<int32_t>(hetups::PsfType::kRegister);
@@ -108,9 +109,32 @@ void Init() {
       hetups::Message book;
       if (!g_server_sched_conn->recv(&book))
         throw std::runtime_error("scheduler closed during server registration");
+      // periodic keepalive so the scheduler can report this server dead to
+      // workers when it stops arriving (reference van.cc:27,569). Detached,
+      // with shared ownership of the conn and stop flag: a server process
+      // that exits without Finalize must not std::terminate in a joinable
+      // thread's destructor.
+      int hb_ms = env_int_or("DMLC_PS_HEARTBEAT_MS", 1000);
+      g_server_hb_stop = std::make_shared<std::atomic<bool>>(false);
+      std::thread([id, hb_ms, conn = g_server_sched_conn,
+                   stop = g_server_hb_stop] {
+        while (!*stop) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(hb_ms));
+          if (*stop) break;
+          hetups::Message hb;
+          hb.head.type = static_cast<int32_t>(hetups::PsfType::kHeartbeat);
+          int32_t meta[2] = {0, id};
+          hb.args.push_back(hetups::Arg::i32(meta, 2));
+          try {
+            conn->send(hb);
+          } catch (...) {
+            break;  // scheduler gone; nothing to keep alive for
+          }
+        }
+      }).detach();
     } else {  // worker
       if (g_worker) return;
-      int id = env_int("WORKER_ID", 0);
+      int id = env_int_or("WORKER_ID", 0);
       g_worker = std::make_unique<hetups::PsWorker>(id, n_workers, root,
                                                     root_port);
     }
@@ -132,6 +156,7 @@ void Finalize() {
       g_worker.reset();
     }
     if (g_server) {
+      if (g_server_hb_stop) *g_server_hb_stop = true;
       if (g_server_sched_conn) {
         hetups::Message bye;
         bye.head.type = static_cast<int32_t>(hetups::PsfType::kShutdown);

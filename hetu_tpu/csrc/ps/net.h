@@ -14,11 +14,15 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <stdexcept>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace hetups {
@@ -36,6 +40,8 @@ enum class PsfType : int32_t {
   kBarrier = 2,        // worker -> scheduler -> worker
   kShutdown = 3,
   kAck = 4,
+  kHeartbeat = 5,      // server -> scheduler keepalive (reference van.cc:27,569)
+  kQueryServers = 6,   // any -> scheduler: current address book + liveness
   // dense
   kDensePush = 10,
   kDensePull = 11,
@@ -64,9 +70,11 @@ enum class PsfType : int32_t {
 struct MsgHeader {
   int32_t type = 0;       // PsfType
   int32_t tensor_id = 0;  // node_name in the reference C API
-  uint64_t req_id = 0;
+  uint64_t req_id = 0;    // per-client monotonic; servers dedup resends on it
   int32_t n_args = 0;
   int32_t flags = 0;
+  int32_t client_id = -1; // worker rank (for resend dedup); -1 = untracked
+  int32_t pad = 0;
 };
 
 enum class ArgType : int32_t { kF32 = 0, kI64 = 1, kF64 = 2, kBytes = 3, kI32 = 4, kU64 = 5 };
@@ -170,6 +178,19 @@ inline bool recv_msg(int fd, Message* m) {
   return true;
 }
 
+// Bound every blocking recv so a dead peer surfaces as an error instead of a
+// hang (the role of the reference's resender timeouts, resender.h:116).
+inline void set_recv_timeout(int fd, int ms) {
+  if (ms <= 0) return;
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+inline int env_int_or(const char* name, int dflt) {
+  const char* v = ::getenv(name);
+  return v && *v ? std::atoi(v) : dflt;
+}
+
 inline int listen_on(const std::string& host, int port, int backlog = 128) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) throw std::runtime_error("hetups: socket() failed");
@@ -225,6 +246,53 @@ inline int connect_to(const std::string& host, int port, int retries = 600,
   throw std::runtime_error("hetups: connect to " + host + ":" +
                            std::to_string(port) + " timed out");
 }
+
+// Connection-thread registry that reaps finished threads as new connections
+// arrive: short-lived connections (scheduler liveness queries, worker
+// reconnects) would otherwise accumulate joinable thread handles for the
+// life of the process.
+class ConnThreads {
+ public:
+  template <typename F>
+  void spawn(F&& f) {
+    reap();
+    auto done = std::make_shared<std::atomic<bool>>(false);
+    std::lock_guard<std::mutex> g(mu_);
+    threads_.push_back(
+        {std::thread([fn = std::forward<F>(f), done]() mutable {
+           fn();
+           *done = true;
+         }),
+         done});
+  }
+
+  void reap() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = threads_.begin(); it != threads_.end();) {
+      if (it->done->load()) {
+        it->t.join();
+        it = threads_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void join_all() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& e : threads_)
+      if (e.t.joinable()) e.t.join();
+    threads_.clear();
+  }
+
+ private:
+  struct Entry {
+    std::thread t;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+  std::mutex mu_;
+  std::vector<Entry> threads_;
+};
 
 // A connection whose requests may be issued from many threads: writes are
 // serialized by a mutex; responses are matched by req_id by a reader thread.

@@ -8,6 +8,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <mutex>
 #include <thread>
@@ -42,9 +43,7 @@ class Scheduler {
       std::lock_guard<std::mutex> g(fds_mu_);
       for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
     }
-    for (auto& t : conn_threads_)
-      if (t.joinable()) t.join();
-    conn_threads_.clear();
+    conn_threads_.join_all();
   }
 
   // Blocks until every node has sent kShutdown (clean cluster teardown).
@@ -60,7 +59,7 @@ class Scheduler {
     while (running_) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) break;
-      conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+      conn_threads_.spawn([this, fd] { serve_conn(fd); });
     }
   }
 
@@ -86,9 +85,35 @@ class Scheduler {
               break;
             }
             if (server_addrs_.size() <
-                static_cast<size_t>(num_servers_))
+                static_cast<size_t>(num_servers_)) {
               server_addrs_.resize(num_servers_);
+              last_hb_.resize(num_servers_);
+            }
+            bool readd = !server_addrs_[meta[1]].empty();
             server_addrs_[meta[1]] = host + ":" + std::to_string(meta[2]);
+            last_hb_[meta[1]] = Clock::now();
+            if (readd) {
+              // recovery re-add (reference van.cc:47's recovery-node path):
+              // the cluster is already assembled, answer immediately so the
+              // replacement can start serving
+              std::fprintf(stderr,
+                           "[hetups scheduler] server %d re-registered "
+                           "(recovery) at %s\n",
+                           meta[1], server_addrs_[meta[1]].c_str());
+              Message rsp;
+              rsp.head.type = static_cast<int32_t>(PsfType::kAddressBook);
+              rsp.head.req_id = req.head.req_id;
+              std::string book;
+              for (auto& a : server_addrs_) book += a + "\n";
+              rsp.args.push_back(Arg::str(book));
+              g.unlock();
+              try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;  // peer vanished; drop the connection, not the scheduler
+          }
+              break;
+            }
             ++servers_seen_;
           } else {
             ++workers_seen_;
@@ -104,7 +129,48 @@ class Scheduler {
           rsp.head.req_id = req.head.req_id;
           rsp.args.push_back(Arg::str(book));
           g.unlock();
-          send_msg(fd, rsp);
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;  // peer vanished; drop the connection, not the scheduler
+          }
+          break;
+        }
+        case PsfType::kHeartbeat: {
+          // args: i32[role, id] — one-way keepalive (reference van.cc:569)
+          const int32_t* meta = req.args[0].as_i32();
+          std::lock_guard<std::mutex> g(mu_);
+          if (meta[0] == 0 && meta[1] >= 0 &&
+              static_cast<size_t>(meta[1]) < last_hb_.size())
+            last_hb_[meta[1]] = Clock::now();
+          break;
+        }
+        case PsfType::kQueryServers: {
+          // reply: str book, i32 alive[num_servers] (1 = heartbeat fresh)
+          std::unique_lock<std::mutex> g(mu_);
+          std::string book;
+          for (auto& a : server_addrs_) book += a + "\n";
+          std::vector<int32_t> alive(server_addrs_.size(), 0);
+          auto now = Clock::now();
+          for (size_t i = 0; i < server_addrs_.size(); ++i) {
+            auto age = std::chrono::duration_cast<std::chrono::milliseconds>(
+                           now - last_hb_[i])
+                           .count();
+            alive[i] = (!server_addrs_[i].empty() && age <= hb_timeout_ms_)
+                           ? 1
+                           : 0;
+          }
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAddressBook);
+          rsp.head.req_id = req.head.req_id;
+          rsp.args.push_back(Arg::str(book));
+          rsp.args.push_back(Arg::i32(alive.data(), alive.size()));
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;  // peer vanished; drop the connection, not the scheduler
+          }
           break;
         }
         case PsfType::kBarrier: {
@@ -122,7 +188,11 @@ class Scheduler {
           rsp.head.type = static_cast<int32_t>(PsfType::kAck);
           rsp.head.req_id = req.head.req_id;
           g.unlock();
-          send_msg(fd, rsp);
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;  // peer vanished; drop the connection, not the scheduler
+          }
           break;
         }
         case PsfType::kShutdown: {
@@ -150,13 +220,18 @@ class Scheduler {
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
-  std::vector<std::thread> conn_threads_;
+  ConnThreads conn_threads_;
 
   std::mutex fds_mu_;
   std::vector<int> live_fds_;
+  using Clock = std::chrono::steady_clock;
   std::mutex mu_;
   std::condition_variable reg_cv_, barrier_cv_, done_cv_;
   std::vector<std::string> server_addrs_;
+  std::vector<Clock::time_point> last_hb_;
+  // a server whose last heartbeat is older than this is reported dead to
+  // kQueryServers clients (reference heartbeat_timeout, van.cc:27)
+  int hb_timeout_ms_ = env_int_or("DMLC_PS_HEARTBEAT_TIMEOUT_MS", 10000);
   int servers_seen_ = 0, workers_seen_ = 0;
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;

@@ -11,8 +11,10 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdio>
+#include <memory>
 #include <mutex>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "net.h"
@@ -45,9 +47,7 @@ class PsServer {
       std::lock_guard<std::mutex> g(fds_mu_);
       for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
     }
-    for (auto& t : conn_threads_)
-      if (t.joinable()) t.join();
-    conn_threads_.clear();
+    conn_threads_.join_all();
   }
 
   int rank() const { return rank_; }
@@ -59,8 +59,25 @@ class PsServer {
       if (fd < 0) break;
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+      conn_threads_.spawn([this, fd] { serve_conn(fd); });
     }
+  }
+
+  // Per-client resend dedup (the server half of the reference's resender.h
+  // contract): a worker that resends after a lost response must not have the
+  // request applied twice. One slot per client suffices because each worker
+  // serializes its requests to one server.
+  struct ClientSlot {
+    std::mutex mu;
+    uint64_t last_id = 0;
+    Message rsp;
+  };
+
+  ClientSlot* client_slot(int32_t client_id) {
+    std::lock_guard<std::mutex> g(clients_mu_);
+    auto& p = clients_[client_id];
+    if (!p) p = std::make_unique<ClientSlot>();
+    return p.get();
   }
 
   void serve_conn(int fd) {
@@ -71,6 +88,29 @@ class PsServer {
     Message req;
     while (recv_msg(fd, &req)) {
       if (static_cast<PsfType>(req.head.type) == PsfType::kShutdown) break;
+      ClientSlot* slot =
+          (req.head.client_id >= 0 && req.head.req_id > 0)
+              ? client_slot(req.head.client_id)
+              : nullptr;
+      std::unique_lock<std::mutex> slot_g;
+      if (slot) {
+        slot_g = std::unique_lock<std::mutex>(slot->mu);
+        if (req.head.req_id == slot->last_id) {
+          // duplicate of the last executed request: replay the response
+          try {
+            send_msg(fd, slot->rsp);
+          } catch (...) {
+            break;
+          }
+          continue;
+        }
+        if (req.head.req_id < slot->last_id) {
+          // stale straggler from a pre-reconnect stream (a newer request
+          // already executed): applying it now would double-apply — drop;
+          // the worker stopped waiting on that stream long ago
+          continue;
+        }
+      }
       Message rsp;
       rsp.head.type = static_cast<int32_t>(PsfType::kAck);
       rsp.head.tensor_id = req.head.tensor_id;
@@ -84,8 +124,12 @@ class PsServer {
         rsp.args.clear();
         rsp.args.push_back(Arg::str(e.what()));
       }
+      if (slot) {
+        slot->last_id = req.head.req_id;
+        slot->rsp = std::move(rsp);  // no payload copy; slot mutex still held
+      }
       try {
-        send_msg(fd, rsp);
+        send_msg(fd, slot ? slot->rsp : rsp);
       } catch (...) {
         break;  // peer gone mid-reply
       }
@@ -460,9 +504,11 @@ class PsServer {
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
-  std::vector<std::thread> conn_threads_;
+  ConnThreads conn_threads_;
   std::mutex fds_mu_;
   std::vector<int> live_fds_;
+  std::mutex clients_mu_;
+  std::unordered_map<int32_t, std::unique_ptr<ClientSlot>> clients_;
   Store store_;
   std::shared_mutex data_mu_;
   std::unordered_map<std::pair<int32_t, uint64_t>, std::vector<float>, PairHash>

@@ -123,7 +123,10 @@ class PsWorker {
  public:
   PsWorker(int rank, int num_workers, const std::string& sched_host,
            int sched_port, int n_threads = 4)
-      : rank_(rank), num_workers_(num_workers), pool_(n_threads) {
+      : rank_(rank), num_workers_(num_workers), sched_host_(sched_host),
+        sched_port_(sched_port), pool_(n_threads) {
+    recv_timeout_ms_ = env_int_or("DMLC_PS_RECV_TIMEOUT_MS", 15000);
+    max_retry_ = env_int_or("DMLC_PS_MAX_RETRY", 3);
     sched_ = std::make_unique<Conn>(connect_to(sched_host, sched_port));
     // register with the scheduler, receive the server address book
     Message reg;
@@ -139,9 +142,8 @@ class PsWorker {
     std::string line;
     while (std::getline(ss, line)) {
       if (line.empty()) continue;
-      auto colon = line.rfind(':');
-      servers_.push_back(std::make_unique<Conn>(
-          connect_to(line.substr(0, colon), std::stoi(line.substr(colon + 1)))));
+      server_addrs_.push_back(line);
+      servers_.push_back(std::make_unique<Conn>(connect_addr(line)));
     }
     if (servers_.empty()) throw std::runtime_error("no servers in address book");
   }
@@ -717,18 +719,90 @@ class PsWorker {
   }
 
  private:
+  int connect_addr(const std::string& addr, int retries = 600,
+                   int wait_ms = 100) {
+    auto colon = addr.rfind(':');
+    int fd = connect_to(addr.substr(0, colon),
+                        std::stoi(addr.substr(colon + 1)), retries, wait_ms);
+    set_recv_timeout(fd, recv_timeout_ms_);
+    return fd;
+  }
+
+  // Current address + liveness of one server, per the scheduler's heartbeat
+  // ledger. Uses a fresh short-lived connection (the registered scheduler
+  // connection may be parked inside a barrier).
+  std::pair<std::string, bool> query_server_status(size_t server) {
+    try {
+      Conn c(connect_to(sched_host_, sched_port_, /*retries=*/20,
+                        /*wait_ms=*/100));
+      set_recv_timeout(c.fd(), recv_timeout_ms_);
+      Message q;
+      q.head.type = static_cast<int32_t>(PsfType::kQueryServers);
+      c.send(q);
+      Message rsp;
+      if (!c.recv(&rsp) || rsp.args.size() < 2)
+        return {server_addrs_[server], true};
+      std::vector<std::string> addrs;
+      std::istringstream ss(rsp.args[0].as_str());
+      std::string line;
+      while (std::getline(ss, line))
+        if (!line.empty()) addrs.push_back(line);
+      const int32_t* alive = rsp.args[1].as_i32();
+      if (server < addrs.size())
+        return {addrs[server], alive[server] != 0};
+    } catch (...) {
+      // scheduler unreachable: fall back to the cached address and let the
+      // reconnect below decide
+    }
+    return {server_addrs_[server], true};
+  }
+
+  // One reliable request/response round trip (the role of the reference's
+  // resender.h ack+timeout+resend): recv timeouts bound every wait, a dead
+  // connection triggers reconnect (to the scheduler's current address for
+  // that rank, so a recovered server is picked up) and a RESEND — servers
+  // dedup on (client_id, req_id) so a request that executed but whose
+  // response was lost is not applied twice.
   Message rpc(size_t server, Message& req) {
     // serialize the whole round trip per server connection: concurrency
     // comes from the pool issuing to different servers in parallel
-    auto& conn = *servers_[server];
     std::lock_guard<std::mutex> g(server_mu_[server % kMaxServers]);
-    conn.send(req);
-    Message rsp;
-    if (!conn.recv(&rsp))
-      throw std::runtime_error("server " + std::to_string(server) + " closed");
-    if (rsp.head.flags == -1)
-      throw std::runtime_error("server error: " + rsp.args[0].as_str());
-    return rsp;
+    req.head.req_id = next_req_id_.fetch_add(1);
+    req.head.client_id = rank_;
+    std::string last_err;
+    for (int attempt = 0; attempt <= max_retry_; ++attempt) {
+      if (attempt > 0) {
+        auto st = query_server_status(server);
+        server_addrs_[server] = st.first;
+        if (!st.second && attempt == max_retry_) break;  // declared dead
+        try {
+          servers_[server] = std::make_unique<Conn>(
+              connect_addr(st.first, /*retries=*/30, /*wait_ms=*/100));
+        } catch (const std::exception& e) {
+          last_err = e.what();
+          continue;
+        }
+      }
+      try {
+        auto& conn = *servers_[server];
+        conn.send(req);
+        Message rsp;
+        if (!conn.recv(&rsp))
+          throw std::runtime_error("server " + std::to_string(server) +
+                                   " timed out or closed");
+        if (rsp.head.flags == -1)
+          throw std::runtime_error("server error: " + rsp.args[0].as_str());
+        return rsp;
+      } catch (const std::exception& e) {
+        std::string what = e.what();
+        if (what.rfind("server error:", 0) == 0) throw;  // app-level: no retry
+        last_err = what;
+        servers_[server]->close();
+      }
+    }
+    throw std::runtime_error(
+        "PS server " + std::to_string(server) + " unreachable after " +
+        std::to_string(max_retry_ + 1) + " attempts (" + last_err + ")");
   }
 
   template <typename F>
@@ -800,8 +874,14 @@ class PsWorker {
 
   int rank_, num_workers_;
   bool finalized_ = false;
+  std::string sched_host_;
+  int sched_port_ = 0;
+  int recv_timeout_ms_ = 15000;
+  int max_retry_ = 3;
+  std::atomic<uint64_t> next_req_id_{1};
   std::unique_ptr<Conn> sched_;
   std::mutex sched_mu_;
+  std::vector<std::string> server_addrs_;
   std::vector<std::unique_ptr<Conn>> servers_;
   std::mutex server_mu_[kMaxServers];
   ThreadPool pool_;

@@ -1,0 +1,157 @@
+"""PS fault tolerance: heartbeats, recv timeouts, resend, recovery re-add.
+
+Mirrors the reference's resender + recovery machinery
+(ps-lite/src/resender.h:15-35,116 ack+timeout resend; van.cc:27,47,569
+heartbeats and recovery-node re-add), redesigned for the raw-TCP van:
+SO_RCVTIMEO bounds every wait, the worker resends over a fresh connection
+(servers dedup on (client_id, req_id)), the scheduler's heartbeat ledger
+declares dead servers, and a replacement server re-registering under the
+same id is picked up by worker reconnects.
+
+Scenarios (the VERDICT's acceptance test): SIGKILL one of 2 servers
+mid-run and observe either a clean, prompt error — or recovery once a
+replacement registers.
+"""
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+
+from test_ps import _env, _run_scheduler, _worker_body, _port_iter, NITEM, ITEM_LEN
+
+# tight knobs so death is detected in seconds, not minutes
+FAULT_ENV = {
+    "DMLC_PS_RECV_TIMEOUT_MS": "2000",
+    "DMLC_PS_MAX_RETRY": "3",
+    "DMLC_PS_HEARTBEAT_MS": "300",
+    "DMLC_PS_HEARTBEAT_TIMEOUT_MS": "1500",
+}
+
+
+def _run_server_fault(idx, port, n_workers, n_servers, stopfile):
+    os.environ.update(_env("server", idx, port, n_workers, n_servers))
+    os.environ.update(FAULT_ENV)
+    from hetu_tpu.ps import server as srv
+    srv.start_server_from_env()
+    while not os.path.exists(stopfile):
+        time.sleep(0.05)
+    srv.stop_server()
+
+
+def _worker_body_fault(rank, port, n_workers, n_servers, fn, tmpdir, result_q):
+    os.environ.update(FAULT_ENV)
+    _worker_body(rank, port, n_workers, n_servers, fn, tmpdir, result_q)
+
+
+def _wait_file(path, timeout=60):
+    t0 = time.time()
+    while not os.path.exists(path):
+        if time.time() - t0 > timeout:
+            raise TimeoutError(f"waiting for {path}")
+        time.sleep(0.05)
+
+
+def _run_fault_cluster(worker_fn, orchestrate, tmpdir):
+    """1 worker + 2 servers + scheduler; ``orchestrate(ctx, procs, env_port)``
+    runs in the main process to inject faults (kill/restart servers)."""
+    port = next(_port_iter)
+    tmpdir = str(tmpdir)
+    ctx = mp.get_context("spawn")
+    stopfile = os.path.join(tmpdir, "stop_servers")
+    sched = ctx.Process(target=_run_scheduler, args=(port, 1, 2))
+    servers = [ctx.Process(target=_run_server_fault,
+                           args=(i, port, 1, 2, stopfile)) for i in range(2)]
+    result_q = ctx.Queue()
+    worker = ctx.Process(target=_worker_body_fault,
+                         args=(0, port, 1, 2, worker_fn, tmpdir, result_q))
+    sched.start()
+    for s in servers:
+        s.start()
+    worker.start()
+    try:
+        orchestrate(ctx, {"servers": servers, "port": port,
+                          "stopfile": stopfile, "tmpdir": tmpdir})
+        rank, status, err = result_q.get(timeout=120)
+        assert status == "ok", f"worker failed:\n{err}"
+    finally:
+        with open(stopfile, "w") as f:
+            f.write("stop")
+        worker.join(timeout=20)
+        for p in servers + [sched, worker]:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: server dies, stays dead -> clean prompt error, no hang
+# ---------------------------------------------------------------------------
+
+def _worker_clean_error(client, rank, tmpdir):
+    client.InitTensor(0, sparse=False, length=NITEM * ITEM_LEN, width=1,
+                      init_type="constant", init_a=1.5)
+    out = client.Pull(0, np.empty(NITEM * ITEM_LEN, np.float32))
+    client.Wait(0)
+    np.testing.assert_allclose(out, 1.5)
+    open(os.path.join(tmpdir, "phase1"), "w").write("ok")
+    _wait_file(os.path.join(tmpdir, "killed"))
+    t0 = time.time()
+    try:
+        client.Pull(0, out)
+        client.Wait(0)
+        raise AssertionError("pull against a dead server did not raise")
+    except RuntimeError as e:
+        elapsed = time.time() - t0
+        assert "unreachable" in str(e) or "timed out" in str(e), e
+        # prompt: bounded by recv timeout x retries, not a forever-hang
+        assert elapsed < 60, f"error took {elapsed:.0f}s"
+
+
+def test_server_death_prompt_clean_error(tmp_path):
+    def orchestrate(ctx, env):
+        _wait_file(os.path.join(env["tmpdir"], "phase1"))
+        env["servers"][1].kill()
+        env["servers"][1].join()
+        open(os.path.join(env["tmpdir"], "killed"), "w").write("ok")
+
+    _run_fault_cluster(_worker_clean_error, orchestrate, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: server dies, a replacement re-registers -> worker recovers
+# ---------------------------------------------------------------------------
+
+def _worker_recovers(client, rank, tmpdir):
+    client.InitTensor(1, sparse=False, length=NITEM * ITEM_LEN, width=1,
+                      init_type="constant", init_a=2.5)
+    out = client.Pull(1, np.empty(NITEM * ITEM_LEN, np.float32))
+    client.Wait(1)
+    np.testing.assert_allclose(out, 2.5)
+    open(os.path.join(tmpdir, "phase1"), "w").write("ok")
+    _wait_file(os.path.join(tmpdir, "restarted"))
+    # the replacement server is empty: re-init (idempotent on the survivor,
+    # creates the shard on the recovered one), then pull through the worker's
+    # reconnect path
+    client.InitTensor(1, sparse=False, length=NITEM * ITEM_LEN, width=1,
+                      init_type="constant", init_a=2.5)
+    out = client.Pull(1, out)
+    client.Wait(1)
+    np.testing.assert_allclose(out, 2.5)
+
+
+def test_server_recovery_after_restart(tmp_path):
+    def orchestrate(ctx, env):
+        _wait_file(os.path.join(env["tmpdir"], "phase1"))
+        env["servers"][1].kill()
+        env["servers"][1].join()
+        # replacement under the same SERVER_ID: scheduler takes the
+        # recovery re-add path and workers reconnect to it
+        repl = ctx.Process(target=_run_server_fault,
+                           args=(1, env["port"], 1, 2, env["stopfile"]))
+        repl.start()
+        env["servers"][1] = repl
+        time.sleep(1.5)  # let it register + heartbeat
+        open(os.path.join(env["tmpdir"], "restarted"), "w").write("ok")
+
+    _run_fault_cluster(_worker_recovers, orchestrate, tmp_path)
