@@ -65,7 +65,11 @@ class HetuConfig:
         self.cache_bound = cache_bound
         self.log_path = log_path
         self.gpipe = gpipe
+        # compute dtype: bf16 keeps the MXU fed at full rate; master params,
+        # optimizer state and updates stay f32 (mixed precision — the
+        # reference is f32-only, c_runtime_api.h GetDataSize :74-82)
         self.dtype = np.dtype(dtype)
+        self.compute_dtype = self.dtype
         self.dp_axis = dp_axis
         self.mp_axis = mp_axis
         if mesh is not None and not isinstance(mesh, Mesh):
@@ -177,6 +181,9 @@ class TraceContext:
         self.ps_grad_outputs: dict[int, Any] = {}
         self.grad_cache: dict[int, dict[int, Any]] = {}
         self._in_grad_retrace = False
+        # f32 master copies of params when compute_dtype is lower precision
+        # (filled by the step builder; optimizer updates read these)
+        self.master_params: dict[int, Any] = {}
         # Fold the node's position WITHIN this topo, not its process-global
         # id: global ids depend on how many nodes earlier code constructed,
         # which made RNG streams (dropout etc.) vary with test order.
@@ -226,6 +233,8 @@ class TraceContext:
         program output; the host pushes it to the server post-step (the
         reference instead issues the RPC from the interpreter on the d2h
         stream, ParameterServerCommunicate.py:38-50)."""
+        if hasattr(grad, "dtype") and grad.dtype != jnp.float32:
+            grad = grad.astype(jnp.float32)  # PS stores/accumulates f32
         self.ps_grad_outputs[id(op)] = grad
         return None
 
@@ -274,6 +283,18 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
     if id(node) in env:
         return
     input_vals = [env[id(i)] for i in node.inputs]
+    cdtype = tc.config.compute_dtype
+    if cdtype != np.float32:
+        # enforce the compute dtype at every op boundary: stateful ops
+        # (batchnorm running stats) legitimately produce f32 and would
+        # otherwise poison downstream matmuls back to full precision.
+        # XLA elides the no-op casts.
+        input_vals = [
+            v.astype(cdtype)
+            if (isinstance(v, jax.Array) or hasattr(v, "aval"))
+            and jnp.issubdtype(getattr(v, "dtype", np.int32), jnp.floating)
+            and v.dtype != cdtype else v
+            for v in input_vals]
     if any(v is _PS_RESIDENT for v in input_vals):
         raise ValueError(
             f"{node.name} reads a PS-resident embedding table directly; only "
@@ -282,6 +303,13 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
     if node.stateful:
         state_in = tc.op_state_in[id(node)]
         out, new_state = node.compute_stateful(input_vals, state_in, tc)
+        # op state (running stats) keeps its own dtype across steps — under
+        # bf16 compute the update must not silently downcast the f32 stats
+        new_state = jax.tree.map(
+            lambda new, old: new.astype(old.dtype)
+            if hasattr(old, "dtype") and hasattr(new, "dtype")
+            and new.dtype != old.dtype else new,
+            new_state, state_in)
         if not tc._in_grad_retrace:
             tc.op_state_updates[id(node)] = new_state
         env[id(node)] = out
@@ -306,6 +334,7 @@ class SubExecutor:
         self.stateful_nodes = [n for n in self.topo if n.stateful]
         self.optimizer_nodes = [n for n in self.topo if n.is_optimizer]
         self._compiled: dict[tuple, Any] = {}
+        self._last_call = None  # (jitted fn, args) of the latest run
 
         # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
         ps = executor.ps_runtime
@@ -378,25 +407,39 @@ class SubExecutor:
         ps_dense_vars = self.ps_dense_vars
         ps_comm_ops = self.ps_comm_ops
 
+        compute_dtype = config.compute_dtype
+
+        def cast_in(v):
+            """Cast a float input to the compute dtype (bf16 mixed precision);
+            master params stay f32 outside ``env``."""
+            if compute_dtype == np.float32:
+                return v
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(compute_dtype)
+            return v
+
         def step_fn(params_t, slots_t, opstate_t, rng, step, feeds_t, batches_t,
                     ps_staged_t, ps_dense_t):
             env: dict[int, Any] = {}
+            masters: dict[int, Any] = {}
             for node, val in zip(param_nodes, params_t):
-                env[id(node)] = val
+                env[id(node)] = cast_in(val)
+                masters[id(node)] = val
             for node, val in zip(feed_nodes, feeds_t):
-                env[id(node)] = val
+                env[id(node)] = cast_in(val)
             for node, val in zip(dl_nodes, batches_t):
-                env[id(node)] = val
+                env[id(node)] = cast_in(val)
             # PS-resident embeddings: staged rows stand in for the lookup
             # output; the table itself never exists on device
             for node, val in zip(ps_staged_ops, ps_staged_t):
-                env[id(node)] = val
+                env[id(node)] = cast_in(val)
             for node in ps_sparse_vars:
                 env[id(node)] = _PS_RESIDENT
             for node, val in zip(ps_dense_vars, ps_dense_t):
-                env[id(node)] = val
+                env[id(node)] = cast_in(val)
             op_state_in = {id(n): s for n, s in zip(stateful_nodes, opstate_t)}
             tc = TraceContext(config, topo, training, env, rng, step, op_state_in)
+            tc.master_params = masters
             slots_in = {id(n): s for n, s in zip(opt_nodes, slots_t)}
             for node in topo:
                 if id(node) in env:
@@ -412,7 +455,7 @@ class SubExecutor:
                 jnp.zeros(()) if (env[id(n)] is _NO_OUTPUT or env[id(n)] is None)
                 else env[id(n)]
                 for n in eval_nodes)
-            new_params = tuple(tc.param_updates.get(id(n), env[id(n)])
+            new_params = tuple(tc.param_updates.get(id(n), masters[id(n)])
                                for n in param_nodes)
             new_slots = tuple(tc.slot_updates.get(id(n), slots_in[id(n)])
                               for n in opt_nodes)
@@ -423,6 +466,17 @@ class SubExecutor:
 
         donate = (0, 1, 2) if training else ()
         return jax.jit(step_fn, donate_argnums=donate)
+
+    def last_cost_analysis(self):
+        """XLA cost analysis (flops etc.) of the latest executed step, for
+        MFU reporting (reaches the compilation cache — no recompile)."""
+        if self._last_call is None:
+            return None
+        fn, args = self._last_call
+        try:
+            return fn.lower(*args).compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return None
 
     # ------------------------------------------------------------------
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False,
@@ -466,10 +520,12 @@ class SubExecutor:
         step = ex.state["step"]
         rng = jax.random.fold_in(ex.rng_root, step)
 
-        outputs, new_params, new_slots, new_opstate, ps_grads = fn(
-            params_t, slots_t, opstate_t, rng, jnp.asarray(step, jnp.int32),
-            tuple(feed_vals), tuple(batch_vals), tuple(ps_staged_vals),
-            tuple(ps_dense_vals))
+        args = (params_t, slots_t, opstate_t, rng,
+                jnp.asarray(step, jnp.int32), tuple(feed_vals),
+                tuple(batch_vals), tuple(ps_staged_vals),
+                tuple(ps_dense_vals))
+        self._last_call = (fn, args)
+        outputs, new_params, new_slots, new_opstate, ps_grads = fn(*args)
 
         # -- PS post-step: push gradients (reference push/pull, ASP/BSP) ----
         for op, grad in zip(self.ps_comm_ops, ps_grads):

@@ -196,11 +196,15 @@ class OptimizerOp(Op):
         lr = self.optimizer.lr_value(tc.step)
         new_slots = []
         for var, grad_node, slot in zip(self.vars, self.inputs, slots):
-            param = env[id(var)]
+            # mixed precision: update the f32 master copy, not the (possibly
+            # bf16) compute-side value in env
+            param = tc.master_params.get(id(var), env[id(var)])
             grad = env[id(grad_node)]
             if grad is None:  # PS-managed parameter: server applied the update
                 new_slots.append(slot)
                 continue
+            if hasattr(grad, "dtype") and grad.dtype != param.dtype:
+                grad = grad.astype(param.dtype)
             new_param, new_slot = self.optimizer.apply_dense(param, grad, slot, lr)
             tc.param_updates[id(var)] = new_param
             new_slots.append(new_slot)

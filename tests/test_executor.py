@@ -185,3 +185,43 @@ def test_variable_value_and_fetch():
     ex = ht.Executor([loss], ctx=ht.cpu(0))
     (val,) = ex.fetch_dense_parameter_value([w])
     np.testing.assert_allclose(val.asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_bf16_compute_mode():
+    """dtype=bfloat16: compute runs in bf16 (MXU-rate path), master params
+    and optimizer updates stay f32, loss tracks the f32 run loosely."""
+    import jax.numpy as jnp
+    import numpy as np
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 16).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+    wv = (rng.randn(16, 4) * 0.1).astype(np.float32)
+
+    def build():
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y", trainable=False)
+        w = ht.Variable("w", value=wv.copy())
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.5).minimize(loss)
+        return x, y_, w, loss, train_op
+
+    x, y_, w, loss, train_op = build()
+    ex32 = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=3)
+    l32 = [float(np.mean(ex32.run("train", feed_dict={x: xv, y_: yv},
+                                  convert_to_numpy_ret_vals=True)[0]))
+           for _ in range(5)]
+
+    x, y_, w, loss, train_op = build()
+    ex16 = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=3,
+                       dtype=jnp.bfloat16)
+    l16 = [float(np.mean(ex16.run("train", feed_dict={x: xv, y_: yv},
+                                  convert_to_numpy_ret_vals=True)[0]))
+           for _ in range(5)]
+    # master params stay f32
+    assert ex16.state["params"][id(w)].dtype == jnp.float32
+    # bf16 training tracks f32 within bf16 tolerance and actually learns
+    np.testing.assert_allclose(l32, l16, rtol=0.05, atol=0.02)
+    assert l16[-1] < l16[0]
