@@ -17,15 +17,20 @@ _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
 def _conv2d(x, w, padding, stride):
+    # No preferred_element_type: output dtype follows the inputs, so the conv
+    # transpose rule under jax.grad sees matching dtypes in bf16 compute mode
+    # (the MXU accumulates bf16 products in f32 internally either way).
     p, s = int(padding), int(stride)
     return jax.lax.conv_general_dilated(
         x, w, window_strides=(s, s), padding=[(p, p), (p, p)],
-        dimension_numbers=_DIMNUMS, preferred_element_type=jnp.float32)
+        dimension_numbers=_DIMNUMS)
 
 
 def conv2d_op(node_A, node_B, padding=0, stride=1, ctx=None):
-    return FunctionalOp("Conv2d", lambda x, w: _conv2d(x, w, padding, stride),
-                        [node_A, node_B], ctx)
+    op = FunctionalOp("Conv2d", lambda x, w: _conv2d(x, w, padding, stride),
+                      [node_A, node_B], ctx)
+    op.export_attrs = {"padding": int(padding), "stride": int(stride)}
+    return op
 
 
 def conv2d_gradient_of_data_op(node_filter, node_grad_y, padding=0, stride=1, ctx=None):
@@ -92,8 +97,10 @@ def _avg_pool(x, kh, kw, p, s):
 
 def max_pool2d_op(node_A, kernel_H, kernel_W, padding, stride, ctx=None):
     kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
-    return FunctionalOp("MaxPool2d", lambda x: _max_pool(x, kh, kw, p, s),
-                        [node_A], ctx)
+    op = FunctionalOp("MaxPool2d", lambda x: _max_pool(x, kh, kw, p, s),
+                      [node_A], ctx)
+    op.export_attrs = {"kernel_H": kh, "kernel_W": kw, "padding": p, "stride": s}
+    return op
 
 
 def max_pool2d_gradient_op(node_out, node_out_gradient, node_in,
@@ -110,8 +117,10 @@ def max_pool2d_gradient_op(node_out, node_out_gradient, node_in,
 
 def avg_pool2d_op(node_A, kernel_H, kernel_W, padding, stride, ctx=None):
     kh, kw, p, s = int(kernel_H), int(kernel_W), int(padding), int(stride)
-    return FunctionalOp("AvgPool2d", lambda x: _avg_pool(x, kh, kw, p, s),
-                        [node_A], ctx)
+    op = FunctionalOp("AvgPool2d", lambda x: _avg_pool(x, kh, kw, p, s),
+                      [node_A], ctx)
+    op.export_attrs = {"kernel_H": kh, "kernel_W": kw, "padding": p, "stride": s}
+    return op
 
 
 def avg_pool2d_gradient_op(node_out, node_out_gradient, node_in,

@@ -225,3 +225,48 @@ def test_bf16_compute_mode():
     # bf16 training tracks f32 within bf16 tolerance and actually learns
     np.testing.assert_allclose(l32, l16, rtol=0.05, atol=0.02)
     assert l16[-1] < l16[0]
+
+
+def test_bf16_conv_bn_training():
+    """Regression for the round-2 bench crash: conv under jax.grad in bf16
+    compute mode (the conv transpose rule must see matching dtypes), with
+    BatchNorm running stats staying f32. Exercises exactly the config
+    bench.py runs (conv + BN + pool + matmul, Momentum)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 3, 8, 8).astype(np.float32)
+    yv = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+
+    def build():
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y", trainable=False)
+        w1 = ht.Variable("w1", value=(rng.randn(8, 3, 3, 3) * 0.1).astype(np.float32))
+        scale = ht.Variable("scale", value=np.ones(8, np.float32))
+        bias = ht.Variable("bias", value=np.zeros(8, np.float32))
+        w2 = ht.Variable("w2", value=(rng.randn(8 * 4 * 4, 4) * 0.1).astype(np.float32))
+        h = ht.conv2d_op(x, w1, padding=1, stride=1)
+        h = ht.batch_normalization_op(h, scale, bias)
+        h = ht.relu_op(h)
+        h = ht.max_pool2d_op(h, 2, 2, 0, 2)
+        h = ht.array_reshape_op(h, [-1, 8 * 4 * 4])
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+        train_op = ht.optim.MomentumOptimizer(0.1).minimize(loss)
+        return x, y_, scale, loss, train_op
+
+    rng = np.random.RandomState(1)
+    x, y_, scale, loss, train_op = build()
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=3,
+                     dtype=jnp.bfloat16)
+    losses = [float(np.mean(ex.run("train", feed_dict={x: xv, y_: yv},
+                                   convert_to_numpy_ret_vals=True)[0]))
+              for _ in range(8)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # master params and BN running stats stay f32
+    assert ex.state["params"][id(scale)].dtype == jnp.float32
+    for st in jax.tree.leaves(ex.state["op_state"]):
+        if hasattr(st, "dtype") and jnp.issubdtype(st.dtype, jnp.floating):
+            assert st.dtype == jnp.float32
