@@ -65,16 +65,21 @@ def datad2h_op(node, ctx=None):
 
 
 class PipelineSendOp(Op):
-    """Stage-boundary send (reference PipelineSend.py). The pipeline executor
-    cuts the graph here; within a fused pipeline step it lowers to a
-    ``lax.ppermute`` to the next stage."""
+    """Stage-boundary send marker (reference PipelineSend.py:19-44).
+
+    Executable: an identity pinned to the sending stage's context. The
+    reference issues a NCCL P2P send with a runtime shape handshake; here the
+    gpipe executor partitions the graph at context boundaries and its generic
+    boundary-edge machinery carries the value to the consuming stage via
+    ``jax.device_put`` — shapes are static and known at placement, so no
+    handshake exists. The marker's job is to make the stage cut explicit."""
 
     def __init__(self, node, destination=None, comm=None, stream=None, ctx=None):
         super().__init__([node], ctx)
         self.destination = destination
 
     def compute(self, input_vals, tc):
-        return tc.pipeline_send(self, input_vals[0])
+        return input_vals[0]
 
 
 def pipeline_send_op(node, destination=None, comm=None, stream=None, ctx=None):
@@ -82,17 +87,27 @@ def pipeline_send_op(node, destination=None, comm=None, stream=None, ctx=None):
 
 
 class PipelineReceiveOp(Op):
-    """Stage-boundary receive (reference PipelineReceive.py). Shapes are
-    resolved at placement time — no dynamic shape handshake (the reference
-    ships shapes as a padded length-3 tensor at runtime; XLA needs static
-    shapes, and placement already knows them)."""
+    """Stage-boundary receive marker (reference PipelineReceive.py:20-48).
+
+    Executable: pass the paired :class:`PipelineSendOp` node (or any producer
+    node) as ``source`` — the pair forms a real graph edge, so topo sort,
+    autodiff, and the gpipe executor's cross-stage boundary transfer all see
+    it. The reference instead pairs send/recv by device rank at runtime with
+    a dynamic shape handshake; XLA's static shapes make placement-time
+    pairing the TPU-native design."""
 
     def __init__(self, source=None, comm=None, stream=None, ctx=None):
-        super().__init__([], ctx)
+        if not isinstance(source, Op):
+            raise TypeError(
+                "pipeline_receive_op(source=...) takes the paired "
+                "pipeline_send_op NODE (placement-time pairing); device-rank "
+                "pairing with a runtime shape handshake is a NCCL-ism with no "
+                "XLA equivalent")
+        super().__init__([source], ctx)
         self.source = source
 
     def compute(self, input_vals, tc):
-        return tc.pipeline_recv(self)
+        return input_vals[0]
 
 
 def pipeline_receive_op(source=None, comm=None, stream=None, ctx=None):

@@ -111,6 +111,59 @@ def test_gpipe_validate_entry_pipelines():
     assert exp.state["step"] == 1
 
 
+def test_gpipe_explicit_send_recv_markers():
+    """pipeline_send_op/pipeline_receive_op are executable stage-boundary
+    markers (reference PipelineSend.py:19-44 / PipelineReceive.py:20-48):
+    send pins the value to the producing stage, recv (paired with the send
+    node at placement time) pins the consumer side, and the boundary
+    machinery carries the bytes. The marked pipeline must match the
+    unmarked oracle exactly."""
+    M, mb = 2, 8
+    xv, yv = _data(M * mb, seed=7)
+
+    # oracle: unmarked single-device run
+    x, y_, loss, train_op = _build_mlp(None)
+    ex1 = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=5)
+    lv, _ = ex1.run("train", feed_dict={x: xv, y_: yv},
+                    convert_to_numpy_ret_vals=True)
+    oracle = float(np.mean(lv))
+
+    # 2-stage pipeline with explicit send/recv markers at the cut
+    rng = np.random.RandomState(0)
+    dims = [20, 32, 32, 16, 10]
+    ws = [(rng.randn(dims[i], dims[i + 1]) * 0.2).astype(np.float32)
+          for i in range(4)]
+    c0, c1 = ht.cpu(0), ht.cpu(1)
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y", trainable=False)
+    h = x
+    for i in range(2):
+        w = ht.Variable(f"w{i}", value=ws[i].copy(), ctx=c0)
+        h = ht.relu_op(ht.matmul_op(h, w, ctx=c0), ctx=c0)
+    sent = ht.pipeline_send_op(h, destination=1, ctx=c0)
+    h = ht.pipeline_receive_op(source=sent, ctx=c1)
+    for i in range(2, 4):
+        w = ht.Variable(f"w{i}", value=ws[i].copy(), ctx=c1)
+        h = ht.matmul_op(h, w, ctx=c1)
+        if i < 3:
+            h = ht.relu_op(h, ctx=c1)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(h, y_, ctx=c1), [0], ctx=c1)
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    exp = ht.Executor({"train": [loss, train_op]}, gpipe=True, seed=5)
+    assert len(exp.subexecutors["train"].stages) == 2
+    fdl = [{x: xv[m * mb:(m + 1) * mb], y_: yv[m * mb:(m + 1) * mb]}
+           for m in range(M)]
+    ret = exp.run("train", feed_dict=fdl, convert_to_numpy_ret_vals=True)
+    pipe = float(np.mean([np.mean(v) for v in ret[0]]))
+    np.testing.assert_allclose(oracle, pipe, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_recv_requires_paired_send():
+    with pytest.raises(TypeError, match="paired"):
+        ht.pipeline_receive_op(source=3)
+
+
 def test_gpipe_without_stage_contexts_raises():
     x, y_, loss, train_op = _build_mlp(None)
     with pytest.raises(ValueError, match="context"):
