@@ -2,11 +2,13 @@
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 Headline (BASELINE.md north star): ResNet-18 / CIFAR10-shape training through
-the define-then-run Executor on the real chip, samples/sec/chip — now in
-bf16 compute mode (f32 master params), the named change over round 1's f32
-number. ``detail`` carries the f32 A/B, MFU (XLA cost-analysis flops over an
-assumed peak), the flagship transformer tokens/s, and a WDL-Criteo-shaped
-run through a real local PS cluster (scheduler + 2 servers, Hybrid mode).
+the define-then-run Executor on the real chip, samples/sec/chip, best of
+{f32, bf16} x {bs 128, 256}. Round-3 changes: bf16 conv backward fixed,
+device-resident dataset slicing (zero per-step H2D), rng folded into the jit.
+``detail`` carries each config's samples/s + step ms + MFU (XLA cost-analysis
+flops over an assumed peak), the flagship transformer tokens/s, and a
+WDL-Criteo run through a real local PS cluster (scheduler + 2 servers,
+Hybrid mode) with the prefetch on/off A/B.
 
 Syncs once per timed window: host<->device roundtrips on the tunneled chip
 cost ~64ms and must not be counted per step.
@@ -141,6 +143,8 @@ def _server_proc(port, idx):
 
 
 def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
+    """Returns {prefetch_on: (sps, ms, perf), prefetch_off: (sps, ms)} — the
+    overlap A/B the reference's prefetch x ASP matrix is about."""
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "examples", "ctr"))
     port = _PS_PORT
@@ -159,23 +163,40 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
 
         (tr_dense, tr_sparse, tr_y), _ = load_criteo_data(
             feature_dimension=feature_dim, n_train=batch_size * 8, n_test=64)
-        dense = ht.dataloader_op([ht.Dataloader(tr_dense, batch_size, "train")])
-        sparse = ht.dataloader_op([ht.Dataloader(tr_sparse, batch_size, "train")])
-        y_ = ht.dataloader_op([ht.Dataloader(tr_y, batch_size, "train")])
-        loss, y, labels, train_op = models.wdl_criteo(
-            dense, sparse, y_, feature_dimension=feature_dim,
-            embedding_size=16)
-        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.tpu(0),
-                         comm_mode="Hybrid", seed=0)
-        for _ in range(warmup):
-            ex.run("train")
-        float(np.mean(ex.run("train")[0].asnumpy()))
-        t0 = time.time()
-        for _ in range(iters - 1):
-            ex.run("train")
-        float(np.mean(ex.run("train")[0].asnumpy()))
-        dt = (time.time() - t0) / iters
-        return batch_size / dt, dt * 1000
+
+        out = {}
+        for leg, prefetch in enumerate((True, False)):
+            # disjoint server tensor ids per leg: the servers are live across
+            # both legs and ParamInit is idempotent, so reusing ids would
+            # resume from the first leg's trained values
+            os.environ["HETU_PS_ID_BASE"] = str(leg * 1000)
+            dense = ht.dataloader_op([ht.Dataloader(tr_dense, batch_size,
+                                                    "train")])
+            sparse = ht.dataloader_op([ht.Dataloader(tr_sparse, batch_size,
+                                                     "train")])
+            y_ = ht.dataloader_op([ht.Dataloader(tr_y, batch_size, "train")])
+            loss, y, labels, train_op = models.wdl_criteo(
+                dense, sparse, y_, feature_dimension=feature_dim,
+                embedding_size=16)
+            ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.tpu(0),
+                             comm_mode="Hybrid", seed=0, prefetch=prefetch)
+            for _ in range(warmup):
+                ex.run("train")
+            float(np.mean(ex.run("train")[0].asnumpy()))
+            t0 = time.time()
+            for _ in range(iters - 1):
+                ex.run("train")
+            float(np.mean(ex.run("train")[0].asnumpy()))
+            dt = (time.time() - t0) / iters
+            key = "prefetch_on" if prefetch else "prefetch_off"
+            out[key] = {"samples_per_sec": round(batch_size / dt, 1),
+                        "step_ms": round(dt * 1000, 2)}
+            if prefetch:
+                ex.ps_runtime.drain()
+                out[key]["ps_perf"] = dict(ex.ps_runtime.perf)
+            ex.close()
+        os.environ.pop("HETU_PS_ID_BASE", None)
+        return out
     finally:
         for p in procs:
             p.terminate()
@@ -187,16 +208,16 @@ def main():
     import jax
 
     detail = {"device": str(jax.devices()[0].device_kind),
-              "assumed_peak_tflops": PEAK_TFLOPS, "batch_size": 128}
+              "assumed_peak_tflops": PEAK_TFLOPS}
 
-    f32_sps, f32_ms, f32_mfu = bench_resnet18()
-    bf16_sps, bf16_ms, bf16_mfu = bench_resnet18(dtype="bfloat16")
-    detail["resnet18_f32"] = {"samples_per_sec": round(f32_sps, 1),
-                              "step_ms": round(f32_ms, 2),
-                              "mfu": round(f32_mfu, 4) if f32_mfu else None}
-    detail["resnet18_bf16"] = {"samples_per_sec": round(bf16_sps, 1),
-                               "step_ms": round(bf16_ms, 2),
-                               "mfu": round(bf16_mfu, 4) if bf16_mfu else None}
+    headline = 0.0
+    for bs in (128, 256):
+        for dtype, tag in ((None, "f32"), ("bfloat16", "bf16")):
+            sps, ms, mfu = bench_resnet18(batch_size=bs, dtype=dtype)
+            detail[f"resnet18_{tag}_bs{bs}"] = {
+                "samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
+                "mfu": round(mfu, 4) if mfu else None}
+            headline = max(headline, sps)
 
     skip_extras = "--fast" in sys.argv
     if not skip_extras:
@@ -208,14 +229,12 @@ def main():
         except Exception as e:  # noqa: BLE001 — partial bench beats no bench
             detail["transformer_38M_seq512"] = {"error": str(e)[:200]}
         try:
-            wsps, wms = bench_wdl_ps()
-            detail["wdl_criteo_hybrid_ps"] = {
-                "samples_per_sec": round(wsps, 1), "step_ms": round(wms, 2),
-                "servers": 2}
+            wdl = bench_wdl_ps()
+            wdl["servers"] = 2
+            detail["wdl_criteo_hybrid_ps"] = wdl
         except Exception as e:  # noqa: BLE001
             detail["wdl_criteo_hybrid_ps"] = {"error": str(e)[:200]}
 
-    headline = max(f32_sps, bf16_sps)
     vs = headline / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
     print(json.dumps({
         "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",

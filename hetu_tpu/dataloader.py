@@ -58,7 +58,7 @@ class Dataloader:
         if self._cursor == 0 and self.shuffle:
             self._rng.shuffle(self._order)
 
-    def get_arr(self) -> np.ndarray:
+    def _next_batch(self) -> np.ndarray:
         self._maybe_reshuffle()
         i = self._cursor
         idx = self._order[i * self.batch_size:(i + 1) * self.batch_size]
@@ -67,6 +67,22 @@ class Dataloader:
             batch = self.func(batch)
         self._cursor = (self._cursor + 1) % self.batch_num
         return batch
+
+    _peeked: Optional[np.ndarray] = None
+
+    def get_arr(self) -> np.ndarray:
+        if self._peeked is not None:
+            batch, self._peeked = self._peeked, None
+            return batch
+        return self._next_batch()
+
+    def peek_arr(self) -> np.ndarray:
+        """The batch the next ``get_arr`` will return, without consuming it.
+        Lets the PS runtime pull batch N+1's embedding rows while step N runs
+        (reference prefetch, ParameterServerCommunicate.py:122-231)."""
+        if self._peeked is None:
+            self._peeked = self._next_batch()
+        return self._peeked
 
     def get_cur_shape(self):
         return (self.batch_size,) + tuple(self._data.shape[1:])
@@ -88,6 +104,9 @@ class DataloaderOp(Op):
 
     def get_batch(self, name):
         return self.dataloaders[name].get_arr()
+
+    def peek_batch(self, name):
+        return self.dataloaders[name].peek_arr()
 
     def get_cur_shape(self, name):
         return self.dataloaders[name].get_cur_shape()

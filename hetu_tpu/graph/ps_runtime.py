@@ -24,12 +24,56 @@ program; PS traffic happens at its boundary:
 """
 from __future__ import annotations
 
+import queue
+import threading
+from concurrent.futures import Future
 from typing import Any, Optional
 
 import numpy as np
 
 from .node import Op, PlaceholderOp, find_topo_sort
 from .ops.ps import ParameterServerCommunicateOp, ParameterServerSparsePullOp
+
+
+class _SerialIO:
+    """A dedicated thread running submitted closures in order.
+
+    The PS worker agent's C++ side is thread-safe (its own pool + per-tensor
+    tickets), but the Python client keeps shared staging state, so all client
+    calls from one logical stream go through one of these; cross-stream calls
+    are guarded by the runtime's rpc lock around the issue phase."""
+
+    def __init__(self, name: str):
+        self._q: "queue.Queue" = queue.Queue()
+        self._t = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered via future
+                fut.set_exception(e)
+
+    def submit(self, fn) -> Future:
+        fut: Future = Future()
+        self._q.put((fut, fn))
+        return fut
+
+    def drain(self):
+        """Block until everything submitted so far has completed."""
+        self.submit(lambda: None).result()
+
+    def stop(self):
+        self.drain()
+        self._q.put(None)
+        self._t.join(timeout=10)
 
 
 _INIT_SPEC_BY_CLASS = {
@@ -94,7 +138,9 @@ class PSRuntime:
                 embed_vars.add(id(embed))
                 lookups_by_var.setdefault(id(embed), []).append(op)
         self.params: dict[int, PSParam] = {}
-        next_id = 0
+        # id base lets multiple Executors in one process address disjoint
+        # server tensors (e.g. A/B runs against one live cluster)
+        next_id = int(os.environ.get("HETU_PS_ID_BASE", "0"))
         for op in topo:
             if not (isinstance(op, PlaceholderOp) and op.trainable):
                 continue
@@ -112,6 +158,27 @@ class PSRuntime:
         self._opt_nodes = [n for n in topo if n.is_optimizer]
         self._server_opt = self._deduce_server_opt()
         self._init_params()
+
+        # -- async I/O (reference prefetch x ASP/BSP matrix,
+        #    ParameterServerCommunicate.py:122-231) ------------------------
+        # push stream: syncs the device grads (off the critical path) then
+        # pushes; pull stream: issues batch N+1's row pulls while step N
+        # computes. Under BSP the pull stream IS the push stream, so the
+        # ordering push -> barrier -> pull is exact; under ASP the streams
+        # race, giving the reference's staleness-by-one-step semantics.
+        self.async_enabled = bool(config.prefetch)
+        self._rpc_lock = threading.Lock()
+        self._io_push: Optional[_SerialIO] = None
+        self._io_pull: Optional[_SerialIO] = None
+        if self.async_enabled:
+            self._io_push = _SerialIO("hetu-ps-push")
+            self._io_pull = (self._io_push if self.bsp
+                             else _SerialIO("hetu-ps-pull"))
+        self._prefetched: dict[int, tuple[np.ndarray, Future]] = {}
+        self._pending_pushes: list[Future] = []
+        self._dense_push_fut: dict[int, Future] = {}
+        self.perf = {"sync_pulls": 0, "prefetch_hits": 0,
+                     "prefetch_misses": 0, "async_pushes": 0}
 
     # ------------------------------------------------------------------
     def _deduce_server_opt(self):
@@ -217,23 +284,58 @@ class PSRuntime:
     # ------------------------------------------------------------------
     # pre-step: stage embedding rows / dense values
     # ------------------------------------------------------------------
-    def stage_lookup(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
-        """Pull the batch's rows (reference EmbeddingLookUp.py:27-40)."""
+    def _pull_rows(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
         width = int(np.prod(p.shape[1:]))
         flat = np.ascontiguousarray(idx, dtype=np.int64).ravel()
         dest = np.zeros((flat.size, width), np.float32)
         if p.cache is not None:
-            p.cache.embedding_lookup(flat.astype(np.uint64), dest, sync=True)
+            with self._rpc_lock:
+                p.cache.embedding_lookup(flat.astype(np.uint64), dest,
+                                         sync=True)
         else:
-            self.comm.SparsePull(p.ps_id, flat, dest)
+            with self._rpc_lock:
+                self.comm.SparsePull(p.ps_id, flat, dest)
             self.comm.Wait(p.ps_id)
         return dest.reshape(tuple(idx.shape) + tuple(p.shape[1:]))
+
+    def stage_lookup(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
+        """Pull the batch's rows (reference EmbeddingLookUp.py:27-40)."""
+        self.perf["sync_pulls"] += 1
+        return self._pull_rows(p, idx)
+
+    def prefetch_lookup(self, key: int, p: PSParam, idx: np.ndarray):
+        """Issue batch N+1's row pull on the pull stream (reference prefetch,
+        ParameterServerCommunicate.py:122-231). Under ASP the pull races this
+        step's push — staleness bounded by one step, like the reference;
+        under BSP the pull stream is the push stream, so ordering is exact."""
+        idx = np.array(idx, copy=True)
+        self._prefetched[key] = (idx, self._io_pull.submit(
+            lambda: self._pull_rows(p, idx)))
+
+    def take_prefetched(self, key: int, idx) -> Optional[np.ndarray]:
+        ent = self._prefetched.pop(key, None)
+        if ent is None:
+            return None
+        expected, fut = ent
+        if np.array_equal(expected, np.asarray(idx)):
+            self.perf["prefetch_hits"] += 1
+            return fut.result()
+        self.perf["prefetch_misses"] += 1
+        fut.result()  # let it finish; the pulled rows are simply unused
+        return None
+
+    def wait_dense(self, p: PSParam):
+        """Block until the latest async DDPushPull for ``p`` has refreshed
+        ``host_value``."""
+        fut = self._dense_push_fut.get(id(p.node))
+        if fut is not None:
+            fut.result()
 
     # ------------------------------------------------------------------
     # post-step: push gradients
     # ------------------------------------------------------------------
-    def push_grad(self, p: PSParam, grad: np.ndarray,
-                  idx: Optional[np.ndarray], step: int = 0):
+    def _push_one(self, p: PSParam, grad: np.ndarray,
+                  idx: Optional[np.ndarray], step: int):
         opt = self._server_opt
         if p.sparse:
             width = int(np.prod(p.shape[1:]))
@@ -242,31 +344,86 @@ class PSRuntime:
             if opt["prescale"]:
                 g = -self._prescale_lr(step) * g
             if p.cache is not None:
-                p.cache.embedding_update(flat_idx.astype(np.uint64), g,
-                                         sync=True)
+                with self._rpc_lock:
+                    p.cache.embedding_update(flat_idx.astype(np.uint64), g,
+                                             sync=True)
             else:
-                self.comm.SparsePush(p.ps_id, flat_idx, g)
+                with self._rpc_lock:
+                    self.comm.SparsePush(p.ps_id, flat_idx, g)
                 self.comm.Wait(p.ps_id)
         else:
             g = np.asarray(grad, np.float32).ravel()
             if opt["prescale"]:
                 g = -self._prescale_lr(step) * g
             out = np.empty_like(p.host_value).ravel()
-            self.comm.DDPushPull(p.ps_id, g, out)
+            with self._rpc_lock:
+                self.comm.DDPushPull(p.ps_id, g, out)
             self.comm.Wait(p.ps_id)
             p.host_value = out.reshape(p.shape)
+
+    def push_grad(self, p: PSParam, grad: np.ndarray,
+                  idx: Optional[np.ndarray], step: int = 0):
+        """Synchronous push (prefetch=False path)."""
+        self._push_one(p, grad, idx, step)
         if self.bsp:
             self.comm.BarrierWorker()
+
+    def push_grads_async(self, items, step: int):
+        """Enqueue one step's pushes on the push stream. ``items`` is
+        ``[(PSParam, device_grad, idx_or_None), ...]`` — the device sync
+        (np.asarray of a possibly-unfinished jax array) happens on the push
+        thread, so the caller returns before the step has even finished on
+        the accelerator."""
+
+        def _do():
+            for p, grad, idx in items:
+                self._push_one(p, np.asarray(grad), idx, step)
+            if self.bsp:
+                self.comm.BarrierWorker()
+            self.perf["async_pushes"] += len(items)
+
+        fut = self._io_push.submit(_do)
+        self._pending_pushes.append(fut)
+        if len(self._pending_pushes) > 64:
+            # bound the backlog: the oldest push must land before we pile on
+            self._pending_pushes.pop(0).result()
+        for p, _, _ in items:
+            if not p.sparse:
+                self._dense_push_fut[id(p.node)] = fut
+        return fut
+
+    def drain(self):
+        """Complete all in-flight async PS traffic (checkpoint/fetch/shutdown
+        boundaries)."""
+        if self._io_push is not None:
+            self._io_push.drain()
+        if self._io_pull is not None and self._io_pull is not self._io_push:
+            self._io_pull.drain()
+        for fut in self._pending_pushes:
+            fut.result()
+        self._pending_pushes.clear()
+
+    def shutdown(self):
+        """Stop the async I/O threads (after draining)."""
+        if self._io_push is not None:
+            self._io_push.stop()
+        if self._io_pull is not None and self._io_pull is not self._io_push:
+            self._io_pull.stop()
+        self._io_push = self._io_pull = None
+        self.async_enabled = False
 
     # ------------------------------------------------------------------
     def save(self, directory: str):
         """Server-side checkpoint of PS params (reference executor.py:355)."""
+        self.drain()
         if self.comm.rank == 0:
             for p in self.params.values():
                 self.comm.SaveParam(p.ps_id, directory)
         self.comm.BarrierWorker()
 
     def load(self, directory: str):
+        self.drain()
+        self._prefetched.clear()  # prefetched rows predate the restore
         if self.comm.rank == 0:
             for p in self.params.values():
                 self.comm.LoadParam(p.ps_id, directory)
@@ -279,10 +436,12 @@ class PSRuntime:
                 p.host_value = buf.reshape(p.shape)
 
     def pull_dense_value(self, p: PSParam) -> np.ndarray:
+        self.drain()
         buf = np.zeros(int(np.prod(p.shape)), np.float32)
         self.comm.Pull(p.ps_id, buf)
         self.comm.Wait(p.ps_id)
         return buf.reshape(p.shape)
 
     def pull_sparse_rows(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
-        return self.stage_lookup(p, idx)
+        self.drain()
+        return self._pull_rows(p, idx)

@@ -133,6 +133,87 @@ def _ps_checkpoint(client, rank, tmpdir):
     np.testing.assert_allclose(after, before, rtol=1e-6)
 
 
+def _make_loader_model(ht, steps, seed, batch=BATCH):
+    """Dataloader-fed embedding model (prefetch needs peekable batches)."""
+    rng = np.random.RandomState(seed)
+    bidx, by = [], []
+    for _ in range(steps):
+        bi, b = _gen_batch(rng)
+        bidx.append(bi)
+        by.append(b)
+    bidx = np.concatenate(bidx)
+    by = np.concatenate(by)
+    embed = ht.init.random_normal((NROWS, WIDTH), stddev=0.1, name="embed",
+                                  is_embed=True)
+    idx = ht.dataloader_op([ht.Dataloader(bidx, batch, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(by, batch, "train")])
+    vec = ht.embedding_lookup_op(embed, idx)
+    flat = ht.array_reshape_op(vec, (-1, SLOTS * WIDTH))
+    w = ht.init.xavier_uniform((SLOTS * WIDTH, 1), name="w")
+    prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return loss, train_op
+
+
+def _prefetch_overlap(client, rank, tmpdir):
+    """prefetch=True (default): after the first step every pull is a
+    prefetch hit issued while the previous step ran; pushes are async."""
+    import hetu_tpu as ht
+    steps = 40
+    loss, train_op = _make_loader_model(ht, steps, seed=13 + rank)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="Hybrid")
+    losses = [float(ex.run("train")[0].asnumpy()) for _ in range(steps)]
+    perf = ex.ps_runtime.perf
+    assert perf["prefetch_hits"] >= steps - 2, perf
+    assert perf["sync_pulls"] <= 2, perf
+    ex.ps_runtime.drain()
+    assert perf["async_pushes"] >= steps - 1, perf
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]), (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
+    client.BarrierWorker()
+
+
+def _bsp_prefetch_losses(client, rank, tmpdir, prefetch):
+    """BSP + single worker: prefetch rides the push stream (push -> barrier ->
+    pull ordering), so training is bit-identical to the synchronous path."""
+    import hetu_tpu as ht
+    steps = 30
+    loss, train_op = _make_loader_model(ht, steps, seed=21)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=0,
+                     comm_mode="Hybrid", bsp=True, prefetch=prefetch)
+    losses = [float(ex.run("train")[0].asnumpy()) for _ in range(steps)]
+    np.save(f"{tmpdir}/bsp_losses_{int(bool(prefetch))}.npy",
+            np.asarray(losses))
+    if prefetch:
+        ex.ps_runtime.drain()
+        assert ex.ps_runtime.perf["prefetch_hits"] >= steps - 2, \
+            ex.ps_runtime.perf
+    client.BarrierWorker()
+
+
+def _bsp_prefetch_on(client, rank, tmpdir):
+    _bsp_prefetch_losses(client, rank, tmpdir, prefetch=True)
+
+
+def _bsp_prefetch_off(client, rank, tmpdir):
+    _bsp_prefetch_losses(client, rank, tmpdir, prefetch=False)
+
+
+def test_prefetch_overlap(tmp_path):
+    run_cluster(_prefetch_overlap, tmp_path, n_workers=1, timeout=300)
+
+
+def test_bsp_prefetch_exact(tmp_path):
+    run_cluster(_bsp_prefetch_on, tmp_path, n_workers=1, timeout=300)
+    run_cluster(_bsp_prefetch_off, tmp_path, n_workers=1, timeout=300)
+    a = np.load(f"{tmp_path}/bsp_losses_1.npy")
+    b = np.load(f"{tmp_path}/bsp_losses_0.npy")
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
 def test_hybrid_training(tmp_path):
     run_cluster(_hybrid_training, tmp_path, n_workers=2, timeout=300)
 
