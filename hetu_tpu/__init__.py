@@ -36,5 +36,6 @@ from . import lr_scheduler as lr
 from . import initializers as init
 from . import data
 from . import metrics
+from . import onnx
 
 __version__ = "0.1.0"

@@ -141,6 +141,9 @@ class FunctionalOp(Op):
         self.opname = opname
         self.fn = fn
         self.attrs = attrs
+        # introspection-only metadata (ONNX export, graphboard); never passed
+        # to ``fn`` — constructors close over the actual values
+        self.export_attrs: dict = {}
 
     def compute(self, input_vals, tc):
         return self.fn(*input_vals, **self.attrs)

@@ -19,7 +19,9 @@ def add_op(node_A, node_B, ctx=None):
 
 
 def addbyconst_op(node, const_val, ctx=None):
-    return FunctionalOp("AddConst", lambda x, c=const_val: x + c, [node], ctx)
+    op = FunctionalOp("AddConst", lambda x, c=const_val: x + c, [node], ctx)
+    op.export_attrs = {"const_val": const_val}
+    return op
 
 
 def mul_op(node_A, node_B, ctx=None):
@@ -27,7 +29,9 @@ def mul_op(node_A, node_B, ctx=None):
 
 
 def mul_byconst_op(node, const_val, ctx=None):
-    return FunctionalOp("MultiplyConst", lambda x, c=const_val: x * c, [node], ctx)
+    op = FunctionalOp("MultiplyConst", lambda x, c=const_val: x * c, [node], ctx)
+    op.export_attrs = {"const_val": const_val}
+    return op
 
 
 def div_op(node_A, node_B, ctx=None):
@@ -35,7 +39,9 @@ def div_op(node_A, node_B, ctx=None):
 
 
 def div_const_op(const_val, node_A, ctx=None):
-    return FunctionalOp("DivConst", lambda x, c=const_val: c / x, [node_A], ctx)
+    op = FunctionalOp("DivConst", lambda x, c=const_val: c / x, [node_A], ctx)
+    op.export_attrs = {"const_val": const_val}
+    return op
 
 
 def opposite_op(node, ctx=None):
@@ -78,8 +84,10 @@ def relu_gradient_op(node, grad_node, ctx=None):
 
 
 def leaky_relu_op(node, alpha, ctx=None):
-    return FunctionalOp("LeakyRelu", lambda x, a=alpha: jnp.where(x > 0, x, a * x),
-                        [node], ctx)
+    op = FunctionalOp("LeakyRelu", lambda x, a=alpha: jnp.where(x > 0, x, a * x),
+                      [node], ctx)
+    op.export_attrs = {"alpha": float(alpha)}
+    return op
 
 
 def leaky_relu_gradient_op(node_A, node_B, alpha, ctx=None):

@@ -23,7 +23,9 @@ def matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
             b = b.T
         return jnp.dot(a, b, preferred_element_type=jnp.float32)
 
-    return FunctionalOp("MatMul", _mm, [node_A, node_B], ctx)
+    op = FunctionalOp("MatMul", _mm, [node_A, node_B], ctx)
+    op.export_attrs = {"trans_A": bool(trans_A), "trans_B": bool(trans_B)}
+    return op
 
 
 def batch_matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
@@ -34,7 +36,9 @@ def batch_matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
             b = jnp.swapaxes(b, -1, -2)
         return jnp.matmul(a, b, preferred_element_type=jnp.float32)
 
-    return FunctionalOp("BatchMatMul", _bmm, [node_A, node_B], ctx)
+    op = FunctionalOp("BatchMatMul", _bmm, [node_A, node_B], ctx)
+    op.export_attrs = {"trans_A": bool(trans_A), "trans_B": bool(trans_B)}
+    return op
 
 
 def matrix_dot_op(node_A, node_B, axes=0, ctx=None):

@@ -17,9 +17,11 @@ from ..node import FunctionalOp
 
 
 def array_reshape_op(node, output_shape, ctx=None):
-    return FunctionalOp("ArrayReshape",
-                        lambda x, s=tuple(output_shape): jnp.reshape(x, s),
-                        [node], ctx)
+    op = FunctionalOp("ArrayReshape",
+                      lambda x, s=tuple(output_shape): jnp.reshape(x, s),
+                      [node], ctx)
+    op.export_attrs = {"output_shape": tuple(int(s) for s in output_shape)}
+    return op
 
 
 def array_reshape_gradient_op(node_in, node_out, ctx=None):
@@ -30,7 +32,9 @@ def array_reshape_gradient_op(node_in, node_out, ctx=None):
 
 
 def transpose_op(node, perm=None, ctx=None):
-    return FunctionalOp("Transpose", lambda x, p=perm: jnp.transpose(x, p), [node], ctx)
+    op = FunctionalOp("Transpose", lambda x, p=perm: jnp.transpose(x, p), [node], ctx)
+    op.export_attrs = {"perm": None if perm is None else tuple(int(p) for p in perm)}
+    return op
 
 
 def slice_op(node, begin, size, ctx=None):
@@ -42,7 +46,9 @@ def slice_op(node, begin, size, ctx=None):
                    for i in range(len(size)))
         return jax.lax.dynamic_slice(x, begin, sz)
 
-    return FunctionalOp("Slice", _slice, [node], ctx)
+    op = FunctionalOp("Slice", _slice, [node], ctx)
+    op.export_attrs = {"begin": begin, "size": size}
+    return op
 
 
 def slice_gradient_op(node, begin, size=None, ctx=None):
@@ -99,9 +105,11 @@ def split_gradient_op(node, axes, indices, splits, ctx=None):
 
 
 def concat_op(node_A, node_B, axis=0, ctx=None):
-    return FunctionalOp("Concat",
-                        lambda a, b, ax=axis: jnp.concatenate([a, b], axis=ax),
-                        [node_A, node_B], ctx)
+    op = FunctionalOp("Concat",
+                      lambda a, b, ax=axis: jnp.concatenate([a, b], axis=ax),
+                      [node_A, node_B], ctx)
+    op.export_attrs = {"axis": int(axis)}
+    return op
 
 
 def concat_gradient_op(grad_node, input_node, axis, idx, ctx=None):
@@ -123,7 +131,9 @@ def pad_op(node, paddings, mode="CONSTANT", constant_values=0, ctx=None):
         full = [(0, 0)] * (x.ndim - len(pads)) + pads
         return jnp.pad(x, full, constant_values=constant_values)
 
-    return FunctionalOp("Pad", _pad, [node], ctx)
+    op = FunctionalOp("Pad", _pad, [node], ctx)
+    op.export_attrs = {"paddings": pads, "constant_values": constant_values}
+    return op
 
 
 def pad_gradient_op(node, paddings, mode="CONSTANT", ctx=None):
@@ -162,16 +172,20 @@ def broadcast_shape_op(node, shape, add_axes=(), ctx=None):
 
 def reduce_sum_op(node, axes, keepdims=False, ctx=None):
     axes = tuple(int(a) for a in np.atleast_1d(axes))
-    return FunctionalOp("ReduceSum",
-                        lambda x: jnp.sum(x, axis=axes, keepdims=keepdims),
-                        [node], ctx)
+    op = FunctionalOp("ReduceSum",
+                      lambda x: jnp.sum(x, axis=axes, keepdims=keepdims),
+                      [node], ctx)
+    op.export_attrs = {"axes": axes, "keepdims": bool(keepdims)}
+    return op
 
 
 def reduce_mean_op(node, axes, keepdims=False, ctx=None):
     axes = tuple(int(a) for a in np.atleast_1d(axes))
-    return FunctionalOp("ReduceMean",
-                        lambda x: jnp.mean(x, axis=axes, keepdims=keepdims),
-                        [node], ctx)
+    op = FunctionalOp("ReduceMean",
+                      lambda x: jnp.mean(x, axis=axes, keepdims=keepdims),
+                      [node], ctx)
+    op.export_attrs = {"axes": axes, "keepdims": bool(keepdims)}
+    return op
 
 
 def reducesumaxiszero_op(node, ctx=None):
@@ -179,7 +193,9 @@ def reducesumaxiszero_op(node, ctx=None):
 
 
 def one_hot_op(node, num_classes, ctx=None):
-    return FunctionalOp("OneHot",
-                        lambda x, n=int(num_classes): jax.nn.one_hot(
-                            x.astype(jnp.int32), n, dtype=jnp.float32),
-                        [node], ctx)
+    op = FunctionalOp("OneHot",
+                      lambda x, n=int(num_classes): jax.nn.one_hot(
+                          x.astype(jnp.int32), n, dtype=jnp.float32),
+                      [node], ctx)
+    op.export_attrs = {"num_classes": int(num_classes)}
+    return op

@@ -1,0 +1,10 @@
+"""ONNX bridge (reference ``python/hetu/onnx/`` — export ``hetu2onnx.py:27``,
+import ``onnx2hetu.py`` + ``X2hetu/``).
+
+Self-contained: serialization uses the vendored wire codec in ``proto.py``
+(the ``onnx`` pip package is not required); files written/read are standard
+``.onnx`` protobufs.
+"""
+from . import hetu2onnx, onnx2hetu, proto
+
+__all__ = ["hetu2onnx", "onnx2hetu", "proto"]

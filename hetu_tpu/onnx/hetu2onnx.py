@@ -1,0 +1,467 @@
+"""hetu_tpu graph -> ONNX export (reference ``python/hetu/onnx/hetu2onnx.py:27``).
+
+API parity: ``export(executor, inputs, outputs, path)``. Each graph op maps to
+standard ONNX ops via the handler registry below (mirroring the reference's
+``onnx_opset`` per-op handler modules); parameter values come from the
+executor's state (or the PS for PS-hosted params), BatchNorm running stats
+export as inference-mode mean/var initializers.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..graph.node import Op, PlaceholderOp
+from ..graph.ops.dropout import DropoutOp
+from ..graph.ops.norm import BatchNormOp
+from . import proto as P
+
+OPSET_VERSION = 13
+
+_HANDLERS: dict[str, Callable] = {}
+
+
+def handles(*opnames):
+    def deco(fn):
+        for n in opnames:
+            _HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+class ExportContext:
+    """Name allocation + graph assembly state for one export."""
+
+    def __init__(self, executor):
+        self.executor = executor
+        self.nodes: list[P.NodeProto] = []
+        self.initializers: list[P.TensorProto] = []
+        self._names: dict[int, str] = {}
+        self._used: set[str] = set()
+        self.shapes: dict[int, tuple] = {}  # id(op) -> inferred shape
+
+    def name_of(self, op: Op) -> str:
+        if id(op) not in self._names:
+            base = op.name
+            name, k = base, 1
+            while name in self._used:
+                name, k = f"{base}_{k}", k + 1
+            self._used.add(name)
+            self._names[id(op)] = name
+        return self._names[id(op)]
+
+    def fresh(self, base: str) -> str:
+        name, k = base, 1
+        while name in self._used:
+            name, k = f"{base}_{k}", k + 1
+        self._used.add(name)
+        return name
+
+    def add_node(self, op_type: str, inputs: list[str], outputs: list[str],
+                 name: Optional[str] = None, **attrs):
+        self.nodes.append(P.NodeProto(
+            op_type=op_type, input=inputs, output=outputs,
+            name=name or self.fresh(op_type),
+            attribute=[P.make_attr(k, v) for k, v in attrs.items()
+                       if v is not None]))
+
+    def add_initializer(self, value: np.ndarray, base_name: str) -> str:
+        name = self.fresh(base_name)
+        self.initializers.append(P.tensor_from_numpy(np.asarray(value), name))
+        return name
+
+    def shape(self, op: Op):
+        return self.shapes.get(id(op))
+
+
+# ---------------------------------------------------------------------------
+# per-op handlers: (ctx, op, in_names, out_name) -> None (append NodeProtos)
+# ---------------------------------------------------------------------------
+
+_DIRECT = {
+    "AddElewise": "Add", "MultiplyElewise": "Mul", "Division": "Div",
+    "Relu": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh", "Sqrt": "Sqrt",
+    "Opposite": "Neg", "Exp": "Exp", "Log": "Log",
+}
+
+
+@handles("AddElewise", "MultiplyElewise", "Division", "Relu", "Sigmoid",
+         "Tanh", "Sqrt", "Opposite", "Exp", "Log")
+def _direct(ctx, op, ins, out):
+    ctx.add_node(_DIRECT[op.opname], ins, [out])
+
+
+@handles("OnesLike", "ZerosLike")
+def _constlike(ctx, op, ins, out):
+    shape = ctx.shape(op.inputs[0])
+    fill = 1.0 if op.opname == "OnesLike" else 0.0
+    if shape is not None and None not in shape:
+        ctx.add_node("Constant", [], [out],
+                     value=np.full(shape, fill, np.float32))
+    else:
+        sname = ctx.fresh(out + "_shape")
+        ctx.add_node("Shape", ins, [sname])
+        ctx.add_node("ConstantOfShape", [sname], [out],
+                     value=np.asarray([fill], np.float32))
+
+
+@handles("AddConst", "MultiplyConst", "DivConst")
+def _const_binop(ctx, op, ins, out):
+    c = ctx.add_initializer(np.asarray(op.export_attrs["const_val"],
+                                       np.float32), out + "_const")
+    onnx_op = {"AddConst": "Add", "MultiplyConst": "Mul",
+               "DivConst": "Div"}[op.opname]
+    # DivConst is const/x — constant is the FIRST operand
+    pair = [c, ins[0]] if op.opname == "DivConst" else [ins[0], c]
+    ctx.add_node(onnx_op, pair, [out])
+
+
+@handles("LeakyRelu")
+def _leaky(ctx, op, ins, out):
+    ctx.add_node("LeakyRelu", ins, [out], alpha=float(op.export_attrs["alpha"]))
+
+
+@handles("Softmax")
+def _softmax(ctx, op, ins, out):
+    ctx.add_node("Softmax", ins, [out], axis=-1)
+
+
+@handles("MatMul", "BatchMatMul")
+def _matmul(ctx, op, ins, out):
+    def swap_last_two(name, node_in, tag):
+        shape = ctx.shape(node_in)
+        if shape is None:
+            raise NotImplementedError(
+                f"{op.name}: exporting a transposed matmul operand needs its "
+                "rank; pass input_shapes to export()")
+        rank = len(shape)
+        perm = list(range(rank - 2)) + [rank - 1, rank - 2]
+        t = ctx.fresh(out + tag)
+        ctx.add_node("Transpose", [name], [t], perm=perm)
+        return t
+
+    a, b = ins
+    if op.export_attrs.get("trans_A"):
+        a = swap_last_two(a, op.inputs[0], "_ta")
+    if op.export_attrs.get("trans_B"):
+        b = swap_last_two(b, op.inputs[1], "_tb")
+    ctx.add_node("MatMul", [a, b], [out])
+
+
+@handles("Conv2d")
+def _conv(ctx, op, ins, out):
+    p, s = op.export_attrs["padding"], op.export_attrs["stride"]
+    ctx.add_node("Conv", ins, [out], pads=[p, p, p, p], strides=[s, s])
+
+
+@handles("MaxPool2d", "AvgPool2d")
+def _pool(ctx, op, ins, out):
+    a = op.export_attrs
+    kw = dict(kernel_shape=[a["kernel_H"], a["kernel_W"]],
+              pads=[a["padding"]] * 4, strides=[a["stride"]] * 2)
+    if op.opname == "MaxPool2d":
+        ctx.add_node("MaxPool", ins, [out], **kw)
+    else:
+        # our avg divides by the full kernel area (reference semantics)
+        ctx.add_node("AveragePool", ins, [out], count_include_pad=1, **kw)
+
+
+@handles("ArrayReshape")
+def _reshape(ctx, op, ins, out):
+    shape = ctx.add_initializer(
+        np.asarray(op.export_attrs["output_shape"], np.int64), out + "_shape")
+    ctx.add_node("Reshape", [ins[0], shape], [out])
+
+
+@handles("Transpose")
+def _transpose(ctx, op, ins, out):
+    perm = op.export_attrs.get("perm")
+    if perm is None:
+        ctx.add_node("Transpose", ins, [out])
+    else:
+        ctx.add_node("Transpose", ins, [out], perm=list(perm))
+
+
+@handles("Concat")
+def _concat(ctx, op, ins, out):
+    ctx.add_node("Concat", ins, [out], axis=int(op.export_attrs["axis"]))
+
+
+@handles("Slice")
+def _slice(ctx, op, ins, out):
+    begin = op.export_attrs["begin"]
+    size = op.export_attrs["size"]
+    in_shape = ctx.shape(op.inputs[0])
+    ends = []
+    for i, (b, sz) in enumerate(zip(begin, size)):
+        if sz == -1:
+            ends.append(np.iinfo(np.int64).max if in_shape is None
+                        else in_shape[i])
+        else:
+            ends.append(b + sz)
+    starts = ctx.add_initializer(np.asarray(begin, np.int64), out + "_starts")
+    ends_n = ctx.add_initializer(np.asarray(ends, np.int64), out + "_ends")
+    ctx.add_node("Slice", [ins[0], starts, ends_n], [out])
+
+
+@handles("Pad")
+def _pad(ctx, op, ins, out):
+    pads = op.export_attrs["paddings"]
+    rank = len(ctx.shape(op.inputs[0]) or pads)
+    full = [(0, 0)] * (rank - len(pads)) + list(pads)
+    onnx_pads = [p[0] for p in full] + [p[1] for p in full]
+    pads_n = ctx.add_initializer(np.asarray(onnx_pads, np.int64), out + "_pads")
+    cval = ctx.add_initializer(
+        np.asarray(op.export_attrs["constant_values"], np.float32),
+        out + "_cval")
+    ctx.add_node("Pad", [ins[0], pads_n, cval], [out], mode="constant")
+
+
+def _emit_reduce_sum(ctx, ins, out, axes, keepdims):
+    # opset 13 moved ReduceSum's axes from attribute to input
+    axes_n = ctx.add_initializer(np.asarray(axes, np.int64), out + "_axes")
+    ctx.add_node("ReduceSum", [ins[0], axes_n], [out], keepdims=int(keepdims))
+
+
+@handles("ReduceSum", "ReduceMean")
+def _reduce(ctx, op, ins, out):
+    a = op.export_attrs
+    if op.opname == "ReduceSum":
+        _emit_reduce_sum(ctx, ins, out, list(a["axes"]), a["keepdims"])
+    else:  # ReduceMean keeps axes as an attribute through opset 17
+        ctx.add_node("ReduceMean", ins, [out], axes=list(a["axes"]),
+                     keepdims=int(a["keepdims"]))
+
+
+@handles("ReduceSumAxisZero")
+def _reduce0(ctx, op, ins, out):
+    _emit_reduce_sum(ctx, ins, out, [0], 0)
+
+
+@handles("OneHot")
+def _onehot(ctx, op, ins, out):
+    n = op.export_attrs["num_classes"]
+    idx = ctx.fresh(out + "_idx64")
+    ctx.add_node("Cast", ins, [idx], to=P.TensorProto.INT64)
+    depth = ctx.add_initializer(np.asarray(n, np.int64), out + "_depth")
+    values = ctx.add_initializer(np.asarray([0.0, 1.0], np.float32),
+                                 out + "_values")
+    ctx.add_node("OneHot", [idx, depth, values], [out], axis=-1)
+
+
+@handles("BroadcastTo")
+def _broadcast(ctx, op, ins, out):
+    sname = ctx.fresh(out + "_shape")
+    ctx.add_node("Shape", [ins[1]], [sname])
+    ctx.add_node("Expand", [ins[0], sname], [out])
+
+
+@handles("Conv2dBroadcastTo")
+def _conv_broadcast(ctx, op, ins, out):
+    # (C,) bias -> (N,C,H,W): reshape to (1,C,1,1) then Expand to x's shape
+    shp = ctx.add_initializer(np.asarray([1, -1, 1, 1], np.int64),
+                              out + "_bshape")
+    r = ctx.fresh(out + "_r")
+    ctx.add_node("Reshape", [ins[0], shp], [r])
+    sname = ctx.fresh(out + "_shape")
+    ctx.add_node("Shape", [ins[1]], [sname])
+    ctx.add_node("Expand", [r, sname], [out])
+
+
+@handles("Conv2dReduceSum")
+def _conv_reduce(ctx, op, ins, out):
+    _emit_reduce_sum(ctx, ins, out, [0, 2, 3], 0)
+
+
+@handles("Where")
+def _where(ctx, op, ins, out):
+    cond = ctx.fresh(out + "_cond")
+    ctx.add_node("Cast", [ins[0]], [cond], to=P.TensorProto.BOOL)
+    ctx.add_node("Where", [cond, ins[1], ins[2]], [out])
+
+
+@handles("EmbeddingLookUp")
+def _gather(ctx, op, ins, out):
+    idx = ctx.fresh(out + "_idx64")
+    ctx.add_node("Cast", [ins[1]], [idx], to=P.TensorProto.INT64)
+    ctx.add_node("Gather", [ins[0], idx], [out], axis=0)
+
+
+@handles("LayerNorm")
+def _layernorm(ctx, op, ins, out):
+    # fn closes over eps; LayerNormalization is opset 17 — export the
+    # composition instead for wide consumer support
+    eps = op.fn.__defaults__[0] if op.fn.__defaults__ else 1e-2
+    mean = ctx.fresh(out + "_mean")
+    ctx.add_node("ReduceMean", [ins[0]], [mean], axes=[-1], keepdims=1)
+    cent = ctx.fresh(out + "_cent")
+    ctx.add_node("Sub", [ins[0], mean], [cent])
+    sq = ctx.fresh(out + "_sq")
+    ctx.add_node("Mul", [cent, cent], [sq])
+    var = ctx.fresh(out + "_var")
+    ctx.add_node("ReduceMean", [sq], [var], axes=[-1], keepdims=1)
+    eps_n = ctx.add_initializer(np.asarray(eps, np.float32), out + "_eps")
+    ve = ctx.fresh(out + "_ve")
+    ctx.add_node("Add", [var, eps_n], [ve])
+    std = ctx.fresh(out + "_std")
+    ctx.add_node("Sqrt", [ve], [std])
+    norm = ctx.fresh(out + "_norm")
+    ctx.add_node("Div", [cent, std], [norm])
+    scaled = ctx.fresh(out + "_scaled")
+    ctx.add_node("Mul", [norm, ins[1]], [scaled])
+    ctx.add_node("Add", [scaled, ins[2]], [out])
+
+
+def _handle_batchnorm(ctx, op: BatchNormOp, ins, out):
+    ex = ctx.executor
+    state = None
+    if ex is not None:
+        state = ex.state["op_state"].get(id(op))
+    if state is None:
+        c = int(np.prod(op.inputs[1].shape))
+        state = {"mean": np.zeros(c, np.float32), "var": np.ones(c, np.float32)}
+    mean = ctx.add_initializer(np.asarray(state["mean"], np.float32),
+                               out + "_mean")
+    var = ctx.add_initializer(np.asarray(state["var"], np.float32),
+                              out + "_var")
+    ctx.add_node("BatchNormalization", [ins[0], ins[1], ins[2], mean, var],
+                 [out], epsilon=float(op.eps), momentum=float(op.momentum))
+
+
+def _handle_dropout(ctx, op: DropoutOp, ins, out):
+    ctx.add_node("Dropout", ins, [out], )  # inference: identity
+
+
+# ---------------------------------------------------------------------------
+# shape inference over the graph (export needs ranks/sizes for several ops)
+# ---------------------------------------------------------------------------
+
+def _infer_shapes(topo, input_shapes: dict[int, tuple], ctx: ExportContext):
+    for op in topo:
+        if id(op) in input_shapes:
+            ctx.shapes[id(op)] = tuple(input_shapes[id(op)])
+            continue
+        if isinstance(op, PlaceholderOp):
+            if op.shape is not None:
+                ctx.shapes[id(op)] = tuple(op.shape)
+            continue
+        in_shapes = [ctx.shapes.get(id(i)) for i in op.inputs]
+        if any(s is None for s in in_shapes):
+            continue
+        try:
+            if isinstance(op, BatchNormOp):
+                ctx.shapes[id(op)] = in_shapes[0]
+            elif isinstance(op, DropoutOp):
+                ctx.shapes[id(op)] = in_shapes[0]
+            else:
+                ctx.shapes[id(op)] = tuple(op.infer_shape(in_shapes))
+        except Exception:  # noqa: BLE001 — shapes are advisory for export
+            pass
+
+
+# ---------------------------------------------------------------------------
+# export driver
+# ---------------------------------------------------------------------------
+
+def export(executor, inputs: list, outputs: list, path: str,
+           job_name: str = None, input_shapes: Optional[dict] = None):
+    """Export the subgraph computing ``outputs`` from ``inputs``.
+
+    ``executor`` supplies parameter values (pass None for an untrained graph —
+    initializers then come from Variable values). ``input_shapes`` optionally
+    maps input node -> shape when the placeholders carry none.
+    """
+    assert inputs and outputs
+    ctx = ExportContext(executor)
+    input_ids = {id(n) for n in inputs}
+    # topo CUT at the input boundary: nodes upstream of a declared input are
+    # outside the exported subgraph (they would otherwise be emitted dead and
+    # their feeds demanded as model inputs)
+    topo = []
+    visited: set[int] = set()
+
+    def _dfs(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        if id(node) not in input_ids:
+            for i in node.inputs:
+                _dfs(i)
+        topo.append(node)
+
+    for n in outputs:
+        _dfs(n)
+
+    shape_map = {}
+    if input_shapes:
+        shape_map = {id(k): tuple(v) for k, v in input_shapes.items()}
+    _infer_shapes(topo, shape_map, ctx)
+
+    # parameter values
+    def param_value(node: PlaceholderOp) -> np.ndarray:
+        if executor is not None:
+            ps = getattr(executor, "ps_runtime", None)
+            if ps is not None and id(node) in ps.params:
+                p = ps.params[id(node)]
+                if p.sparse:
+                    rows = int(node.shape[0])
+                    return ps.pull_sparse_rows(
+                        p, np.arange(rows)).reshape(node.shape)
+                return ps.pull_dense_value(p)
+            val = executor.state["params"].get(id(node))
+            if val is not None:
+                return np.asarray(val)
+        return np.asarray(node.instantiate(_init_key()), np.float32)
+
+    graph_inputs = []
+    for node in topo:
+        if id(node) in input_ids:
+            graph_inputs.append(
+                P.make_value_info(ctx.name_of(node), ctx.shape(node)))
+            continue
+        if isinstance(node, PlaceholderOp):
+            if node.trainable or node.value is not None \
+                    or node.initializer is not None:
+                ctx.initializers.append(P.tensor_from_numpy(
+                    param_value(node), ctx.name_of(node)))
+            else:
+                graph_inputs.append(
+                    P.make_value_info(ctx.name_of(node), ctx.shape(node)))
+                input_ids.add(id(node))
+            continue
+        if node.is_dataloader:
+            raise ValueError(
+                f"{node.name}: dataloader nodes cannot be exported; list "
+                "them in `inputs` replaced by placeholders")
+        ins = [ctx.name_of(i) for i in node.inputs]
+        out = ctx.name_of(node)
+        if isinstance(node, BatchNormOp):
+            _handle_batchnorm(ctx, node, ins, out)
+        elif isinstance(node, DropoutOp):
+            _handle_dropout(ctx, node, ins, out)
+        else:
+            opname = getattr(node, "opname", None)
+            handler = _HANDLERS.get(opname)
+            if handler is None:
+                raise NotImplementedError(
+                    f"no ONNX handler for op {opname or type(node).__name__} "
+                    f"({node.name})")
+            handler(ctx, node, ins, out)
+
+    graph_outputs = [P.make_value_info(ctx.name_of(n), ctx.shape(n))
+                     for n in outputs]
+    graph = P.GraphProto(node=ctx.nodes, name=job_name or "HetuTpuToOnnx",
+                         initializer=ctx.initializers,
+                         input=graph_inputs, output=graph_outputs)
+    model = P.ModelProto(ir_version=8, producer_name="hetu_tpu",
+                         producer_version="0.1", graph=graph,
+                         opset_import=[P.OperatorSetIdProto(domain="",
+                                                            version=OPSET_VERSION)])
+    P.save_model(model, path)
+    return model
+
+
+def _init_key():
+    import jax
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,301 @@
+"""ONNX -> hetu_tpu graph import (reference ``python/hetu/onnx/onnx2hetu.py``
+and ``X2hetu/``).
+
+``load(path)`` parses a standard ``.onnx`` protobuf and rebuilds the graph
+with this framework's ops: initializers become trainable Variables, graph
+inputs become fed placeholders, and each ONNX node maps through the handler
+registry below (the inverse of ``hetu2onnx``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..graph import ops as O
+from ..graph.node import Variable
+from . import proto as P
+
+_IMPORTERS: dict[str, Callable] = {}
+
+
+def imports(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _IMPORTERS[t] = fn
+        return fn
+    return deco
+
+
+class ImportContext:
+    def __init__(self):
+        self.values: dict[str, Any] = {}    # name -> Op node
+        self.consts: dict[str, np.ndarray] = {}  # names with static values
+        self.inputs: dict[str, Any] = {}    # fed placeholders by name
+
+    def const(self, name):
+        """Static value of an input (initializer / Constant output), if any."""
+        return self.consts.get(name)
+
+
+def _attrs(node: P.NodeProto) -> dict[str, Any]:
+    return {a.name: P.attr_value(a) for a in node.attribute}
+
+
+@imports("Add", "Mul", "Div", "Sub")
+def _i_binop(ctx, node, ins, attrs):
+    a, b = ins
+    ops = {"Add": O.add_op, "Mul": O.mul_op, "Div": O.div_op}
+    if node.op_type == "Sub":
+        return O.add_op(a, O.opposite_op(b))
+    return ops[node.op_type](a, b)
+
+
+@imports("Relu", "Sigmoid", "Tanh", "Sqrt", "Neg", "Exp", "Log", "Identity",
+         "Dropout")
+def _i_unary(ctx, node, ins, attrs):
+    ops = {"Relu": O.relu_op, "Sigmoid": O.sigmoid_op, "Tanh": O.tanh_op,
+           "Sqrt": O.sqrt_op, "Neg": O.opposite_op, "Exp": O.exp_op,
+           "Log": O.log_op}
+    if node.op_type in ("Identity", "Dropout"):  # inference dropout = id
+        return ins[0]
+    return ops[node.op_type](ins[0])
+
+
+@imports("LeakyRelu")
+def _i_leaky(ctx, node, ins, attrs):
+    return O.leaky_relu_op(ins[0], attrs.get("alpha", 0.01))
+
+
+@imports("Softmax")
+def _i_softmax(ctx, node, ins, attrs):
+    axis = attrs.get("axis", -1)
+    if axis != -1:
+        raise NotImplementedError(
+            f"Softmax axis={axis}: only last-axis softmax is supported "
+            "(transpose around the op to import axis-k softmax)")
+    return O.softmax_op(ins[0])
+
+
+@imports("MatMul")
+def _i_matmul(ctx, node, ins, attrs):
+    return O.matmul_op(ins[0], ins[1])
+
+
+@imports("Gemm")
+def _i_gemm(ctx, node, ins, attrs):
+    y = O.matmul_op(ins[0], ins[1], trans_A=bool(attrs.get("transA", 0)),
+                    trans_B=bool(attrs.get("transB", 0)))
+    alpha, beta = attrs.get("alpha", 1.0), attrs.get("beta", 1.0)
+    if alpha != 1.0:
+        y = O.mul_byconst_op(y, alpha)
+    if len(ins) > 2:
+        b = ins[2] if beta == 1.0 else O.mul_byconst_op(ins[2], beta)
+        y = O.add_op(y, O.broadcastto_op(b, y))
+    return y
+
+
+@imports("Conv")
+def _i_conv(ctx, node, ins, attrs):
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    strides = attrs.get("strides", [1, 1])
+    assert pads[0] == pads[1] == pads[2] == pads[3], \
+        f"only symmetric conv pads supported, got {pads}"
+    assert strides[0] == strides[1], strides
+    y = O.conv2d_op(ins[0], ins[1], padding=pads[0], stride=strides[0])
+    if len(ins) > 2:  # bias
+        y = O.add_op(y, O.conv2d_broadcastto_op(ins[2], y))
+    return y
+
+
+@imports("MaxPool", "AveragePool")
+def _i_pool(ctx, node, ins, attrs):
+    kh, kw = attrs["kernel_shape"]
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    strides = attrs.get("strides", [1, 1])
+    assert pads[0] == pads[1] == pads[2] == pads[3], pads
+    assert strides[0] == strides[1], strides
+    if node.op_type == "MaxPool":
+        return O.max_pool2d_op(ins[0], kh, kw, pads[0], strides[0])
+    if pads[0] != 0 and not attrs.get("count_include_pad", 0):
+        raise NotImplementedError(
+            "AveragePool with pads and count_include_pad=0: this framework's "
+            "avg pool divides by the full kernel area (reference semantics)")
+    return O.avg_pool2d_op(ins[0], kh, kw, pads[0], strides[0])
+
+
+@imports("BatchNormalization")
+def _i_bn(ctx, node, ins, attrs):
+    x, scale, bias, mean, var = ins
+    # imported BN starts from the exported running stats; they continue to
+    # update if the imported graph is trained
+    op = O.batch_normalization_op(x, scale, bias,
+                                  momentum=attrs.get("momentum", 0.9),
+                                  eps=attrs.get("epsilon", 1e-5))
+    mean_v = ctx.const(node.input[3])
+    var_v = ctx.const(node.input[4])
+    if mean_v is not None and var_v is not None:
+        op.state_init = lambda: {"mean": np.asarray(mean_v, np.float32),
+                                 "var": np.asarray(var_v, np.float32)}
+    return op
+
+
+@imports("Reshape")
+def _i_reshape(ctx, node, ins, attrs):
+    shape = ctx.const(node.input[1])
+    assert shape is not None, "Reshape with dynamic shape input unsupported"
+    return O.array_reshape_op(ins[0], tuple(int(s) for s in shape))
+
+
+@imports("Transpose")
+def _i_transpose(ctx, node, ins, attrs):
+    return O.transpose_op(ins[0], attrs.get("perm"))
+
+
+@imports("Concat")
+def _i_concat(ctx, node, ins, attrs):
+    out = ins[0]
+    for nxt in ins[1:]:
+        out = O.concat_op(out, nxt, axis=attrs["axis"])
+    return out
+
+
+@imports("Slice")
+def _i_slice(ctx, node, ins, attrs):
+    starts = ctx.const(node.input[1])
+    ends = ctx.const(node.input[2])
+    assert starts is not None and ends is not None, \
+        "Slice with dynamic starts/ends unsupported"
+    imax = np.iinfo(np.int64).max
+    size = [-1 if e >= imax else int(e - s) for s, e in zip(starts, ends)]
+    return O.slice_op(ins[0], [int(s) for s in starts], size)
+
+
+@imports("Pad")
+def _i_pad(ctx, node, ins, attrs):
+    pads = ctx.const(node.input[1])
+    assert pads is not None, "Pad with dynamic pads unsupported"
+    n = len(pads) // 2
+    paddings = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    cval = 0.0
+    if len(node.input) > 2:
+        cv = ctx.const(node.input[2])
+        if cv is not None:
+            cval = float(np.asarray(cv).ravel()[0])
+    return O.pad_op(ins[0], paddings, constant_values=cval)
+
+
+@imports("ReduceSum")
+def _i_reduce_sum(ctx, node, ins, attrs):
+    if len(node.input) > 1:  # opset 13: axes as input
+        axes = ctx.const(node.input[1])
+        assert axes is not None, "ReduceSum with dynamic axes unsupported"
+    else:
+        axes = attrs.get("axes")
+    if axes is None:
+        raise NotImplementedError(
+            "ReduceSum with axes omitted (reduce over ALL axes) needs the "
+            "input rank, which is not tracked at import")
+    return O.reduce_sum_op(ins[0], [int(a) for a in axes],
+                           keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@imports("ReduceMean")
+def _i_reduce_mean(ctx, node, ins, attrs):
+    return O.reduce_mean_op(ins[0], [int(a) for a in attrs["axes"]],
+                            keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@imports("Cast")
+def _i_cast(ctx, node, ins, attrs):
+    return ins[0]  # dtypes are managed by the executor (f32/bf16 compute)
+
+
+@imports("Gather")
+def _i_gather(ctx, node, ins, attrs):
+    assert attrs.get("axis", 0) == 0, "Gather only on axis 0"
+    return O.embedding_lookup_op(ins[0], ins[1])
+
+
+@imports("OneHot")
+def _i_onehot(ctx, node, ins, attrs):
+    depth = ctx.const(node.input[1])
+    assert depth is not None, "OneHot with dynamic depth unsupported"
+    return O.one_hot_op(ins[0], int(np.asarray(depth).ravel()[0]))
+
+
+@imports("Expand")
+def _i_expand(ctx, node, ins, attrs):
+    # Expand(x, Shape(y)) round-trips broadcastto_op; the shape source node
+    # is recovered from the producing Shape node (see _import_graph)
+    shape_src = ctx.values.get("__shape_src__" + node.input[1])
+    if shape_src is not None:
+        return O.broadcastto_op(ins[0], shape_src)
+    shape = ctx.const(node.input[1])
+    assert shape is not None, "Expand needs a Shape() input or static shape"
+    return O.broadcast_shape_op(ins[0], tuple(int(s) for s in shape))
+
+
+@imports("Where")
+def _i_where(ctx, node, ins, attrs):
+    return O.where_op(ins[0], ins[1], ins[2])
+
+
+def load(path: str):
+    """Parse ``path`` and rebuild the graph.
+
+    Returns ``(inputs, outputs)``: dict of input name -> fed placeholder
+    Variable, and list of output nodes (in graph output order).
+    """
+    model = P.load_model(path)
+    return import_graph(model.graph)
+
+
+def import_graph(graph: P.GraphProto):
+    ctx = ImportContext()
+    for init in graph.initializer:
+        value = P.numpy_from_tensor(init)
+        ctx.consts[init.name] = value
+        ctx.values[init.name] = Variable(init.name, value=value)
+    for vi in graph.input:
+        if vi.name in ctx.values:
+            continue  # initializers may be re-listed as inputs
+        v = Variable(vi.name, trainable=False)
+        ctx.values[vi.name] = v
+        ctx.inputs[vi.name] = v
+
+    for node in graph.node:
+        attrs = _attrs(node)
+        if node.op_type == "Constant":
+            value = attrs["value"]
+            ctx.consts[node.output[0]] = np.asarray(value)
+            # constants are NOT trainable — a Variable with a value defaults
+            # to trainable=True and the optimizer would update it
+            ctx.values[node.output[0]] = Variable(
+                node.output[0], value=np.asarray(value), trainable=False)
+            continue
+        if node.op_type == "Shape":
+            # keep the source node so Expand can rebuild broadcastto
+            ctx.values["__shape_src__" + node.output[0]] = \
+                ctx.values[node.input[0]]
+            ctx.values[node.output[0]] = None  # consumed only via the marker
+            continue
+        if node.op_type == "ConstantOfShape":
+            src = ctx.values.get("__shape_src__" + node.input[0])
+            assert src is not None, "ConstantOfShape needs a Shape() input"
+            fill = float(np.asarray(attrs.get("value", np.zeros(1))).ravel()[0])
+            out = (O.zeroslike_op(src) if fill == 0.0 else
+                   O.mul_byconst_op(O.oneslike_op(src), fill)
+                   if fill != 1.0 else O.oneslike_op(src))
+            ctx.values[node.output[0]] = out
+            continue
+        handler = _IMPORTERS.get(node.op_type)
+        if handler is None:
+            raise NotImplementedError(
+                f"no import handler for ONNX op {node.op_type}")
+        ins = [ctx.values[n] for n in node.input if n]
+        out = handler(ctx, node, ins, attrs)
+        ctx.values[node.output[0]] = out
+
+    outputs = [ctx.values[vi.name] for vi in graph.output]
+    return ctx.inputs, outputs

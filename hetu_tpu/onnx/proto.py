@@ -1,0 +1,404 @@
+"""Minimal ONNX protobuf wire-format codec (pure Python, no dependencies).
+
+The reference's ONNX bridge (``/root/reference/python/hetu/onnx/hetu2onnx.py:27``)
+leans on the ``onnx`` pip package; that package is not in this image, so the
+message subset the bridge needs — ModelProto, GraphProto, NodeProto,
+TensorProto, AttributeProto, ValueInfoProto and friends — is encoded/decoded
+here directly against the standard ONNX IR field numbers. Files produced are
+ordinary ``.onnx`` protobufs loadable by stock onnx/onnxruntime.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire primitives
+# ---------------------------------------------------------------------------
+
+def _write_varint(buf: bytearray, v: int):
+    if v < 0:
+        v &= (1 << 64) - 1  # two's-complement 64-bit, proto convention
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _tag(num: int, wire: int) -> bytes:
+    buf = bytearray()
+    _write_varint(buf, (num << 3) | wire)
+    return bytes(buf)
+
+
+def _write_len_delimited(buf: bytearray, num: int, payload: bytes):
+    buf += _tag(num, 2)
+    _write_varint(buf, len(payload))
+    buf += payload
+
+
+# ---------------------------------------------------------------------------
+# message base: FIELDS = {py_name: (field_number, kind)} where kind is one of
+# 'int' (varint int64), 'float' (fixed32), 'bytes', 'string', a Message class,
+# or a list [kind] for repeated fields (scalars packed on write).
+# ---------------------------------------------------------------------------
+
+class Message:
+    FIELDS: dict[str, tuple] = {}
+
+    def __init__(self, **kwargs):
+        for name, (num, kind) in self.FIELDS.items():
+            default = [] if isinstance(kind, list) else None
+            setattr(self, name, kwargs.pop(name, default))
+        if kwargs:
+            raise TypeError(f"unknown fields {list(kwargs)} for {type(self).__name__}")
+
+    # -- encode ---------------------------------------------------------
+    def SerializeToString(self) -> bytes:
+        buf = bytearray()
+        for name, (num, kind) in self.FIELDS.items():
+            val = getattr(self, name)
+            if val is None or (isinstance(val, list) and not val):
+                continue
+            if isinstance(kind, list):
+                elem = kind[0]
+                if elem == "int":   # packed varints
+                    payload = bytearray()
+                    for v in val:
+                        _write_varint(payload, int(v))
+                    _write_len_delimited(buf, num, bytes(payload))
+                elif elem == "float":  # packed fixed32
+                    _write_len_delimited(
+                        buf, num, b"".join(struct.pack("<f", float(v)) for v in val))
+                elif elem == "string":
+                    for v in val:
+                        _write_len_delimited(buf, num, v.encode("utf-8"))
+                elif elem == "bytes":
+                    for v in val:
+                        _write_len_delimited(buf, num, v)
+                else:  # repeated message
+                    for v in val:
+                        _write_len_delimited(buf, num, v.SerializeToString())
+            elif kind == "int":
+                buf += _tag(num, 0)
+                _write_varint(buf, int(val))
+            elif kind == "float":
+                buf += _tag(num, 5)
+                buf += struct.pack("<f", float(val))
+            elif kind == "string":
+                _write_len_delimited(buf, num, val.encode("utf-8"))
+            elif kind == "bytes":
+                _write_len_delimited(buf, num, val)
+            else:  # nested message
+                _write_len_delimited(buf, num, val.SerializeToString())
+        return bytes(buf)
+
+    # -- decode ---------------------------------------------------------
+    @classmethod
+    def FromString(cls, data: bytes) -> "Message":
+        self = cls()
+        by_num = {num: (name, kind) for name, (num, kind) in cls.FIELDS.items()}
+        pos = 0
+        while pos < len(data):
+            key, pos = _read_varint(data, pos)
+            num, wire = key >> 3, key & 7
+            if num not in by_num:  # skip unknown field
+                if wire == 0:
+                    _, pos = _read_varint(data, pos)
+                elif wire == 1:
+                    pos += 8
+                elif wire == 2:
+                    ln, pos = _read_varint(data, pos)
+                    pos += ln
+                elif wire == 5:
+                    pos += 4
+                else:
+                    raise ValueError(f"unsupported wire type {wire}")
+                continue
+            name, kind = by_num[num]
+            if isinstance(kind, list):
+                elem = kind[0]
+                lst = getattr(self, name)
+                if wire == 2:
+                    ln, pos = _read_varint(data, pos)
+                    chunk, pos = data[pos:pos + ln], pos + ln
+                    if elem == "int":      # packed
+                        p = 0
+                        while p < len(chunk):
+                            v, p = _read_varint(chunk, p)
+                            lst.append(_signed64(v))
+                    elif elem == "float":  # packed
+                        lst.extend(struct.unpack(f"<{len(chunk)//4}f", chunk))
+                    elif elem == "string":
+                        lst.append(chunk.decode("utf-8"))
+                    elif elem == "bytes":
+                        lst.append(chunk)
+                    else:
+                        lst.append(elem.FromString(chunk))
+                elif wire == 0 and elem == "int":  # unpacked varint
+                    v, pos = _read_varint(data, pos)
+                    lst.append(_signed64(v))
+                elif wire == 5 and elem == "float":
+                    lst.append(struct.unpack("<f", data[pos:pos + 4])[0])
+                    pos += 4
+                else:
+                    raise ValueError(f"bad wire {wire} for repeated {elem}")
+            elif kind == "int":
+                v, pos = _read_varint(data, pos)
+                setattr(self, name, _signed64(v))
+            elif kind == "float":
+                setattr(self, name, struct.unpack("<f", data[pos:pos + 4])[0])
+                pos += 4
+            elif kind in ("string", "bytes"):
+                ln, pos = _read_varint(data, pos)
+                chunk = data[pos:pos + ln]
+                pos += ln
+                setattr(self, name, chunk.decode("utf-8") if kind == "string"
+                        else chunk)
+            else:
+                ln, pos = _read_varint(data, pos)
+                setattr(self, name, kind.FromString(data[pos:pos + ln]))
+                pos += ln
+        return self
+
+    def __repr__(self):
+        fields = {n: getattr(self, n) for n in self.FIELDS
+                  if getattr(self, n) not in (None, [])}
+        return f"{type(self).__name__}({fields})"
+
+
+# ---------------------------------------------------------------------------
+# ONNX IR messages (field numbers per onnx/onnx.proto)
+# ---------------------------------------------------------------------------
+
+class TensorProto(Message):
+    # DataType enum values
+    FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64, STRING, BOOL = range(1, 10)
+    FLOAT16, DOUBLE, UINT32, UINT64 = 10, 11, 12, 13
+    BFLOAT16 = 16
+
+    FIELDS = {
+        "dims": (1, ["int"]),
+        "data_type": (2, "int"),
+        "float_data": (4, ["float"]),
+        "int32_data": (5, ["int"]),
+        "int64_data": (7, ["int"]),
+        "name": (8, "string"),
+        "raw_data": (9, "bytes"),
+    }
+
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): TensorProto.FLOAT,
+    np.dtype(np.float64): TensorProto.DOUBLE,
+    np.dtype(np.int32): TensorProto.INT32,
+    np.dtype(np.int64): TensorProto.INT64,
+    np.dtype(np.uint8): TensorProto.UINT8,
+    np.dtype(np.bool_): TensorProto.BOOL,
+}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def tensor_from_numpy(arr: np.ndarray, name: str) -> TensorProto:
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _NP_TO_ONNX:
+        arr = arr.astype(np.float32)
+    return TensorProto(dims=list(arr.shape), data_type=_NP_TO_ONNX[arr.dtype],
+                       raw_data=arr.tobytes(), name=name)
+
+
+def numpy_from_tensor(t: TensorProto) -> np.ndarray:
+    dtype = _ONNX_TO_NP.get(t.data_type)
+    if dtype is None:
+        raise ValueError(f"unsupported ONNX tensor data_type {t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dtype).reshape(shape).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, np.float32).astype(dtype).reshape(shape)
+    if t.int64_data:
+        return np.asarray(t.int64_data, np.int64).astype(dtype).reshape(shape)
+    if t.int32_data:
+        return np.asarray(t.int32_data, np.int32).astype(dtype).reshape(shape)
+    return np.zeros(shape, dtype)
+
+
+class AttributeProto(Message):
+    # AttributeType enum
+    FLOAT, INT, STRING, TENSOR, GRAPH, FLOATS, INTS, STRINGS = range(1, 9)
+
+    FIELDS = {
+        "name": (1, "string"),
+        "f": (2, "float"),
+        "i": (3, "int"),
+        "s": (4, "bytes"),
+        "t": (5, TensorProto),
+        "floats": (7, ["float"]),
+        "ints": (8, ["int"]),
+        "strings": (9, ["bytes"]),
+        "type": (20, "int"),
+    }
+
+
+def make_attr(name: str, value: Any) -> AttributeProto:
+    if isinstance(value, bool):
+        return AttributeProto(name=name, i=int(value), type=AttributeProto.INT)
+    if isinstance(value, (int, np.integer)):
+        return AttributeProto(name=name, i=int(value), type=AttributeProto.INT)
+    if isinstance(value, (float, np.floating)):
+        return AttributeProto(name=name, f=float(value), type=AttributeProto.FLOAT)
+    if isinstance(value, str):
+        return AttributeProto(name=name, s=value.encode("utf-8"),
+                              type=AttributeProto.STRING)
+    if isinstance(value, np.ndarray):
+        return AttributeProto(name=name, t=tensor_from_numpy(value, name),
+                              type=AttributeProto.TENSOR)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            return AttributeProto(name=name, ints=[int(v) for v in value],
+                                  type=AttributeProto.INTS)
+        if all(isinstance(v, (int, float, np.floating, np.integer))
+               for v in value):
+            return AttributeProto(name=name, floats=[float(v) for v in value],
+                                  type=AttributeProto.FLOATS)
+    raise TypeError(f"cannot make ONNX attribute from {type(value)}")
+
+
+def attr_value(a: AttributeProto) -> Any:
+    if a.type == AttributeProto.FLOAT:
+        return a.f
+    if a.type == AttributeProto.INT:
+        return a.i
+    if a.type == AttributeProto.STRING:
+        return a.s.decode("utf-8")
+    if a.type == AttributeProto.TENSOR:
+        return numpy_from_tensor(a.t)
+    if a.type == AttributeProto.FLOATS:
+        return list(a.floats)
+    if a.type == AttributeProto.INTS:
+        return list(a.ints)
+    raise ValueError(f"unsupported attribute type {a.type}")
+
+
+class NodeProto(Message):
+    FIELDS = {
+        "input": (1, ["string"]),
+        "output": (2, ["string"]),
+        "name": (3, "string"),
+        "op_type": (4, "string"),
+        "attribute": (5, []),  # patched below (forward ref)
+        "doc_string": (6, "string"),
+        "domain": (7, "string"),
+    }
+
+
+NodeProto.FIELDS["attribute"] = (5, [AttributeProto])
+
+
+class DimProto(Message):
+    FIELDS = {"dim_value": (1, "int"), "dim_param": (2, "string")}
+
+
+class TensorShapeProto(Message):
+    FIELDS = {"dim": (1, [DimProto])}
+
+
+class TensorTypeProto(Message):
+    FIELDS = {"elem_type": (1, "int"), "shape": (2, TensorShapeProto)}
+
+
+class TypeProto(Message):
+    FIELDS = {"tensor_type": (1, TensorTypeProto)}
+
+
+class ValueInfoProto(Message):
+    FIELDS = {"name": (1, "string"), "type": (2, TypeProto),
+              "doc_string": (3, "string")}
+
+
+def make_value_info(name: str, shape, elem_type=TensorProto.FLOAT) -> ValueInfoProto:
+    """``shape=None`` means unknown RANK: the shape field is omitted entirely
+    (declaring a wrong rank would break consumers' shape inference)."""
+    if shape is None:
+        return ValueInfoProto(name=name, type=TypeProto(
+            tensor_type=TensorTypeProto(elem_type=elem_type)))
+    dims = []
+    for d in shape:
+        if d is None:
+            dims.append(DimProto(dim_param="N"))
+        else:
+            dims.append(DimProto(dim_value=int(d)))
+    return ValueInfoProto(name=name, type=TypeProto(tensor_type=TensorTypeProto(
+        elem_type=elem_type, shape=TensorShapeProto(dim=dims))))
+
+
+def value_info_shape(vi: ValueInfoProto):
+    tt = vi.type.tensor_type if vi.type else None
+    if tt is None or tt.shape is None:
+        return None
+    out = []
+    for d in tt.shape.dim:
+        out.append(int(d.dim_value) if d.dim_value is not None else None)
+    return tuple(out)
+
+
+class GraphProto(Message):
+    FIELDS = {
+        "node": (1, [NodeProto]),
+        "name": (2, "string"),
+        "initializer": (5, [TensorProto]),
+        "doc_string": (10, "string"),
+        "input": (11, [ValueInfoProto]),
+        "output": (12, [ValueInfoProto]),
+        "value_info": (13, [ValueInfoProto]),
+    }
+
+
+class OperatorSetIdProto(Message):
+    FIELDS = {"domain": (1, "string"), "version": (2, "int")}
+
+
+class ModelProto(Message):
+    FIELDS = {
+        "ir_version": (1, "int"),
+        "producer_name": (2, "string"),
+        "producer_version": (3, "string"),
+        "domain": (4, "string"),
+        "model_version": (5, "int"),
+        "doc_string": (6, "string"),
+        "graph": (7, GraphProto),
+        "opset_import": (8, [OperatorSetIdProto]),
+    }
+
+
+def load_model(path: str) -> ModelProto:
+    with open(path, "rb") as f:
+        return ModelProto.FromString(f.read())
+
+
+def save_model(model: ModelProto, path: str):
+    with open(path, "wb") as f:
+        f.write(model.SerializeToString())
