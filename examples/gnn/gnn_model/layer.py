@@ -1,0 +1,47 @@
+"""GCN / GraphSAGE layers on the graph API (capability parity with
+reference ``examples/gnn/gnn_model/layer.py``: GCN and SageConv over a
+sparse normalized adjacency fed at runtime)."""
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+class GCN:
+    """h' = act(A_norm @ h @ W + b); ``norm_adj`` is a fed sparse Variable."""
+
+    def __init__(self, in_features, out_features, norm_adj, activation=None,
+                 name="gcn"):
+        self.output_width = out_features
+        self.weight = init.xavier_uniform((in_features, out_features),
+                                          name=name + "_weight")
+        self.bias = init.zeros((out_features,), name=name + "_bias")
+        self.norm_adj = norm_adj
+        self.activation = activation
+
+    def __call__(self, x):
+        msg = ht.distgcn_15d_op(self.norm_adj, x, self.weight)
+        y = msg + ht.broadcastto_op(self.bias, msg)
+        if self.activation == "relu":
+            y = ht.relu_op(y)
+        return y
+
+
+class SageConv:
+    """GraphSAGE mean aggregator: concat(h, A_norm @ h) @ W."""
+
+    def __init__(self, in_features, out_features, norm_adj, activation=None,
+                 name="sage"):
+        self.output_width = out_features
+        self.weight = init.xavier_uniform((2 * in_features, out_features),
+                                          name=name + "_weight")
+        self.bias = init.zeros((out_features,), name=name + "_bias")
+        self.norm_adj = norm_adj
+        self.activation = activation
+
+    def __call__(self, x):
+        neigh = ht.csrmm_op(self.norm_adj, x)
+        h = ht.concat_op(x, neigh, axis=1)
+        y = ht.matmul_op(h, self.weight)
+        y = y + ht.broadcastto_op(self.bias, y)
+        if self.activation == "relu":
+            y = ht.relu_op(y)
+        return y

@@ -1,0 +1,57 @@
+"""GNN models on the graph API (reference ``gnn_model/model.py`` dense_model /
+sparse_model surface, minus the graphmix sampling client)."""
+import numpy as np
+
+import hetu_tpu as ht
+
+from .layer import GCN
+
+
+def convert_to_one_hot(vals, max_val=0):
+    if max_val == 0:
+        max_val = vals.max() + 1
+    one_hot = np.zeros((vals.size, max_val), np.float32)
+    one_hot[np.arange(vals.size), vals] = 1
+    return one_hot
+
+
+def dense_model(feature_dim, hidden_layer_size, num_classes, lr, arch=GCN):
+    """Full-batch node classification: feats/labels/mask fed per step,
+    normalized adjacency fed as a sparse Variable."""
+    y_ = ht.Variable(name="y_", trainable=False)
+    mask_ = ht.Variable(name="mask_", trainable=False)
+    feat = ht.Variable(name="feat", trainable=False)
+    norm_adj_ = ht.Variable(name="message_passing", trainable=False)
+
+    gcn1 = arch(feature_dim, hidden_layer_size, norm_adj_, activation="relu",
+                name="gcn1")
+    gcn2 = arch(gcn1.output_width, num_classes, norm_adj_, name="gcn2")
+    y = gcn2(gcn1(feat))
+    loss = ht.softmaxcrossentropy_op(y, y_)
+    train_loss = ht.reduce_mean_op(loss * mask_, [0])
+    train_op = ht.optim.SGDOptimizer(lr).minimize(train_loss)
+    return [train_loss, y, train_op], [feat, y_, mask_, norm_adj_]
+
+
+def sparse_model(num_int_feature, hidden_layer_size, embedding_idx_max,
+                 embedding_width, num_classes, lr):
+    """Integer-feature variant: per-node categorical features pass through an
+    embedding table before the GCN stack (reference sparse_model)."""
+    y_ = ht.Variable(name="y_", trainable=False)
+    mask_ = ht.Variable(name="mask_", trainable=False)
+    index_ = ht.Variable(name="index_", trainable=False)
+    norm_adj_ = ht.Variable(name="message_passing", trainable=False)
+
+    embedding = ht.init.random_normal((embedding_idx_max, embedding_width),
+                                      stddev=0.1, name="gnn_embedding")
+    embed = ht.embedding_lookup_op(embedding, index_)
+    feat = ht.array_reshape_op(embed, (-1, num_int_feature * embedding_width))
+
+    gcn1 = GCN(num_int_feature * embedding_width, hidden_layer_size,
+               norm_adj_, activation="relu", name="gcn1")
+    gcn2 = GCN(gcn1.output_width, num_classes, norm_adj_, name="gcn2")
+    y = gcn2(gcn1(feat))
+    loss = ht.softmaxcrossentropy_op(y, y_)
+    train_loss = ht.reduce_mean_op(loss * mask_, [0])
+    train_op = ht.optim.SGDOptimizer(lr).minimize(train_loss)
+    return [train_loss, y, train_op], [index_, y_, mask_, norm_adj_]

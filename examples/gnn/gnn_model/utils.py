@@ -1,0 +1,38 @@
+"""Graph data helpers (reference ``gnn_model/utils.py`` — get_norm_adj /
+prepare_data over graphmix; here self-contained synthetic graphs, since the
+reference's GraphMix submodule is an empty stub in the snapshot)."""
+import numpy as np
+
+
+def synthetic_graph(n_nodes=256, n_classes=4, feat_dim=16, avg_deg=6, seed=0):
+    """Community-structured random graph: nodes in the same class link with
+    higher probability, features are noisy class prototypes — learnable by a
+    2-layer GCN."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, n_classes, n_nodes)
+    protos = rng.randn(n_classes, feat_dim).astype(np.float32)
+    feats = protos[labels] + 0.5 * rng.randn(n_nodes, feat_dim).astype(np.float32)
+    p_in = avg_deg / (n_nodes / n_classes) * 0.7
+    p_out = avg_deg / n_nodes * 0.3
+    rows, cols = [], []
+    for i in range(n_nodes):
+        same = labels == labels[i]
+        prob = np.where(same, p_in, p_out)
+        nbrs = np.where(rng.rand(n_nodes) < prob)[0]
+        rows.extend([i] * len(nbrs))
+        cols.extend(nbrs)
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    # symmetrize + self loops, so the D^-1/2 A D^-1/2 normalization below is
+    # the genuine GCN normalization (in-degree == out-degree)
+    rows, cols = (np.concatenate([rows, cols, np.arange(n_nodes)]),
+                  np.concatenate([cols, rows, np.arange(n_nodes)]))
+    return rows, cols, feats, labels
+
+
+def normalize_adj(rows, cols, n_nodes):
+    """Symmetric GCN normalization D^-1/2 (A) D^-1/2 as COO values."""
+    deg = np.bincount(rows, minlength=n_nodes).astype(np.float32)
+    deg = np.maximum(deg, 1.0)
+    vals = 1.0 / np.sqrt(deg[rows] * deg[cols])
+    return vals.astype(np.float32)

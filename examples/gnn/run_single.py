@@ -1,0 +1,51 @@
+"""Single-device GCN training (reference ``examples/gnn/run_single.py``,
+self-contained synthetic graph instead of the graphmix sampling service)."""
+import argparse
+import sys
+import os
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import hetu_tpu as ht
+from gnn_model import dense_model, convert_to_one_hot, synthetic_graph, \
+    normalize_adj
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=30)
+    ap.add_argument("--hidden-size", type=int, default=32)
+    ap.add_argument("--nodes", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--learning-rate", type=float, default=0.5)
+    args = ap.parse_args()
+
+    rows, cols, feats, labels = synthetic_graph(args.nodes, args.classes)
+    vals = normalize_adj(rows, cols, args.nodes)
+    onehot = convert_to_one_hot(labels, args.classes)
+    mask = (np.random.RandomState(1).rand(args.nodes) < 0.7).astype(np.float32)
+
+    [loss, y, train_op], [feat_, y__, mask_, adj_] = dense_model(
+        feats.shape[1], args.hidden_size, args.classes, args.learning_rate)
+    ex = ht.Executor([loss, y, train_op], ctx=ht.cpu(0), seed=0)
+    adj = ht.sparse_array(vals, (rows, cols), (args.nodes, args.nodes))
+
+    t0 = time.time()
+    for epoch in range(args.num_epoch):
+        lv, yv, _ = ex.run("default", feed_dict={
+            feat_: feats, y__: onehot, mask_: mask, adj_: adj},
+            convert_to_numpy_ret_vals=True)
+        pred = yv.argmax(1)
+        test = mask == 0
+        acc = float((pred[test] == labels[test]).mean())
+        print(f"epoch {epoch}: train loss {float(np.mean(lv)):.4f} "
+              f"test acc {acc:.3f}")
+    print(f"total {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ from .shape import (
 from .matmul import (
     matmul_op, batch_matmul_op, matrix_dot_op, csrmv_op, csrmm_op,
 )
+from .gnn import distgcn_15d_op
 from .conv import (
     conv2d_op, conv2d_gradient_of_data_op, conv2d_gradient_of_filter_op,
     conv2d_broadcastto_op, conv2d_reducesum_op,

@@ -1,0 +1,27 @@
+"""Graph-neural-network ops: the DistGCN 1.5D hybrid-parallel GCN matmul
+(reference ``gpu_ops/DistGCN_15d.py``).
+
+API parity wrapper: ``distgcn_15d_op(A, H, W, ...)`` computes
+``Z = A @ H (@ W)``. The reference implements the 1.5D schedule imperatively
+(staged NCCL broadcasts + csrmm accumulation + row-group allreduce) inside the
+op's ``compute``; here the op is a pure sparse-matmul composition — on a
+device mesh the 1.5D data movement lives in
+:mod:`hetu_tpu.parallel.distgcn` (``shard_map`` all_gather/psum over a
+``(gr, gc)`` mesh), which XLA lowers to the same collectives.
+"""
+from __future__ import annotations
+
+from .matmul import csrmm_op, matmul_op
+
+
+def distgcn_15d_op(node_A, node_B, node_C=None, node_Count_Self=None,
+                   node_Count_All=None, size=1, replication=1, device_id=0,
+                   comm=None, comm_groups=None, need_W=True, ctx=None):
+    """``A`` sparse adjacency (fed as ND_Sparse_Array), ``B`` features,
+    ``C`` weight. The process-topology arguments of the reference signature
+    (size/replication/device_id/comm/comm_groups) are accepted for API
+    compatibility; distribution is declared via the mesh, not per-op."""
+    z = csrmm_op(node_A, node_B, ctx=ctx)
+    if need_W and node_C is not None:
+        z = matmul_op(z, node_C, ctx=ctx)
+    return z
