@@ -1,3 +1,3 @@
-from .layer import GCN
+from .layer import GCN, SageConv
 from .model import dense_model, sparse_model, convert_to_one_hot
 from .utils import synthetic_graph, normalize_adj

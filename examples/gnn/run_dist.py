@@ -19,9 +19,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..', '..'))
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # virtual mesh run: make sure the forced device count sticks even when a
-    # sitecustomize pre-set XLA_FLAGS (last duplicate flag wins)
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+        and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # virtual mesh run: default to 8 devices unless the user already forced
+    # a count (last duplicate flag wins, so appending would override theirs)
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
 

@@ -27,6 +27,14 @@ TPU-native redesign, not a translation:
   1/M, so the accumulated gradient equals the gradient of the full-batch
   mean loss — the pipeline run matches a single-device run on the
   concatenated batch exactly (the correctness oracle the reference lacks).
+- Pipeline+DP: a multi-device stage context (``with ht.context([d0, d1])``)
+  gives that stage a 1-axis dp mesh; microbatches shard over it and GSPMD
+  inserts the per-stage gradient allreduce (the reference's per-group
+  ``new_group_comm``, executor.py:248-256).
+- Stateful ops (BatchNorm running stats) thread sequentially through the
+  microbatch schedule — each microbatch's forward consumes the previous
+  one's stats, matching the reference's in-op mutable arrays — and the
+  remat backward reuses the exact state its forward saw.
 """
 from __future__ import annotations
 
@@ -36,26 +44,58 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from ..context import DeviceGroup
 from ..ndarray import NDArray
 from .node import Op, find_topo_sort
 
 
 class _Stage:
-    """One pipeline stage: a device plus the forward subgraph placed on it."""
+    """One pipeline stage: its device(s) plus the forward subgraph placed on
+    them. A multi-device stage group means pipeline+DP: the stage's
+    microbatch is sharded over a per-stage 1-axis mesh and GSPMD inserts the
+    per-group gradient allreduce (the reference's ``new_group_comm`` per
+    param group, executor.py:248-256)."""
 
     def __init__(self, index: int, group: DeviceGroup):
+        from jax.sharding import Mesh
         self.index = index
         self.group = group
-        self.device = group.flat()[0].jax_device()
+        devices = [d.jax_device() for d in group.flat()]
+        self.device = devices[0]
+        self.mesh = (Mesh(np.asarray(devices), ("dp",))
+                     if len(devices) > 1 else None)
         self.nodes: list[Op] = []        # compute nodes, topo order
         self.param_nodes: list[Op] = []
         self.feed_nodes: list[Op] = []
+        self.state_nodes: list[Op] = []  # stateful ops (BatchNorm stats)
         self.in_nodes: list[Op] = []     # boundary inputs from earlier stages
         self.out_nodes: list[Op] = []    # values later stages / evals consume
-        self.fwd = None                  # jitted (params, ins, feeds, rng) -> outs
+        self.fwd = None                  # jitted (params, ins, feeds, rng, st) -> (outs, st')
         self.bwd = None                  # jitted (..., cts) -> (ct_params, ct_ins)
         self.apply = None                # jitted optimizer apply for this stage
+
+    # -- placement helpers -------------------------------------------------
+    def put_replicated(self, v):
+        if self.mesh is not None:
+            return jax.device_put(v, NamedSharding(self.mesh, P()))
+        return jax.device_put(v, self.device)
+
+    def put_batch(self, v):
+        """Shard dim 0 over the stage's dp mesh (microbatch data)."""
+        if self.mesh is not None:
+            ndim = np.ndim(v)
+            if ndim >= 1:
+                dp = self.mesh.shape["dp"]
+                if np.shape(v)[0] % dp:
+                    raise ValueError(
+                        f"stage {self.index}: microbatch dim 0 "
+                        f"({np.shape(v)[0]}) must divide the stage's dp "
+                        f"width ({dp}); size the microbatches accordingly")
+            spec = P("dp") if ndim >= 1 else P()
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+        return jax.device_put(v, self.device)
 
 
 class SubExecutor4Gpipe:
@@ -74,10 +114,12 @@ class SubExecutor4Gpipe:
             raise ValueError(
                 f"gpipe=True needs at most one optimizer in the graph, "
                 f"found {len(opt_nodes)}")
-        if self.config.comm_mode is not None:
+        if self.config.comm_mode not in (None, "AllReduce"):
             raise NotImplementedError(
-                "gpipe=True with comm_mode is not supported on the graph "
-                "API; use hetu_tpu.parallel.pipeline for combined pp+dp/tp")
+                f"gpipe=True with comm_mode={self.config.comm_mode!r}: "
+                "PS/Hybrid embeddings cannot ride the pipeline schedule; "
+                "pipeline+DP is expressed by multi-device stage contexts "
+                "(comm_mode='AllReduce' or default)")
         # no optimizer = a forward-only (validation) target: it still runs
         # through the stage pipeline, because after a train step the params
         # are committed to their stage devices
@@ -86,6 +128,12 @@ class SubExecutor4Gpipe:
         self.opt_vars = []
         if self.opt_node is not None:
             grad0 = self.opt_node.inputs[0]
+            # comm_mode='AllReduce' wraps grads in AllReduce markers
+            # (optimizer.insert_comm_ops); under gpipe the dp reduction is
+            # GSPMD's inside each stage program, so unwrap to the gradient
+            from .ops.comm import AllReduceCommunicateOp
+            if isinstance(grad0, AllReduceCommunicateOp):
+                grad0 = grad0.inputs[0]
             if not getattr(grad0, "is_gradient", False):
                 raise ValueError(
                     "gpipe optimizer inputs must be gradient nodes")
@@ -99,11 +147,6 @@ class SubExecutor4Gpipe:
         fwd_topo = [n for n in find_topo_sort(fwd_evals)
                     if not (n.is_gradient or n.is_optimizer)]
         for n in fwd_topo:
-            if n.stateful:
-                raise NotImplementedError(
-                    f"stateful op {n.name!r} (running stats) under gpipe: "
-                    "put normalization state-free (LayerNorm) in pipelined "
-                    "models, as the flagship pipeline does")
             if n.is_dataloader:
                 raise NotImplementedError(
                     "gpipe feeds come from the feed_dicts list, not "
@@ -149,6 +192,9 @@ class SubExecutor4Gpipe:
         if len(stages) == 0:
             raise ValueError("gpipe=True but the graph has no stage contexts")
 
+        for st in stages:
+            st.state_nodes = [n for n in st.nodes if n.stateful]
+
         # placeholders (params and feeds) belong to their earliest consumer
         for n in fwd_topo:
             if not n.is_placeholder:
@@ -191,7 +237,7 @@ class SubExecutor4Gpipe:
 
         for stage in self.stages:
             def make_fwd(stage=stage):
-                def fwd(params_t, ins_t, feeds_t, rng):
+                def fwd(params_t, ins_t, feeds_t, rng, opstate_t):
                     env: dict[int, Any] = {}
                     for node, v in zip(stage.param_nodes, params_t):
                         env[id(node)] = v
@@ -199,11 +245,16 @@ class SubExecutor4Gpipe:
                         env[id(node)] = v
                     for node, v in zip(stage.feed_nodes, feeds_t):
                         env[id(node)] = v
+                    op_state_in = {id(n): s for n, s in
+                                   zip(stage.state_nodes, opstate_t)}
                     tc = TraceContext(config, stage.nodes, training, env, rng,
-                                      jnp.zeros((), jnp.int32), {})
+                                      jnp.zeros((), jnp.int32), op_state_in)
                     for node in stage.nodes:
                         _eval_node(node, env, tc)
-                    return tuple(env[id(n)] for n in stage.out_nodes)
+                    new_state = tuple(
+                        tc.op_state_updates.get(id(n), op_state_in[id(n)])
+                        for n in stage.state_nodes)
+                    return tuple(env[id(n)] for n in stage.out_nodes), new_state
                 return fwd
 
             fwd = make_fwd()
@@ -212,11 +263,15 @@ class SubExecutor4Gpipe:
                 continue
 
             def make_bwd(fwd=fwd):
-                def bwd(params_t, ins_t, feeds_t, rng, cts):
+                def bwd(params_t, ins_t, feeds_t, rng, opstate_t, cts):
                     # rematerialize the stage forward inside the vjp: no
-                    # activation stash survives the schedule (GPipe remat)
+                    # activation stash survives the schedule (GPipe remat).
+                    # op state (BN running stats) enters as a constant — the
+                    # microbatch's own batch statistics ARE differentiated
+                    # through; the running EMA is not.
                     _, vjp = jax.vjp(
-                        lambda p, i: fwd(p, i, feeds_t, rng), params_t, ins_t)
+                        lambda p, i: fwd(p, i, feeds_t, rng, opstate_t)[0],
+                        params_t, ins_t)
                     return vjp(cts)
                 return bwd
 
@@ -243,14 +298,21 @@ class SubExecutor4Gpipe:
     # ------------------------------------------------------------------
     def _stage_params(self, stage: _Stage):
         ex = self.executor
+        stage_devs = (set(stage.mesh.devices.flat) if stage.mesh is not None
+                      else {stage.device})
         vals = []
         for node in stage.param_nodes:
             v = ex.state["params"][id(node)]
-            if v.devices() != {stage.device}:
-                v = jax.device_put(v, stage.device)
+            if v.devices() != stage_devs:
+                v = stage.put_replicated(v)
                 ex.state["params"][id(node)] = v
             vals.append(v)
         return tuple(vals)
+
+    def _stage_opstate(self, stage: _Stage):
+        ex = self.executor
+        return tuple(stage.put_replicated(ex.state["op_state"][id(n)])
+                     for n in stage.state_nodes)
 
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False,
             eval_node_list=None):
@@ -268,8 +330,8 @@ class SubExecutor4Gpipe:
         step = ex.state["step"]
         rng_step = jax.random.fold_in(ex.rng_root, step)
 
-        # stage feeds per microbatch, placed on the stage device
-        feeds = [[tuple(jax.device_put(np.asarray(fd[n]), st.device)
+        # stage feeds per microbatch, batch-sharded over the stage devices
+        feeds = [[tuple(st.put_batch(np.asarray(fd[n]))
                         for n in st.feed_nodes)
                   for st in self.stages] for fd in feed_dict]
         for m, fd in enumerate(feed_dict):
@@ -280,6 +342,12 @@ class SubExecutor4Gpipe:
                             f"microbatch {m}: missing feed for {n.name!r}")
 
         params = [self._stage_params(st) for st in self.stages]
+        # op state (BN running stats) threads sequentially through the
+        # microbatches of each stage; state_store holds the rolling value,
+        # state_in_store the per-(m, stage) input for the remat backward
+        state_store = [self._stage_opstate(st) for st in self.stages]
+        state_in_store: list[list[tuple]] = [[None] * len(self.stages)
+                                             for _ in range(M)]
         # per-(microbatch, stage) keys: stages index their nodes locally, so
         # without the stage fold two stages' dropout masks would coincide
         rngs = [[jax.random.fold_in(jax.random.fold_in(rng_step, m), s)
@@ -292,12 +360,22 @@ class SubExecutor4Gpipe:
                                         for _ in range(M)]
         for m in range(M):
             for s, st in enumerate(self.stages):
-                ins = tuple(jax.device_put(boundary[m][id(n)], st.device)
+                ins = tuple(st.put_batch(boundary[m][id(n)])
                             for n in st.in_nodes)
                 ins_store[m][s] = ins
-                outs = st.fwd(params[s], ins, feeds[m][s], rngs[m][s])
+                state_in_store[m][s] = state_store[s]
+                outs, new_state = st.fwd(params[s], ins, feeds[m][s],
+                                         rngs[m][s], state_store[s])
+                state_store[s] = new_state
                 for n, v in zip(st.out_nodes, outs):
                     boundary[m][id(n)] = v
+
+        if self.training:
+            # commit the post-schedule running stats (training mode only —
+            # eval traces return state unchanged anyway)
+            for s, st in enumerate(self.stages):
+                for n, v in zip(st.state_nodes, state_store[s]):
+                    ex.state["op_state"][id(n)] = v
 
         if not self.training:
             return self._collect(boundary, M, eval_node_list,
@@ -309,16 +387,16 @@ class SubExecutor4Gpipe:
             cts: dict[int, Any] = {}
             seed = jnp.ones(np.shape(boundary[m][id(self.loss)]),
                             jnp.float32) / M
-            cts[id(self.loss)] = jax.device_put(seed,
-                                                self.stages[-1].device)
+            cts[id(self.loss)] = self.stages[-1].put_replicated(seed)
             for s in reversed(range(len(self.stages))):
                 st = self.stages[s]
                 ct_out = tuple(
-                    jax.device_put(cts[id(n)], st.device)
+                    st.put_batch(cts[id(n)])
                     if id(n) in cts else jnp.zeros_like(boundary[m][id(n)])
                     for n in st.out_nodes)
                 ct_params, ct_ins = st.bwd(params[s], ins_store[m][s],
-                                           feeds[m][s], rngs[m][s], ct_out)
+                                           feeds[m][s], rngs[m][s],
+                                           state_in_store[m][s], ct_out)
                 if grads_acc[s] is None:
                     grads_acc[s] = list(ct_params)
                 else:
@@ -327,7 +405,7 @@ class SubExecutor4Gpipe:
                 for n, ct in zip(st.in_nodes, ct_ins):
                     prev = cts.get(id(n))
                     if prev is not None:
-                        ct = ct + jax.device_put(prev, st.device)
+                        ct = ct + st.put_batch(prev)
                     cts[id(n)] = ct
 
         # ---- single optimizer apply after all microbatches --------------
@@ -337,7 +415,8 @@ class SubExecutor4Gpipe:
         for s, st in enumerate(self.stages):
             if not st.param_nodes:
                 continue
-            slots_t = tuple(slots_all[i] for i in st.var_idx)
+            slots_t = tuple(st.put_replicated(slots_all[i])
+                            for i in st.var_idx)
             new_p, new_s = st.apply(params[s], tuple(grads_acc[s]),
                                     slots_t, step_arr)
             for node, v in zip(st.param_nodes, new_p):
