@@ -1,0 +1,99 @@
+"""Graph-API transformer LM trainer (reference
+``examples/nlp/train_hetu_transformer.py``).
+
+The reference trains a translation transformer on downloaded corpora; this
+image has no egress, so the trainer runs a character-level LM over a built-in
+text sample tokenized by the BERT WordPiece tokenizer
+(``hetu_tpu.tokenizers``) with a corpus-derived vocabulary — the full
+tokenizer -> graph-API-transformer -> Executor pipeline.
+"""
+import argparse
+import collections
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import hetu_tpu as ht
+from hetu_tpu.tokenizers import BertTokenizer
+from hetu_transformer import transformer_lm
+
+SAMPLE_TEXT = """
+the quick brown fox jumps over the lazy dog . the dog barks at the fox ,
+and the fox runs into the woods . in the woods the fox meets another fox .
+the two foxes play in the woods until the dog finds them again . then the
+quick brown fox jumps over the lazy dog once more , and the game repeats .
+every day the dog chases the fox and every day the fox escapes into the
+woods . the lazy dog never learns , and the quick fox never tires .
+""" * 8
+
+
+def build_vocab(text, min_count=1):
+    """Word-level vocab with wordpiece suffix entries for coverage."""
+    counts = collections.Counter(text.split())
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "[MASK]": 4}
+    for word, c in counts.most_common():
+        if c >= min_count and word not in vocab:
+            vocab[word] = len(vocab)
+    # character fallbacks so wordpiece never hits [UNK] on this corpus
+    for ch in sorted(set(text.replace(" ", "").replace("\n", ""))):
+        for piece in (ch, "##" + ch):
+            if piece not in vocab:
+                vocab[piece] = len(vocab)
+    return vocab
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--timing", action="store_true")
+    args = ap.parse_args()
+
+    vocab = build_vocab(SAMPLE_TEXT)
+    tok = BertTokenizer(vocab)
+    ids = np.asarray(tok.encode(SAMPLE_TEXT), np.float32)
+    print(f"corpus: {ids.size} tokens, vocab {len(vocab)}")
+
+    B, T = args.batch_size, args.seq_len
+    tokens = ht.Variable(name="tokens", trainable=False)
+    labels = ht.Variable(name="labels", trainable=False)
+    loss, logits, _ = transformer_lm(
+        tokens, labels, len(vocab), B, T, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers)
+    opt = ht.optim.AdamOptimizer(learning_rate=args.lr)
+    train_op = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.tpu(0)
+                     if os.environ.get("JAX_PLATFORMS") != "cpu"
+                     else ht.cpu(0), seed=0)
+
+    rng = np.random.RandomState(0)
+    t0 = time.time()
+    window = []
+    for step in range(args.steps):
+        starts = rng.randint(0, ids.size - T - 1, B)
+        bx = np.stack([ids[s:s + T] for s in starts])
+        by = np.stack([ids[s + 1:s + T + 1] for s in starts])
+        lv = ex.run("train", feed_dict={tokens: bx, labels: by})[0]
+        window.append(float(np.mean(lv.asnumpy())))
+        if (step + 1) % 50 == 0:
+            ppl = float(np.exp(np.mean(window)))
+            print(f"step {step + 1}: loss {np.mean(window):.4f} ppl {ppl:.1f}")
+            window = []
+    if args.timing:
+        print(f"{args.steps} steps in {time.time() - t0:.1f}s "
+              f"({(time.time() - t0) / args.steps * 1000:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

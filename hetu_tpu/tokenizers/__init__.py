@@ -1,0 +1,7 @@
+from .bert_tokenizer import (
+    BasicTokenizer, WordpieceTokenizer, BertTokenizer, load_vocab,
+    whitespace_tokenize,
+)
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
+           "load_vocab", "whitespace_tokenize"]

@@ -1,0 +1,161 @@
+"""NLP surface tests: BERT WordPiece tokenizer fixtures (reference
+``tokenizers/bert_tokenizer.py``) and the graph-API transformer trainer
+(reference ``examples/nlp/hetu_transformer.py``)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.tokenizers import (BasicTokenizer, WordpieceTokenizer,
+                                 BertTokenizer, load_vocab)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples", "nlp"))
+
+
+# ---------------------------------------------------------------------------
+# tokenizer: fixture strings with the canonical BERT expected outputs
+# ---------------------------------------------------------------------------
+
+def test_wordpiece_canonical_fixture():
+    """The canonical example from the BERT paper/code: 'unwanted running'
+    -> un ##want ##ed runn ##ing."""
+    vocab = {t: i for i, t in enumerate(
+        ["[UNK]", "[CLS]", "[SEP]", "want", "##want", "##ed", "wa", "un",
+         "runn", "##ing"])}
+    wp = WordpieceTokenizer(vocab)
+    assert wp.tokenize("unwanted running") == \
+        ["un", "##want", "##ed", "runn", "##ing"]
+    # unknown word -> [UNK]; known following it still tokenizes
+    assert wp.tokenize("unwantedX running") == ["[UNK]", "runn", "##ing"]
+    assert wp.tokenize("") == []
+
+
+def test_basic_tokenizer_lower_and_punct():
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize(" \tHeLLo!how  \n Are yoU?  ") == \
+        ["hello", "!", "how", "are", "you", "?"]
+    # accents stripped under lowercasing
+    assert bt.tokenize("Héllo") == ["hello"]
+    # control chars removed, CJK chars isolated
+    assert bt.tokenize("ah博推zz") == ["ah", "博", "推", "zz"]
+
+
+def test_basic_tokenizer_cased():
+    bt = BasicTokenizer(do_lower_case=False)
+    assert bt.tokenize("HeLLo!how Are yoU?") == \
+        ["HeLLo", "!", "how", "Are", "yoU", "?"]
+
+
+def test_bert_tokenizer_end_to_end(tmp_path):
+    tokens = ["[UNK]", "[CLS]", "[SEP]", "want", "##want", "##ed", "wa",
+              "un", "runn", "##ing", ","]
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(tokens) + "\n")
+    tok = BertTokenizer(str(vocab_file))
+    out = tok.tokenize("UNwantéd,running")
+    assert out == ["un", "##want", "##ed", ",", "runn", "##ing"]
+    ids = tok.convert_tokens_to_ids(out)
+    assert ids == [7, 4, 5, 10, 8, 9]
+    assert tok.convert_ids_to_tokens(ids) == out
+    # load_vocab preserves file order
+    assert list(load_vocab(str(vocab_file)).items())[:2] == \
+        [("[UNK]", 0), ("[CLS]", 1)]
+
+
+def test_never_split_tokens_pass_through():
+    vocab = {t: i for i, t in enumerate(
+        ["[UNK]", "[CLS]", "[SEP]", "hello"])}
+    tok = BertTokenizer(vocab)
+    assert tok.tokenize("[CLS] hello [SEP]") == ["[CLS]", "hello", "[SEP]"]
+
+
+def test_bert_data_pipeline():
+    """processBertData: instances have [CLS]/[SEP] structure, valid masking
+    positions, and padded fixed-length rows."""
+    from processBertData import create_instances_from_document
+
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "fox", "dog",
+         "jumps", "runs", "barks", "quick", "lazy", "brown", "over"])}
+    tok = BertTokenizer(vocab)
+    sentences = ["the quick brown fox jumps over the lazy dog",
+                 "the dog barks", "the fox runs", "the lazy dog runs"]
+    insts = create_instances_from_document(
+        sentences, tok, max_seq_length=24, max_predictions_per_seq=5, seed=0)
+    assert len(insts) == len(sentences) - 1
+    for ids, mask, seg, mlm_pos, mlm_ids, nsp in insts:
+        assert ids.shape == (24,) and mask.shape == (24,)
+        assert seg.shape == (24,) and mlm_pos.shape == (5,)
+        n = int(mask.sum())
+        assert ids[0] == vocab["[CLS]"]
+        assert (ids[:n] == vocab["[SEP]"]).sum() == 2
+        assert np.all(ids[n:] == vocab["[PAD]"])
+        assert nsp in (0, 1)
+        # masked positions point inside the live region and the labels are
+        # real vocab ids
+        live = mlm_ids > 0
+        assert np.all(mlm_pos[live] < n)
+
+
+# ---------------------------------------------------------------------------
+# graph-API transformer
+# ---------------------------------------------------------------------------
+
+def test_graph_api_transformer_learns():
+    """Tiny causal LM on a fixed repeating sequence: loss must fall
+    substantially (the model memorizes the pattern)."""
+    from hetu_transformer import transformer_lm
+
+    B, T, V = 4, 16, 11
+    rng = np.random.RandomState(0)
+    pattern = rng.randint(1, V, 64)
+    data = np.tile(pattern, 4).astype(np.float32)
+
+    tokens = ht.Variable(name="tokens", trainable=False)
+    labels = ht.Variable(name="labels", trainable=False)
+    loss, logits, _ = transformer_lm(tokens, labels, V, B, T, d_model=32,
+                                     n_heads=2, n_layers=1, d_ff=64,
+                                     dropout_prob=0.0)
+    train_op = ht.optim.AdamOptimizer(2e-3).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=0)
+
+    losses = []
+    for step in range(150):
+        starts = rng.randint(0, data.size - T - 1, B)
+        bx = np.stack([data[s:s + T] for s in starts])
+        by = np.stack([data[s + 1:s + T + 1] for s in starts])
+        lv = ex.run("train", feed_dict={tokens: bx, labels: by},
+                    convert_to_numpy_ret_vals=True)[0]
+        losses.append(float(np.mean(lv)))
+    assert np.mean(losses[-10:]) < 0.5 * np.mean(losses[:10]), (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
+
+
+def test_graph_api_transformer_causality():
+    """Changing a future token must not change earlier logits (the causal
+    mask is real)."""
+    from hetu_transformer import transformer_lm
+
+    B, T, V = 2, 8, 7
+    tokens = ht.Variable(name="tokens", trainable=False)
+    labels = ht.Variable(name="labels", trainable=False)
+    loss, logits, _ = transformer_lm(tokens, labels, V, B, T, d_model=16,
+                                     n_heads=2, n_layers=1, d_ff=32,
+                                     dropout_prob=0.0)
+    ex = ht.Executor({"eval": [logits]}, ctx=ht.cpu(0), seed=0)
+    rng = np.random.RandomState(1)
+    bx = rng.randint(0, V, (B, T)).astype(np.float32)
+    by = np.zeros((B, T), np.float32)
+    (l1,) = ex.run("eval", feed_dict={tokens: bx, labels: by},
+                   convert_to_numpy_ret_vals=True)
+    bx2 = bx.copy()
+    bx2[:, -1] = (bx2[:, -1] + 1) % V          # perturb the LAST token only
+    (l2,) = ex.run("eval", feed_dict={tokens: bx2, labels: by},
+                   convert_to_numpy_ret_vals=True)
+    l1 = l1.reshape(B, T, V)
+    l2 = l2.reshape(B, T, V)
+    np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], rtol=1e-5, atol=1e-6)
+    assert np.abs(l1[:, -1] - l2[:, -1]).max() > 1e-4
