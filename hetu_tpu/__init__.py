@@ -37,5 +37,7 @@ from . import initializers as init
 from . import data
 from . import metrics
 from . import onnx
+from . import graphboard
+from . import tokenizers
 
 __version__ = "0.1.0"

@@ -96,6 +96,13 @@ void Init() {
       int port = env_int_or("DMLC_PS_SERVER_PORT", 13201 + 2 * id);
       std::string host = env_or("DMLC_PS_SERVER_URI", "127.0.0.1");
       g_server = std::make_unique<hetups::PsServer>(id, host, port);
+      // recovery-restores-state: a replacement server rebuilds its store
+      // from the last ParamSave directory BEFORE it starts serving — the
+      // listen port is deterministic, so a reconnecting worker must never
+      // observe the empty pre-restore store (the worker does NOT re-init;
+      // see server.h load_param_file)
+      const char* restore_dir = std::getenv("DMLC_PS_RESTORE_DIR");
+      if (restore_dir && *restore_dir) g_server->restore_from(restore_dir);
       g_server->start();
       // register the listen address with the scheduler
       g_server_sched_conn = std::make_shared<hetups::Conn>(

@@ -11,6 +11,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdio>
+#include <filesystem>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -315,34 +316,14 @@ class PsServer {
       case PsfType::kParamSave: {
         Param* p = store_.get(key);
         check(p, key);
-        std::shared_lock<std::shared_mutex> g(p->mu);
-        std::string path = shard_path(req.args[0].as_str(), key);
-        FILE* f = std::fopen(path.c_str(), "wb");
-        if (!f) throw std::runtime_error("cannot open " + path);
-        int64_t meta[3] = {static_cast<int64_t>(p->kind),
-                           static_cast<int64_t>(p->rows ? p->rows : p->len),
-                           static_cast<int64_t>(p->width)};
-        std::fwrite(meta, sizeof(meta), 1, f);
-        std::fwrite(p->data.data(), 4, p->data.size(), f);
-        std::fclose(f);
+        save_param_file(*p, shard_path(req.args[0].as_str(), key));
         break;
       }
       case PsfType::kParamLoad: {
-        Param* p = store_.get(key);
-        check(p, key);
-        std::unique_lock<std::shared_mutex> g(p->mu);
-        std::string path = shard_path(req.args[0].as_str(), key);
-        FILE* f = std::fopen(path.c_str(), "rb");
-        if (!f) throw std::runtime_error("cannot open " + path);
-        int64_t meta[3];
-        if (std::fread(meta, sizeof(meta), 1, f) != 1) {
-          std::fclose(f);
-          throw std::runtime_error("truncated " + path);
-        }
-        size_t n = std::fread(p->data.data(), 4, p->data.size(), f);
-        std::fclose(f);
-        if (n != p->data.size())
-          throw std::runtime_error("size mismatch loading " + path);
+        // unlike the reference's LoadParam, the param need not pre-exist:
+        // the shard file carries full meta (+optimizer slots), so a blank
+        // replacement server restores state without any worker-side re-init
+        load_param_file(key, shard_path(req.args[0].as_str(), key));
         break;
       }
       case PsfType::kSyncEmbedding: {
@@ -490,6 +471,148 @@ class PsServer {
     return dir + "/param_" + std::to_string(key) + "_shard" +
            std::to_string(rank_) + ".bin";
   }
+
+  // Full-state shard format (v2): a dead server's replacement can rebuild
+  // its store from disk with no worker cooperation (recovery-restores-state;
+  // the intent of reference van.cc:47 recovery + psf/PSFunc.h:25-28
+  // ParamSave/Load). Layout: i64 meta[8] = {MAGIC(-2), kind, rows|len,
+  // width, otype, step, n_lrs, n_versions}, f32 lrs[], f32 data[],
+  // f32 accum[], f32 accum2[], i64 versions[].
+  static constexpr int64_t kShardMagicV2 = -2;
+
+  void save_param_file(Param& p, const std::string& path) {
+    std::shared_lock<std::shared_mutex> g(p.mu);
+    // tmp + rename: a crash mid-save (the very fault this recovers from)
+    // must not destroy the previous good checkpoint
+    const std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) throw std::runtime_error("cannot open " + tmp);
+    int64_t meta[8] = {kShardMagicV2,
+                       static_cast<int64_t>(p.kind),
+                       static_cast<int64_t>(p.rows ? p.rows : p.len),
+                       static_cast<int64_t>(p.width),
+                       static_cast<int64_t>(p.otype),
+                       static_cast<int64_t>(p.step),
+                       static_cast<int64_t>(p.lrs.size()),
+                       static_cast<int64_t>(p.versions.size())};
+    std::fwrite(meta, sizeof(meta), 1, f);
+    std::fwrite(p.lrs.data(), 4, p.lrs.size(), f);
+    std::fwrite(p.data.data(), 4, p.data.size(), f);
+    std::fwrite(p.accum.data(), 4, p.accum.size(), f);
+    std::fwrite(p.accum2.data(), 4, p.accum2.size(), f);
+    std::fwrite(p.versions.data(), 8, p.versions.size(), f);
+    std::fclose(f);
+    if (std::rename(tmp.c_str(), path.c_str()) != 0)
+      throw std::runtime_error("cannot rename " + tmp + " -> " + path);
+  }
+
+  void load_param_file(int32_t key, const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) throw std::runtime_error("cannot open " + path);
+    struct Closer { FILE* f; ~Closer() { std::fclose(f); } } closer{f};
+    int64_t head;
+    if (std::fread(&head, sizeof(head), 1, f) != 1)
+      throw std::runtime_error("truncated " + path);
+    if (head != kShardMagicV2) {
+      // v1 layout: {kind, rows|len, width} + data only, into an existing
+      // param (pre-v2 checkpoints)
+      int64_t rest[2];
+      if (std::fread(rest, sizeof(rest), 1, f) != 1)
+        throw std::runtime_error("truncated " + path);
+      Param* p1 = store_.get(key);
+      if (!p1 || p1->data.empty())
+        throw std::runtime_error(
+            "v1 shard " + path + " cannot restore an uninitialized param");
+      std::unique_lock<std::shared_mutex> g(p1->mu);
+      std::vector<float> data(p1->data.size());
+      if (std::fread(data.data(), 4, data.size(), f) != data.size())
+        throw std::runtime_error("size mismatch loading " + path);
+      p1->data = std::move(data);
+      return;
+    }
+    // parse EVERYTHING into locals first: a truncated file must not leave a
+    // phantom half-restored param in the store (check() would then pass and
+    // pushes would write through empty buffers)
+    int64_t meta[7];
+    if (std::fread(meta, sizeof(meta), 1, f) != 1)
+      throw std::runtime_error("truncated " + path);
+    Param tmp;
+    tmp.kind = static_cast<ParamKind>(meta[0]);
+    if (tmp.kind == ParamKind::kDense) {
+      tmp.len = static_cast<size_t>(meta[1]);
+      tmp.rows = 0;
+      tmp.width = 1;
+    } else {
+      tmp.rows = static_cast<size_t>(meta[1]);
+      tmp.width = static_cast<size_t>(meta[2]);
+      tmp.len = tmp.rows * tmp.width;
+    }
+    tmp.otype = static_cast<OptType>(meta[3]);
+    tmp.step = static_cast<uint64_t>(meta[4]);
+    tmp.lrs.assign(static_cast<size_t>(meta[5]), 0.0f);
+    tmp.data.assign(tmp.len, 0.0f);
+    auto read_f32 = [&](std::vector<float>& v) {
+      if (!v.empty() && std::fread(v.data(), 4, v.size(), f) != v.size())
+        throw std::runtime_error("size mismatch loading " + path);
+    };
+    read_f32(tmp.lrs);
+    read_f32(tmp.data);
+    alloc_slots(tmp);
+    read_f32(tmp.accum);
+    read_f32(tmp.accum2);
+    tmp.versions.assign(static_cast<size_t>(meta[6]), 0);
+    if (!tmp.versions.empty() &&
+        std::fread(tmp.versions.data(), 8, tmp.versions.size(), f) !=
+            tmp.versions.size())
+      throw std::runtime_error("size mismatch loading " + path);
+    Param* p = store_.get_or_create(key);
+    std::unique_lock<std::shared_mutex> g(p->mu);
+    p->kind = tmp.kind;
+    p->len = tmp.len;
+    p->rows = tmp.rows;
+    p->width = tmp.width;
+    p->otype = tmp.otype;
+    p->step = tmp.step;
+    p->lrs = std::move(tmp.lrs);
+    p->data = std::move(tmp.data);
+    p->accum = std::move(tmp.accum);
+    p->accum2 = std::move(tmp.accum2);
+    p->versions = std::move(tmp.versions);
+  }
+
+ public:
+  // Scan `dir` for this rank's shard files and restore every param found
+  // (invoked at startup when DMLC_PS_RESTORE_DIR is set).
+  int restore_from(const std::string& dir) {
+    namespace fs = std::filesystem;
+    const std::string suffix = "_shard" + std::to_string(rank_) + ".bin";
+    int n = 0;
+    std::error_code ec;
+    for (const auto& ent : fs::directory_iterator(dir, ec)) {
+      const std::string name = ent.path().filename().string();
+      if (name.rfind("param_", 0) != 0) continue;
+      if (name.size() <= suffix.size() + 6 ||
+          name.compare(name.size() - suffix.size(), suffix.size(), suffix))
+        continue;
+      const std::string key_str =
+          name.substr(6, name.size() - suffix.size() - 6);
+      if (key_str.empty() ||
+          key_str.find_first_not_of("0123456789") != std::string::npos)
+        continue;  // stray file; not one of ours
+      try {
+        load_param_file(std::stoi(key_str), ent.path().string());
+        ++n;
+      } catch (const std::exception& e) {
+        // one bad shard must not keep the replacement out of the cluster;
+        // the affected param surfaces as "not initialized" to workers
+        std::fprintf(stderr, "[hetups] server %d: skipping shard %s: %s\n",
+                     rank_, name.c_str(), e.what());
+      }
+    }
+    return n;
+  }
+
+ private:
 
   struct PairHash {
     size_t operator()(const std::pair<int32_t, uint64_t>& p) const {

@@ -1,0 +1,156 @@
+"""Graphboard: visualize an Executor's graph topology
+(reference ``python/graphboard/graph2fig.py:11-31`` — graphviz render + tiny
+HTTP server).
+
+Self-contained redesign: the image has no ``dot`` binary, so alongside the
+DOT source (``output.dot``, loadable by any graphviz) the module renders its
+own SVG with a layered longest-path layout — ``show(executor)`` writes
+``output.svg`` + ``index.html`` and serves them on a background
+``http.server`` thread; ``close()`` stops it.
+"""
+from __future__ import annotations
+
+import html
+import http.server
+import os
+import socketserver
+import threading
+from typing import Optional
+
+_server: Optional[socketserver.TCPServer] = None
+_thread: Optional[threading.Thread] = None
+
+_KIND_COLORS = {
+    "PlaceholderOp": "#a7c7e7",   # params/feeds
+    "DataloaderOp": "#c3e6cb",
+    "OptimizerOp": "#f5c6cb",
+    "GradientOp": "#ffe8a1",
+}
+
+
+def _topo_of(executor, name=None):
+    subs = getattr(executor, "subexecutors", None)
+    if subs:
+        if name is None:
+            name = next(iter(subs))
+        return subs[name].topo
+    return executor.topo  # a bare SubExecutor
+
+
+def make_dot(executor, name=None) -> str:
+    """DOT source of the topo (the reference's Digraph, sans dependency)."""
+    lines = ["digraph hetu {", "  rankdir=TB;",
+             '  node [shape=box, style="rounded,filled", '
+             'fillcolor="#eeeeee", fontname="Helvetica"];']
+    topo = _topo_of(executor, name)
+    for node in topo:
+        color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
+        label = node.name.replace('"', "'")
+        lines.append(f'  n{node.id} [label="{label}", fillcolor="{color}"];')
+    for node in topo:
+        for src in node.inputs:
+            lines.append(f"  n{src.id} -> n{node.id};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _layout(topo):
+    """Layered layout: rank = longest path from a source; x = slot in rank."""
+    rank: dict[int, int] = {}
+    for node in topo:  # topo order: inputs are ranked first
+        rank[id(node)] = 1 + max((rank[id(i)] for i in node.inputs),
+                                 default=-1)
+    by_rank: dict[int, list] = {}
+    for node in topo:
+        by_rank.setdefault(rank[id(node)], []).append(node)
+    pos = {}
+    for r, nodes in by_rank.items():
+        for i, node in enumerate(nodes):
+            pos[id(node)] = (i, r)
+    return pos, max(by_rank) + 1, max(len(v) for v in by_rank.values())
+
+
+NODE_W, NODE_H, GAP_X, GAP_Y = 150, 34, 30, 46
+
+
+def make_svg(executor, name=None) -> str:
+    topo = _topo_of(executor, name)
+    pos, n_ranks, width = _layout(topo)
+    W = width * (NODE_W + GAP_X) + GAP_X
+    H = n_ranks * (NODE_H + GAP_Y) + GAP_Y
+
+    def xy(node):
+        c, r = pos[id(node)]
+        return (GAP_X + c * (NODE_W + GAP_X),
+                GAP_Y + r * (NODE_H + GAP_Y))
+
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+             f'height="{H}" viewBox="0 0 {W} {H}">',
+             '<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+             'refX="7" refY="3" orient="auto"><path d="M0,0 L8,3 L0,6 z" '
+             'fill="#666"/></marker></defs>',
+             f'<rect width="{W}" height="{H}" fill="white"/>']
+    for node in topo:
+        x2, y2 = xy(node)
+        for src in node.inputs:
+            x1, y1 = xy(src)
+            parts.append(
+                f'<path d="M{x1 + NODE_W / 2},{y1 + NODE_H} '
+                f'C{x1 + NODE_W / 2},{y1 + NODE_H + 24} '
+                f'{x2 + NODE_W / 2},{y2 - 24} {x2 + NODE_W / 2},{y2}" '
+                'stroke="#666" fill="none" marker-end="url(#arr)"/>')
+    for node in topo:
+        x, y = xy(node)
+        color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
+        label = node.name if len(node.name) <= 22 else node.name[:20] + "…"
+        label = html.escape(label)  # escape AFTER truncating: cutting inside
+        # an entity would emit a bare '&' and break the XML
+        parts.append(
+            f'<g><rect x="{x}" y="{y}" width="{NODE_W}" height="{NODE_H}" '
+            f'rx="6" fill="{color}" stroke="#888"/>'
+            f'<text x="{x + NODE_W / 2}" y="{y + NODE_H / 2 + 4}" '
+            'font-family="Helvetica" font-size="11" text-anchor="middle">'
+            f'{label}</text></g>')
+    parts.append("</svg>")
+    return "\n".join(parts)
+
+
+def render(executor, name=None, out_dir="graphboard_out"):
+    """Write output.dot / output.svg / index.html; returns out_dir."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "output.dot"), "w") as f:
+        f.write(make_dot(executor, name))
+    svg = make_svg(executor, name)
+    with open(os.path.join(out_dir, "output.svg"), "w") as f:
+        f.write(svg)
+    with open(os.path.join(out_dir, "index.html"), "w") as f:
+        f.write("<!doctype html><title>hetu_tpu graphboard</title>"
+                "<h3>Executor graph</h3>" + svg)
+    return out_dir
+
+
+def show(executor, port=9997, name=None, out_dir="graphboard_out"):
+    """Render + serve on a background thread (reference show :11)."""
+    global _server, _thread
+    render(executor, name, out_dir)
+    close()
+
+    def _make(*a, **k):
+        return http.server.SimpleHTTPRequestHandler(
+            *a, directory=os.path.abspath(out_dir), **k)
+
+    socketserver.TCPServer.allow_reuse_address = True
+    _server = socketserver.TCPServer(("127.0.0.1", port), _make)
+    _thread = threading.Thread(target=_server.serve_forever, daemon=True)
+    _thread.start()
+    return f"http://127.0.0.1:{port}/"
+
+
+def close():
+    """Stop the server (reference close :29)."""
+    global _server, _thread
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+        _thread = None
