@@ -8,8 +8,10 @@ only (block_q, block_k) tiles ever exist:
 - forward: a Pallas kernel — q/k/v tiles stream HBM->VMEM, scores hit the
   MXU, the running (max, sum) rescale keeps the softmax exact. Falls back to
   interpreter mode off-TPU so the same code runs in CPU-mesh tests.
-- backward: blockwise `lax.scan` recomputation in XLA (flash-style: no (S,S)
-  materialization; each dq/dk/dv tile recomputes its probability block).
+- backward: Pallas kernels both directions on TPU (a dq kernel over q blocks
+  and a fused dk+dv kernel over k blocks, each recomputing its probability
+  tile from (q, k, lse) — no (S,S) materialization); off-TPU, a blockwise
+  `lax.scan` recomputation in XLA serves as fallback and numerical oracle.
 
 Public entry: ``flash_attention(q, k, v, causal=True)`` with shapes
 (batch, heads, seq, head_dim), differentiable via custom_vjp.
@@ -33,6 +35,23 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _causal_mask(s, q_start, k_start, block_q, block_k):
+    """Mask scores above the diagonal for one (q block, k block) tile."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _causal_upper_kb(q_start, block_q, block_k):
+    """First key block strictly above the diagonal, by CEIL division —
+    flooring would drop the diagonal block whenever block_q < block_k
+    (regression guard: test_flash_causal_uneven_blocks). Shared by the
+    forward and dq kernels so the bound cannot drift between them."""
+    return (q_start + block_q + block_k - 1) // block_k
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -54,11 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, q_start, kj * block_k, block_q, block_k)
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -68,10 +83,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             p, v_blk, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
-    # causal: skip key blocks entirely above the diagonal (ceil division —
-    # flooring would drop the diagonal block whenever block_q < block_k)
+    # causal: skip key blocks entirely above the diagonal
     upper = (num_kb if not causal
-             else (q_start + block_q + block_k - 1) // block_k)
+             else _causal_upper_kb(q_start, block_q, block_k))
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
@@ -112,7 +126,143 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 # ---------------------------------------------------------------------------
+# backward Pallas kernels (dq; dk+dv) — flash backward both directions:
+# each tile recomputes its probability block from (q, k, lse), so nothing
+# (S, S)-shaped ever exists. delta = rowsum(dO * O) is precomputed in XLA.
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k, seq_len):
+    # grid: (batch*heads, q_blocks); owns one q block, loops over k blocks
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                  # (block_q, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]                            # (block_q,)
+    delta = delta_ref[0, :, 0]
+    block_q = q.shape[0]
+    q_start = qi * block_q
+    num_kb = seq_len // block_k
+
+    def body(kj, dq):
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_start, kj * block_k, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot(ds, k_blk,
+                                preferred_element_type=jnp.float32)
+
+    upper = (num_kb if not causal
+             else _causal_upper_kb(q_start, block_q, block_k))
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
+    # grid: (batch*heads, k_blocks); owns one k/v block, loops over q blocks
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)              # (block_k, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+    k_start = ki * block_k
+    num_qb = seq_len // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, k_start, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                 # (block_q, block_k)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: q blocks strictly before this k block contribute nothing
+    lower = (k_start // block_q) if causal else 0
+    d = k_blk.shape[1]
+    dk, dv = jax.lax.fori_loop(
+        lower, num_qb, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(res, do, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    b, h, s, d = q.shape
+    bh = b * h
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                           # (b, h, s)
+    qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
+    dof = do.reshape(bh, s, d)
+    lsef = lse.reshape(bh, s, 1)
+    deltaf = delta.reshape(bh, s, 1)
+
+    full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
+    col = pl.BlockSpec((1, s, 1), lambda i, j: (i, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=s),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            full, full,
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=s),
+        grid=(bh, s // block_k),
+        in_specs=[
+            full,
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            full, col, col,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
+
+
+# ---------------------------------------------------------------------------
 # blockwise backward (XLA): flash-style recomputation, no (S, S) tensor
+# (off-TPU fallback and the Pallas backward's numerical oracle)
 # ---------------------------------------------------------------------------
 
 def _bwd_blockwise(res, do, *, scale, causal, block_k):
@@ -186,6 +336,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
 def _flash_bwd(causal, scale, block_q, block_k, res, do):
     q = res[0]
     scale, block_q, block_k = _resolve(q, scale, block_q, block_k)
+    if _on_tpu():
+        return _bwd_pallas(res, do, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k, interpret=False)
     return _bwd_blockwise(res, do, scale=scale, causal=causal,
                           block_k=block_k)
 
