@@ -22,6 +22,7 @@
 #include <string>
 #include <thread>
 
+#include "ring.h"
 #include "scheduler.h"
 #include "server.h"
 #include "worker.h"
@@ -30,6 +31,7 @@ namespace {
 
 std::unique_ptr<hetups::Scheduler> g_scheduler;
 std::unique_ptr<hetups::PsServer> g_server;
+std::unique_ptr<hetups::RingComm> g_ring;
 std::shared_ptr<hetups::Conn> g_server_sched_conn;  // server's scheduler link
 std::shared_ptr<std::atomic<bool>> g_server_hb_stop;  // keepalive kill switch
 std::unique_ptr<hetups::PsWorker> g_worker;
@@ -318,6 +320,40 @@ int rank() { return g_worker ? worker().rank() : 0; }
 int nrank() { return g_worker ? worker().nrank() : 1; }
 int num_servers() {
   return g_worker ? static_cast<int>(worker().num_servers()) : 0;
+}
+
+// -- ring collectives (reference c_communication_nthread.cc legacy path) ----
+
+void RingInit(int rank, int nranks, const char* host, int base_port) {
+  guard([&] {
+    g_ring = std::make_unique<hetups::RingComm>(rank, nranks, host,
+                                                base_port);
+  });
+}
+
+void RingAllReduce(float* data, long n) {
+  guard([&] {
+    if (!g_ring) throw std::runtime_error("RingInit not called");
+    g_ring->allreduce_sum(data, static_cast<size_t>(n));
+  });
+}
+
+void RingAllGather(const float* in, float* out, long n_per) {
+  guard([&] {
+    if (!g_ring) throw std::runtime_error("RingInit not called");
+    g_ring->allgather(in, out, static_cast<size_t>(n_per));
+  });
+}
+
+void RingBarrier() {
+  guard([&] {
+    if (!g_ring) throw std::runtime_error("RingInit not called");
+    g_ring->barrier();
+  });
+}
+
+void RingFinalize() {
+  guard([] { g_ring.reset(); });
 }
 
 }  // extern "C"

@@ -1,0 +1,64 @@
+"""Host-side ring allreduce/allgather communicator (reference
+``src/communication/c_communication_nthread.cc`` — the legacy ZMQ ring used
+for CPU data parallelism without NCCL; here raw TCP, see
+``csrc/ps/ring.h``).
+
+On TPU the data-parallel gradient reduction is GSPMD's psum over ICI; this
+communicator exists for capability parity and for accelerator-less workers
+(e.g. host-only preprocessing jobs averaging statistics).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .client import _load_lib
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+class RingCommunicator:
+    """One per process. ``rank``/``nranks`` + a shared host/base_port define
+    the ring: rank r listens at base_port+r and connects to rank (r+1)%n."""
+
+    def __init__(self, rank: int, nranks: int, host: str = "127.0.0.1",
+                 base_port: int = 14400):
+        self._lib = _load_lib()
+        self._lib.RingInit(ctypes.c_int(rank), ctypes.c_int(nranks),
+                           host.encode(), ctypes.c_int(base_port))
+        self._check()
+        self.rank = rank
+        self.nranks = nranks
+
+    def _check(self):
+        err = self._lib.LastError()
+        if err:
+            raise RuntimeError(err.decode())
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """In-place sum-allreduce of a float32 array; returns it."""
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        self._lib.RingAllReduce(arr.ctypes.data_as(_f32p),
+                                ctypes.c_long(arr.size))
+        self._check()
+        return arr
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        """Gather equal-sized float32 arrays from all ranks; returns
+        (nranks, *arr.shape)."""
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        out = np.empty((self.nranks,) + arr.shape, np.float32)
+        self._lib.RingAllGather(arr.ctypes.data_as(_f32p),
+                                out.ctypes.data_as(_f32p),
+                                ctypes.c_long(arr.size))
+        self._check()
+        return out
+
+    def barrier(self):
+        self._lib.RingBarrier()
+        self._check()
+
+    def finalize(self):
+        self._lib.RingFinalize()
+        self._check()
