@@ -37,11 +37,24 @@ def _mfu(flops_per_step, step_s):
     return flops_per_step / step_s / (PEAK_TFLOPS * 1e12)
 
 
-def bench_resnet18(batch_size=128, warmup=5, iters=30, dtype=None):
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    "examples", "cnn"))
-    import hetu_tpu as ht
+def _import_models(suite):
+    """Import examples/<suite>/models fresh — the cnn and ctr suites both
+    name their package ``models``, so the cached module must be dropped."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "examples", suite)
+    if path in sys.path:
+        sys.path.remove(path)
+    sys.path.insert(0, path)
+    for mod in [m for m in sys.modules
+                if m == "models" or m.startswith("models.")]:
+        del sys.modules[mod]
     import models
+    return models
+
+
+def bench_resnet18(batch_size=128, warmup=5, iters=30, dtype=None):
+    import hetu_tpu as ht
+    models = _import_models("cnn")
 
     rng = np.random.RandomState(0)
     n = batch_size * 4
@@ -145,8 +158,6 @@ def _server_proc(port, idx):
 def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
     """Returns {prefetch_on: (sps, ms, perf), prefetch_off: (sps, ms)} — the
     overlap A/B the reference's prefetch x ASP matrix is about."""
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    "examples", "ctr"))
     port = _PS_PORT
     ctx = multiprocessing.get_context("spawn")
     procs = [ctx.Process(target=_sched_proc, args=(port,))]
@@ -158,7 +169,7 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
     os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
     try:
         import hetu_tpu as ht
-        import models
+        models = _import_models("ctr")
         from models.load_data import load_criteo_data
 
         (tr_dense, tr_sparse, tr_y), _ = load_criteo_data(
