@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import time
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -331,6 +332,13 @@ class SubExecutor:
         self._last_call = None  # (jitted fn, args) of the latest run
         # device-side input double buffer: id(node) -> (host batch, device arr)
         self._dev_prefetch: dict[int, tuple] = {}
+        # HETU_PROFILE=1: cumulative host-side phase timings + step count
+        # (the reference's profiling surface is --timing walls + PS load
+        # recording; this adds a per-phase breakdown, ``sub.profile_summary()``)
+        self._profile = ({"prestep_s": 0.0, "trace_build_s": 0.0,
+                          "dispatch_s": 0.0, "poststep_s": 0.0, "steps": 0}
+                         if os.environ.get("HETU_PROFILE", "0")
+                         not in ("", "0") else None)
 
         # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
         ps = executor.ps_runtime
@@ -503,6 +511,23 @@ class SubExecutor:
         donate = (0, 1, 2) if training else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def profile_summary(self):
+        """Per-step host-phase breakdown (HETU_PROFILE=1), or None.
+
+        prestep = feeds/batches/PS pulls staging; dispatch = the jit call
+        (enqueue + any blocking transfers); poststep = PS push issue,
+        prefetch issue, state bookkeeping; trace_build = tracing+compile.
+        Host-side phases only: under async dispatch the device compute wait
+        lands wherever the first output is materialized (often the caller's
+        ``asnumpy``), so the phases need not sum to wall time per step.
+        """
+        p = self._profile
+        if p is None or p["steps"] == 0:
+            return None
+        n = p["steps"]
+        return {k.replace("_s", "_ms_per_step"): round(v / n * 1000, 3)
+                for k, v in p.items() if k != "steps"} | {"steps": n}
+
     def last_cost_analysis(self):
         """XLA cost analysis (flops etc.) of the latest executed step, for
         MFU reporting (reaches the compilation cache — no recompile)."""
@@ -518,6 +543,8 @@ class SubExecutor:
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False,
             eval_node_list=None):
         ex = self.executor
+        prof = self._profile  # HETU_PROFILE=1: per-phase wall-time ledger
+        t_run0 = time.perf_counter() if prof is not None else 0.0
         feed_dict = feed_dict or {}
         feed_vals = []
         for node in self.feed_nodes:
@@ -560,12 +587,19 @@ class SubExecutor:
             ps.wait_dense(p)   # async DDPushPull updates host_value
             ps_dense_vals.append(ex._prepare_input(p.host_value, batch=False))
 
+        if prof is not None:
+            t_pre = time.perf_counter()
+            prof["prestep_s"] += t_pre - t_run0
+
         key = self._signature(feed_vals, batch_vals) + (
             tuple(tuple(v.shape) for v in ps_staged_vals),)
         fn = self._compiled.get(key)
         if fn is None:
+            t_c0 = time.perf_counter() if prof is not None else 0.0
             fn = self._build()
             self._compiled[key] = fn
+            if prof is not None:
+                prof["trace_build_s"] += time.perf_counter() - t_c0
 
         params_t = tuple(ex.state["params"][id(n)] for n in ex.param_nodes)
         slots_t = tuple(ex.state["slots"][id(n)] for n in self.optimizer_nodes)
@@ -578,7 +612,11 @@ class SubExecutor:
                 tuple(feed_vals), tuple(batch_vals), tuple(dl_cursors),
                 res_data, tuple(ps_staged_vals), tuple(ps_dense_vals))
         self._last_call = (fn, args)
+        t_d0 = time.perf_counter() if prof is not None else 0.0
         outputs, new_params, new_slots, new_opstate, ps_grads = fn(*args)
+        t_d1 = time.perf_counter() if prof is not None else 0.0
+        if prof is not None:
+            prof["dispatch_s"] += t_d1 - t_d0
 
         # -- device-side input prefetch: enqueue batch N+1's device_put now,
         # so its H2D transfer overlaps this step's compute (the reference's
@@ -627,6 +665,10 @@ class SubExecutor:
             for node, val in zip(self.stateful_nodes, new_opstate):
                 ex.state["op_state"][id(node)] = val
             ex.state["step"] = step + 1
+
+        if prof is not None:
+            prof["poststep_s"] += time.perf_counter() - t_d1
+            prof["steps"] += 1
 
         results = []
         wanted = eval_node_list if eval_node_list is not None else self.eval_nodes

@@ -1,0 +1,102 @@
+"""PS push/pull bandwidth microbench (reference
+``tests/pstests/test_bandwidth.py`` — prints MB/s per PSF against a local
+cluster). Run standalone:
+
+    python tests/pstests/test_bandwidth.py [--nitem 512] [--item-len 4096]
+
+or via pytest (small sizes, asserts only sanity, prints the numbers).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def run_bandwidth(client, nitem=512, item_len=4096, sparse_rows=256,
+                  iters=10, report=print):
+    """Returns {psf_name: MB/s} for dense push/pull/DDPushPull and sparse
+    pull/push against the connected cluster."""
+    n = nitem * item_len
+    mb = n * 4 / 1e6
+    out = {}
+
+    client.InitTensor(900, sparse=False, length=n, width=1,
+                      init_type="constant", init_a=0.5)
+    buf = np.empty(n, np.float32)
+    grad = np.random.rand(n).astype(np.float32)
+
+    t0 = time.time()
+    for _ in range(iters):
+        client.Push(900, grad)
+        client.Wait(900)
+    out["dense_push"] = mb * iters / (time.time() - t0)
+
+    t0 = time.time()
+    for _ in range(iters):
+        client.Pull(900, buf)
+        client.Wait(900)
+    out["dense_pull"] = mb * iters / (time.time() - t0)
+
+    t0 = time.time()
+    for _ in range(iters):
+        client.DDPushPull(900, grad, buf)
+        client.Wait(900)
+    out["dd_push_pull"] = 2 * mb * iters / (time.time() - t0)
+
+    client.InitTensor(901, sparse=True, length=nitem, width=item_len,
+                      init_type="normal", init_a=0.0, init_b=0.1)
+    idx = np.random.randint(0, nitem, sparse_rows).astype(np.int64)
+    rows = np.empty((sparse_rows, item_len), np.float32)
+    smb = sparse_rows * item_len * 4 / 1e6
+    t0 = time.time()
+    for _ in range(iters):
+        client.SparsePull(901, idx, rows)
+        client.Wait(901)
+    out["sparse_pull"] = smb * iters / (time.time() - t0)
+
+    t0 = time.time()
+    for _ in range(iters):
+        client.SparsePush(901, idx, rows)
+        client.Wait(901)
+    out["sparse_push"] = smb * iters / (time.time() - t0)
+
+    for name, rate in out.items():
+        report(f"[bandwidth] {name}: {rate:,.1f} MB/s")
+    return out
+
+
+def _worker(client, rank, tmpdir):
+    rates = run_bandwidth(client)
+    assert all(r > 1.0 for r in rates.values()), rates  # sanity: >1 MB/s
+    client.BarrierWorker()
+
+
+def test_ps_bandwidth(tmp_path):
+    from test_ps import run_cluster
+    run_cluster(_worker, tmp_path, n_workers=1, timeout=300)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nitem", type=int, default=2000)
+    ap.add_argument("--item-len", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    def body(client, rank, tmpdir):
+        run_bandwidth(client, nitem=args.nitem, item_len=args.item_len,
+                      iters=args.iters)
+        client.BarrierWorker()
+
+    import tempfile
+    from test_ps import run_cluster
+    run_cluster(body, tempfile.mkdtemp(), n_workers=1, timeout=600)
+
+
+if __name__ == "__main__":
+    main()

@@ -227,6 +227,27 @@ def test_bf16_compute_mode():
     assert l16[-1] < l16[0]
 
 
+def test_profile_summary(monkeypatch):
+    """HETU_PROFILE=1 produces a per-phase breakdown; off by default."""
+    monkeypatch.setenv("HETU_PROFILE", "1")
+    x = ht.Variable(name="x", trainable=False)
+    w = ht.Variable("wprof", value=np.ones((3, 2), np.float32))
+    out = ht.matmul_op(x, w)
+    ex = ht.Executor([out], ctx=ht.cpu(0))
+    for _ in range(3):
+        ex.run("default", feed_dict={x: np.ones((4, 3), np.float32)})
+    prof = ex.subexecutors["default"].profile_summary()
+    assert prof["steps"] == 3
+    for key in ("prestep_ms_per_step", "dispatch_ms_per_step",
+                "poststep_ms_per_step", "trace_build_ms_per_step"):
+        assert prof[key] >= 0.0
+
+    monkeypatch.delenv("HETU_PROFILE")
+    ex2 = ht.Executor([out], ctx=ht.cpu(0))
+    ex2.run("default", feed_dict={x: np.ones((4, 3), np.float32)})
+    assert ex2.subexecutors["default"].profile_summary() is None
+
+
 def test_bf16_conv_bn_training():
     """Regression for the round-2 bench crash: conv under jax.grad in bf16
     compute mode (the conv transpose rule must see matching dtypes), with

@@ -1,7 +1,6 @@
 """Graphboard renders the ResNet train graph and serves it
 (reference ``python/graphboard/graph2fig.py:11-31``)."""
 import os
-import sys
 import urllib.request
 import xml.etree.ElementTree as ET
 
@@ -10,12 +9,11 @@ import numpy as np
 import hetu_tpu as ht
 from hetu_tpu import graphboard
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", "examples", "cnn"))
+from test_models import _import_example_models
 
 
 def _resnet_executor():
-    import models
+    models = _import_example_models("cnn")
     x = ht.Variable(name="x", trainable=False)
     y_ = ht.Variable(name="y", trainable=False)
     loss, y = models.resnet18(x, y_, 10)
