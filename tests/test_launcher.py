@@ -46,12 +46,22 @@ def test_heturun_single_machine(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    out = subprocess.run(
+    # own session: on timeout the WHOLE tree (scheduler/servers/workers) is
+    # killed — an orphaned server holding its port would wedge later runs
+    proc = subprocess.Popen(
         [sys.executable, "-m", "hetu_tpu.runner", "-c", str(cfg),
          sys.executable, str(train)],
-        capture_output=True, text=True, timeout=240, env=env, cwd=str(tmp_path))
-    assert out.returncode == 0, out.stdout + "\n" + out.stderr
-    assert out.stdout.count("WORKER_DONE") == 2, out.stdout + out.stderr
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(tmp_path), start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        raise
+    assert proc.returncode == 0, stdout + "\n" + stderr
+    assert stdout.count("WORKER_DONE") == 2, stdout + stderr
 
 
 def test_launcher_yaml_ps_roles(tmp_path):
