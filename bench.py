@@ -233,6 +233,16 @@ def main():
     skip_extras = "--fast" in sys.argv
     if not skip_extras:
         try:
+            # in-repo A/B twin (VERDICT weak#7): same model, pure JAX, no
+            # framework — executor overhead = twin/executor ratio
+            _import_models("cnn")  # dedup-inserts examples/cnn on sys.path
+            import jax_twin
+            tsps, tms = jax_twin.bench(batch_size=256, dtype="bf16")
+            detail["jax_native_twin_bf16_bs256"] = {
+                "samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
+        except Exception as e:  # noqa: BLE001
+            detail["jax_native_twin_bf16_bs256"] = {"error": str(e)[:200]}
+        try:
             toks, tms, tmfu = bench_transformer()
             detail["transformer_38M_seq512"] = {
                 "tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),

@@ -95,17 +95,21 @@ void Init() {
     } else if (role == "server") {
       if (g_server) return;
       int id = env_int_or("SERVER_ID", 0);
-      int port = env_int_or("DMLC_PS_SERVER_PORT", 13201 + 2 * id);
+      // default 0 = OS-assigned: the server binds before anyone learns the
+      // number and registers the ACTUAL port with the scheduler, so stale
+      // clusters can never wedge a new launch on a port collision
+      int port = env_int_or("DMLC_PS_SERVER_PORT", 0);
       std::string host = env_or("DMLC_PS_SERVER_URI", "127.0.0.1");
       g_server = std::make_unique<hetups::PsServer>(id, host, port);
       // recovery-restores-state: a replacement server rebuilds its store
-      // from the last ParamSave directory BEFORE it starts serving — the
-      // listen port is deterministic, so a reconnecting worker must never
-      // observe the empty pre-restore store (the worker does NOT re-init;
-      // see server.h load_param_file)
+      // from the last ParamSave directory BEFORE it starts serving — a
+      // reconnecting worker (racing via the scheduler's address book or a
+      // pinned port) must never observe the empty pre-restore store (the
+      // worker does NOT re-init; see server.h load_param_file)
       const char* restore_dir = std::getenv("DMLC_PS_RESTORE_DIR");
       if (restore_dir && *restore_dir) g_server->restore_from(restore_dir);
       g_server->start();
+      port = g_server->port();  // actual bound port when OS-assigned
       // register the listen address with the scheduler
       g_server_sched_conn = std::make_shared<hetups::Conn>(
           hetups::connect_to(root, root_port));

@@ -32,9 +32,21 @@ class PsServer {
 
   void start() {
     listen_fd_ = listen_on("", port_);
+    if (port_ == 0) {
+      // OS-assigned port (race-free: bound before anyone learns it; the
+      // actual number reaches workers via the scheduler's address book)
+      sockaddr_in addr{};
+      socklen_t len = sizeof(addr);
+      if (::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+                        &len) != 0)
+        throw std::runtime_error("hetups: getsockname failed");
+      port_ = ntohs(addr.sin_port);
+    }
     running_ = true;
     accept_thread_ = std::thread([this] { accept_loop(); });
   }
+
+  int port() const { return port_; }
 
   void stop() {
     running_ = false;

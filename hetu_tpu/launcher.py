@@ -47,15 +47,8 @@ def start_server(server_id=0, env=None):
     os.environ.update(env or {})
     os.environ["DMLC_ROLE"] = "server"
     os.environ.setdefault("SERVER_ID", str(server_id))
-    if "DMLC_PS_SERVER_PORT" not in os.environ:
-        # pick a free port instead of the fixed 13201+2*id default: workers
-        # discover server addresses through the scheduler's address book, so
-        # nothing depends on the number — and a stale process from a killed
-        # earlier cluster can never wedge a new launch on a port collision
-        import socket
-        with socket.socket() as s:
-            s.bind(("", 0))
-            os.environ["DMLC_PS_SERVER_PORT"] = str(s.getsockname()[1])
+    # no DMLC_PS_SERVER_PORT -> the native server binds an OS-assigned port
+    # itself (race-free) and registers the actual number with the scheduler
     import signal as _signal
     import threading
     from hetu_tpu.ps import server as srv
