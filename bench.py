@@ -16,6 +16,7 @@ cost ~64ms and must not be counted per step.
 vs_baseline: the reference publishes no numbers (BASELINE.md); recorded
 baseline = our round-1 f32 measurement (4929.1 samples/s on v5e-1).
 """
+import contextlib
 import json
 import multiprocessing
 import os
@@ -53,6 +54,13 @@ def _import_models(suite):
 
 
 def bench_resnet18(batch_size=128, warmup=5, iters=30, dtype=None):
+    # stdout must stay clean: the driver's contract is ONE JSON line, and
+    # the example model zoo prints "Building ..." banners
+    with contextlib.redirect_stdout(sys.stderr):
+        return _bench_resnet18(batch_size, warmup, iters, dtype)
+
+
+def _bench_resnet18(batch_size, warmup, iters, dtype):
     import hetu_tpu as ht
     models = _import_models("cnn")
 
