@@ -18,3 +18,21 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# shared Executor-driving helpers for op-parity tests (used by test_ops.py
+# and test_op_parity.py)
+def run_graph_helper(out_node, feeds=None):
+    import hetu_tpu as ht
+    ex = ht.Executor([out_node], ctx=ht.cpu(0))
+    (res,) = ex.run("default", feed_dict=feeds or {})
+    return res.asnumpy()
+
+
+def feed_helper(shape=None, val=None, seed=0, name="x"):
+    import numpy as np
+    import hetu_tpu as ht
+    node = ht.Variable(name=name, trainable=False)
+    if val is None:
+        val = np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    return node, val

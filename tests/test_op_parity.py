@@ -1,0 +1,299 @@
+"""Coverage for the op-registry surface not exercised elsewhere: explicit
+gradient ops (API parity with the reference's per-op Gradient classes,
+checked against jax.vjp of the paired forward), remaining elementwise ops,
+and the transfer/comm identity markers. Mirrors reference
+``tests/test_gpu_op.py``'s one-kernel-one-oracle style."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+from conftest import run_graph_helper as run_graph, feed_helper as feed
+
+
+# ---------------------------------------------------------------------------
+# elementwise / misc forwards
+# ---------------------------------------------------------------------------
+
+def test_exp_log_gelu_rsqrt():
+    a, av = feed((4, 6), seed=1, name="a")
+    pos = np.abs(av) + 0.5
+    p, _ = feed(val=pos, name="p")
+    np.testing.assert_allclose(run_graph(ht.exp_op(a), {a: av}), np.exp(av),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(run_graph(ht.log_op(p), {p: pos}), np.log(pos),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(run_graph(ht.rsqrt_op(p), {p: pos}),
+                               1.0 / np.sqrt(pos), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(run_graph(ht.gelu_op(a), {a: av}),
+                               np.asarray(jax.nn.gelu(av)), rtol=RTOL,
+                               atol=ATOL)
+
+
+def test_ones_zeros_like_divconst_matrixdot():
+    a, av = feed((3, 5), seed=2, name="a")
+    np.testing.assert_allclose(run_graph(ht.oneslike_op(a), {a: av}),
+                               np.ones_like(av))
+    np.testing.assert_allclose(run_graph(ht.zeroslike_op(a), {a: av}),
+                               np.zeros_like(av))
+    av_nz = av + np.sign(av) + 0.1
+    np.testing.assert_allclose(run_graph(ht.div_const_op(2.0, a), {a: av_nz}),
+                               2.0 / av_nz, rtol=RTOL, atol=ATOL)
+    b, bv = feed((3, 5), seed=3, name="b")
+    # reference MatrixDot kernel is an elementwise product
+    np.testing.assert_allclose(run_graph(ht.matrix_dot_op(a, b),
+                                         {a: av, b: bv}), av * bv,
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_conv_bias_broadcast_and_reduce():
+    x, xv = feed((2, 3, 4, 4), seed=4, name="x")
+    b, bv = feed((3,), seed=5, name="b")
+    out = run_graph(ht.conv2d_broadcastto_op(b, x), {x: xv, b: bv})
+    np.testing.assert_allclose(out, np.broadcast_to(
+        bv[None, :, None, None], xv.shape))
+    out2 = run_graph(ht.conv2d_reducesum_op(x), {x: xv})
+    np.testing.assert_allclose(out2, xv.sum(axis=(0, 2, 3)), rtol=RTOL,
+                               atol=ATOL)
+
+
+def test_instance_norm2d():
+    x, xv = feed((2, 3, 5, 5), seed=6, name="x")
+    out = run_graph(ht.instance_normalization2d_op(x, eps=1e-5), {x: xv})
+    mean = xv.mean(axis=(2, 3), keepdims=True)
+    var = xv.var(axis=(2, 3), keepdims=True)
+    np.testing.assert_allclose(out, (xv - mean) / np.sqrt(var + 1e-5),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_transfer_markers_and_placeholder_alias():
+    a, av = feed((2, 3), seed=7, name="a")
+    np.testing.assert_allclose(
+        run_graph(ht.datad2h_op(ht.datah2d_op(a)), {a: av}), av)
+    p = ht.placeholder_op(name="p2")  # reference Variable alias
+    np.testing.assert_allclose(run_graph(p + 0.0, {p: av}), av)
+
+
+def test_allreduce_ops_identity_off_mesh():
+    """Without a mesh the (group)allreduce markers are identities."""
+    a, av = feed((4, 2), seed=8, name="a")
+    np.testing.assert_allclose(
+        run_graph(ht.allreduceCommunicate_op(a), {a: av}), av)
+    np.testing.assert_allclose(
+        run_graph(ht.groupallreduceCommunicate_op(a), {a: av}), av)
+
+
+def test_dropout2d_channelwise():
+    """dropout2d drops WHOLE channels; survivors are scaled by 1/keep."""
+    x, xv = feed(val=np.ones((4, 8, 5, 5), np.float32), name="x")
+    node = ht.dropout2d_op(x, 0.5)
+    # optimizer present => tc.training True, so the mask is actually drawn
+    train = ht.optim.SGDOptimizer(0.0).minimize(
+        ht.reduce_mean_op(node * ht.Variable("w2d", value=np.ones(
+            (4, 8, 5, 5), np.float32)), [0, 1, 2, 3]))
+    ex = ht.Executor({"t": [node, train]}, ctx=ht.cpu(0), seed=0)
+    out = ex.run("t", feed_dict={x: xv},
+                 convert_to_numpy_ret_vals=True)[0]
+    per_channel = out.reshape(4, 8, -1)
+    for nc in per_channel.reshape(-1, per_channel.shape[-1]):
+        assert np.all(nc == 0.0) or np.allclose(nc, 2.0), nc  # 1/keep = 2
+    kept = (per_channel[..., 0] != 0).mean()
+    assert 0.2 < kept < 0.8
+
+
+def test_dropout_gradient_regenerates_forward_mask():
+    """dropout(2d)_gradient_op rebuilds the forward op's mask from its RNG:
+    positions zeroed in the forward are zeroed in the grad, survivors scale
+    by 1/keep — so feeding the forward's own INPUT as the cotangent must
+    reproduce the forward output exactly (same mask, same scaling)."""
+    xval = np.ones((4, 6, 3, 3), np.float32)
+    for fwd_ctor, grad_ctor in ((ht.dropout_op, ht.dropout_gradient_op),
+                                (ht.dropout2d_op, ht.dropout2d_gradient_op)):
+        x, _ = feed(val=xval, name="x")
+        fwd = fwd_ctor(x, 0.5)
+        g = ht.Variable(name="g", trainable=False)
+        grad = grad_ctor(g, 0.5, fwd)
+        # a training graph (optimizer present) so tc.training is True
+        w = ht.Variable("wdrop", value=np.ones_like(xval))
+        train = ht.optim.SGDOptimizer(0.0).minimize(
+            ht.reduce_mean_op(fwd * w, [0, 1, 2, 3]))
+        ex = ht.Executor({"t": [fwd, grad, train]}, ctx=ht.cpu(0), seed=0)
+        fv, gv, _ = ex.run("t", feed_dict={x: xval, g: xval},
+                           convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(gv, fv, rtol=RTOL, atol=ATOL)
+        assert 0.0 < (fv != 0).mean() < 1.0  # mask actually dropped some
+
+
+# ---------------------------------------------------------------------------
+# explicit gradient ops vs jax.vjp of the paired forward
+# ---------------------------------------------------------------------------
+
+def test_conv2d_gradient_ops():
+    rng = np.random.RandomState(9)
+    xv = rng.randn(2, 3, 8, 8).astype(np.float32)
+    wv = rng.randn(4, 3, 3, 3).astype(np.float32)
+    dyv = rng.randn(2, 4, 8, 8).astype(np.float32)
+
+    def fwd(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    _, vjp = jax.vjp(fwd, jnp.asarray(xv), jnp.asarray(wv))
+    dx_ref, dw_ref = (np.asarray(v) for v in vjp(jnp.asarray(dyv)))
+
+    w, _ = feed(val=wv, name="w")
+    dy, _ = feed(val=dyv, name="dy")
+    x, _ = feed(val=xv, name="x")
+    dx = run_graph(ht.conv2d_gradient_of_data_op(w, dy, padding=1, stride=1),
+                   {w: wv, dy: dyv})
+    np.testing.assert_allclose(dx, dx_ref, rtol=RTOL, atol=1e-4)
+    dw = run_graph(ht.conv2d_gradient_of_filter_op(x, dy, padding=1, stride=1),
+                   {x: xv, dy: dyv})
+    np.testing.assert_allclose(dw, dw_ref, rtol=RTOL, atol=1e-4)
+
+
+def test_pool_gradient_ops():
+    rng = np.random.RandomState(10)
+    xv = rng.randn(2, 3, 8, 8).astype(np.float32)
+    x, _ = feed(val=xv, name="x")
+    for fwd_op, grad_op, jfwd in (
+            (ht.max_pool2d_op, ht.max_pool2d_gradient_op,
+             lambda v: jax.lax.reduce_window(v, -jnp.inf, jax.lax.max,
+                                             (1, 1, 2, 2), (1, 1, 2, 2),
+                                             "VALID")),
+            (ht.avg_pool2d_op, ht.avg_pool2d_gradient_op,
+             lambda v: jax.lax.reduce_window(v, 0.0, jax.lax.add,
+                                             (1, 1, 2, 2), (1, 1, 2, 2),
+                                             "VALID") / 4.0)):
+        yv = np.asarray(jfwd(jnp.asarray(xv)))
+        dyv = rng.randn(*yv.shape).astype(np.float32)
+        _, vjp = jax.vjp(jfwd, jnp.asarray(xv))
+        ref = np.asarray(vjp(jnp.asarray(dyv))[0])
+        y, _ = feed(val=yv, name="y")
+        dy, _ = feed(val=dyv, name="dy")
+        out = run_graph(grad_op(y, dy, x, 2, 2, 0, 2),
+                        {y: yv, dy: dyv, x: xv})
+        np.testing.assert_allclose(out, ref, rtol=RTOL, atol=1e-4)
+
+
+def test_activation_gradient_ops():
+    rng = np.random.RandomState(11)
+    xv = rng.randn(4, 6).astype(np.float32)
+    gv = rng.randn(4, 6).astype(np.float32)
+    x, _ = feed(val=xv, name="x")
+    g, _ = feed(val=gv, name="g")
+    np.testing.assert_allclose(
+        run_graph(ht.relu_gradient_op(x, g), {x: xv, g: gv}),
+        np.where(xv > 0, gv, 0.0), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(
+        run_graph(ht.leaky_relu_gradient_op(x, g, 0.1), {x: xv, g: gv}),
+        np.where(xv > 0, gv, 0.1 * gv), rtol=RTOL, atol=ATOL)
+    # softmax gradient takes the forward OUTPUT y
+    yv = np.asarray(jax.nn.softmax(jnp.asarray(xv), axis=-1))
+    y, _ = feed(val=yv, name="y")
+    _, vjp = jax.vjp(lambda v: jax.nn.softmax(v, -1), jnp.asarray(xv))
+    ref = np.asarray(vjp(jnp.asarray(gv))[0])
+    np.testing.assert_allclose(
+        run_graph(ht.softmax_gradient_op(y, g), {y: yv, g: gv}), ref,
+        rtol=RTOL, atol=ATOL)
+
+
+def test_shape_gradient_ops():
+    rng = np.random.RandomState(12)
+    xv = rng.randn(4, 6).astype(np.float32)
+    x, _ = feed(val=xv, name="x")
+
+    gv = rng.randn(24).astype(np.float32)
+    g, _ = feed(val=gv, name="g")
+    out = run_graph(ht.array_reshape_gradient_op(x, g), {x: xv, g: gv})
+    np.testing.assert_allclose(out, gv.reshape(4, 6))
+
+    # slice grad scatters back into the input shape
+    dyv = rng.randn(2, 3).astype(np.float32)
+    dy, _ = feed(val=dyv, name="dy")
+    out = run_graph(ht.slice_gradient_op(dy, (1, 2), size=(4, 6)),
+                    {dy: dyv})
+    ref = np.zeros((4, 6), np.float32)
+    ref[1:3, 2:5] = dyv
+    np.testing.assert_allclose(out, ref)
+
+    # concat grad slices each operand's chunk back out
+    a2 = rng.randn(4, 2).astype(np.float32)
+    gcat = rng.randn(4, 8).astype(np.float32)
+    ga, _ = feed(val=gcat, name="ga")
+    xa, _ = feed(val=a2, name="xa")
+    out0 = run_graph(ht.concat_gradient_op(ga, xa, axis=1, idx=0),
+                     {ga: gcat, xa: a2})
+    np.testing.assert_allclose(out0, gcat[:, :2])
+    out1 = run_graph(ht.concat_gradient_op(ga, xa, axis=1, idx=1),
+                     {ga: gcat, xa: a2})
+    np.testing.assert_allclose(out1, gcat[:, -2:])
+
+    # pad grad crops the padding back off
+    gp = rng.randn(6, 8).astype(np.float32)
+    gpn, _ = feed(val=gp, name="gp")
+    out = run_graph(ht.pad_gradient_op(gpn, [(1, 1), (1, 1)]), {gpn: gp})
+    np.testing.assert_allclose(out, gp[1:5, 1:7])
+
+    # split grad scatters the partition back
+    gs = rng.randn(2, 6).astype(np.float32)
+    gsn, _ = feed(val=gs, name="gs")
+    out = run_graph(ht.split_gradient_op(gsn, axes=0, indices=1, splits=2),
+                    {gsn: gs})
+    ref = np.zeros((4, 6), np.float32)
+    ref[2:] = gs
+    np.testing.assert_allclose(out, ref)
+
+
+def test_embedding_and_loss_gradient_ops():
+    rng = np.random.RandomState(13)
+    table_shape = (10, 4)
+    idxv = rng.randint(0, 10, (6,)).astype(np.float32)
+    vecv = rng.randn(6, 4).astype(np.float32)
+    idx, _ = feed(val=idxv, name="idx")
+    vec, _ = feed(val=vecv, name="vec")
+    out = run_graph(ht.embedding_lookup_gradient_op(vec, idx, table_shape),
+                    {vec: vecv, idx: idxv})
+    ref = np.zeros(table_shape, np.float32)
+    np.add.at(ref, idxv.astype(int), vecv)
+    np.testing.assert_allclose(out, ref, rtol=RTOL, atol=ATOL)
+
+    # bce / softmax-ce explicit gradients vs jax.vjp
+    logits = rng.randn(5, 3).astype(np.float32)
+    onehot = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 5)]
+    dl = rng.randn(5).astype(np.float32)
+
+    def sce(z):
+        return -jnp.sum(jnp.asarray(onehot) * jax.nn.log_softmax(z), axis=-1)
+
+    _, vjp = jax.vjp(sce, jnp.asarray(logits))
+    ref = np.asarray(vjp(jnp.asarray(dl))[0])
+    z, _ = feed(val=logits, name="z")
+    yt, _ = feed(val=onehot, name="yt")
+    dln, _ = feed(val=dl, name="dl")
+    out = run_graph(ht.softmaxcrossentropy_gradient_op(z, yt, dln),
+                    {z: logits, yt: onehot, dln: dl})
+    np.testing.assert_allclose(out, ref, rtol=RTOL, atol=1e-4)
+
+    probs = 1 / (1 + np.exp(-logits))
+    labels = (rng.rand(5, 3) > 0.5).astype(np.float32)
+
+    def bce(p):
+        return -(jnp.asarray(labels) * jnp.log(p)
+                 + (1 - jnp.asarray(labels)) * jnp.log(1 - p))
+
+    dlm = rng.randn(5, 3).astype(np.float32)
+    _, vjp = jax.vjp(bce, jnp.asarray(probs))
+    ref = np.asarray(vjp(jnp.asarray(dlm))[0])
+    p, _ = feed(val=probs, name="p")
+    lb, _ = feed(val=labels, name="lb")
+    dm, _ = feed(val=dlm, name="dm")
+    out = run_graph(ht.binarycrossentropy_gradient_op(p, lb, dm),
+                    {p: probs, lb: labels, dm: dlm})
+    np.testing.assert_allclose(out, ref, rtol=RTOL, atol=1e-4)

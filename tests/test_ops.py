@@ -11,17 +11,7 @@ import hetu_tpu as ht
 RTOL, ATOL = 1e-5, 1e-5
 
 
-def run_graph(out_node, feeds=None):
-    ex = ht.Executor([out_node], ctx=ht.cpu(0))
-    (res,) = ex.run("default", feed_dict=feeds or {})
-    return res.asnumpy()
-
-
-def feed(shape=None, val=None, seed=0, name="x"):
-    node = ht.Variable(name=name, trainable=False)
-    if val is None:
-        val = np.random.RandomState(seed).randn(*shape).astype(np.float32)
-    return node, val
+from conftest import run_graph_helper as run_graph, feed_helper as feed
 
 
 def test_add_mul_div():
