@@ -179,6 +179,7 @@ class PSRuntime:
         self._dense_push_fut: dict[int, Future] = {}
         self.perf = {"sync_pulls": 0, "prefetch_hits": 0,
                      "prefetch_misses": 0, "async_pushes": 0}
+        ps_pkg._register_runtime(self)  # drained at worker_finish
 
     # ------------------------------------------------------------------
     def _deduce_server_opt(self):

@@ -9,10 +9,18 @@ round (SURVEY.md §7 step 5).
 """
 from __future__ import annotations
 
+import weakref
+
 _NOT_READY = ("The parameter-server backend is not initialized. Launch roles "
               "via hetu_tpu.launcher (scheduler/server/worker) first.")
 
 _worker = None
+_runtimes: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _register_runtime(rt):
+    """PSRuntimes register here so worker_finish can drain them."""
+    _runtimes.add(rt)
 
 
 def scheduler_init():
@@ -47,6 +55,15 @@ def worker_init():
 
 def worker_finish():
     global _worker
+    # drain every live PSRuntime's async I/O streams BEFORE Finalize closes
+    # the sockets: an in-flight prefetch/push racing the teardown can leave a
+    # pool thread blocked in recv and hang the whole process on pool join
+    for rt in list(_runtimes):
+        try:
+            rt.drain()
+            rt.shutdown()
+        except Exception:  # noqa: BLE001 — teardown must not throw
+            pass
     if _worker is not None:
         _worker.close()
         _worker = None
