@@ -1,0 +1,72 @@
+"""Worker process for tests/test_multihost.py (not collected by pytest).
+
+Joins a 2-process Gloo world (2 virtual CPU devices per process -> 4-device
+global dp mesh), trains a linear model data-parallel with each process
+feeding only its own half of the batch, and prints the final loss/weights as
+one JSON line for the test to compare against a single-process oracle.
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from hetu_tpu.parallel import multihost as mh
+
+    assert mh.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid,
+                         local_device_count=2)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc
+
+    mesh = mh.global_mesh()          # all 4 devices on the dp axis
+    assert mesh.shape["dp"] == 2 * nproc
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    W_true = rng.randn(4, 2).astype(np.float32)
+    Y = X @ W_true
+    rows = len(X) // nproc            # this host's slice of the global batch
+    lo, hi = pid * rows, (pid + 1) * rows
+
+    W = jnp.zeros((4, 2), jnp.float32)
+    rep = NamedSharding(mesh, P())
+
+    @jax.jit
+    def step(W, x, y):
+        def loss_fn(W):
+            return jnp.mean((x @ W - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(W)
+        return loss, W - 0.1 * g
+
+    losses = []
+    for _ in range(20):
+        x = mh.host_local_batch(mesh, P("dp"), X[lo:hi])
+        y = mh.host_local_batch(mesh, P("dp"), Y[lo:hi])
+        loss, W = step(W, x, y)
+        W = jax.device_put(W, rep)
+        losses.append(float(loss))
+
+    mh.barrier("final")
+    # cross-host host-value allgather parity check
+    pids = mh.process_allgather(np.array([pid], np.int32))
+    seed = int(mh.broadcast_from_chief(np.array([1234 + pid], np.int32))[0])
+    print(json.dumps({
+        "pid": pid,
+        "first_loss": losses[0],
+        "final_loss": losses[-1],
+        "w_sum": float(np.sum(mh.fetch_replicated(W))),
+        "gathered_pids": np.asarray(pids).ravel().tolist(),
+        "chief_seed": seed,
+    }), flush=True)
+    mh.shutdown()
+
+
+if __name__ == "__main__":
+    main()
