@@ -93,6 +93,44 @@ def _bench_resnet18(batch_size, warmup, iters, dtype):
     return batch_size / dt, dt * 1000, _mfu(flops, dt)
 
 
+def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15):
+    """BERT-base MLM+NSP pretrain step (BASELINE.md north star: 'BERT-base
+    pretrain (Pallas attention)'). Dense packed batches -> the fused
+    bidirectional flash kernel; tokens/s and 6ND MFU."""
+    import jax
+    from hetu_tpu.models import bert
+
+    cfg = bert.BERT_BASE
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = bert.count_params(params)
+    opt = bert.init_opt_state(params)
+    step = bert.make_pretrain_step(cfg, mesh=None, lr=1e-4)
+    rng = np.random.RandomState(0)
+    P = 76  # ~15% of 512
+    batch = {
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (batch_size, seq_len)).astype(np.int32),
+        "segment_ids": (rng.rand(batch_size, seq_len) > 0.5).astype(np.int32),
+        "mlm_positions": np.sort(rng.randint(
+            1, seq_len, (batch_size, P)).astype(np.int32), axis=1),
+        "mlm_ids": rng.randint(0, cfg.vocab_size,
+                               (batch_size, P)).astype(np.int32),
+        "mlm_weights": np.ones((batch_size, P), np.float32),
+        "nsp_label": rng.randint(0, 2, (batch_size,)).astype(np.int32),
+    }
+    for _ in range(warmup):
+        loss, _, params, opt = step(params, opt, batch)
+    float(np.asarray(loss))   # hard sync: block_until_ready does not wait
+    t0 = time.time()          # for remote execution on the tunneled chip
+    for _ in range(iters):
+        loss, _, params, opt = step(params, opt, batch)
+    float(np.asarray(loss))   # one transfer for the whole window
+    dt = (time.time() - t0) / iters
+    tokens = batch_size * seq_len
+    flops = 6.0 * n_params * tokens
+    return tokens / dt, dt * 1000, _mfu(flops, dt), n_params
+
+
 def bench_transformer(warmup=3, iters=20):
     import jax
     import jax.numpy as jnp
@@ -109,11 +147,11 @@ def bench_transformer(warmup=3, iters=20):
     tgt = jnp.roll(tok, -1, axis=1)
     for _ in range(warmup):
         loss, params, opt = step(params, opt, tok, tgt)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))   # hard sync (see bench_bert)
     t0 = time.time()
     for _ in range(iters):
         loss, params, opt = step(params, opt, tok, tgt)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
     dt = (time.time() - t0) / iters
     tokens = 16 * 512
     # 6ND: fwd+bwd matmul flops for a decoder-only transformer
@@ -257,6 +295,14 @@ def main():
                 "mfu_6nd": round(tmfu, 4) if tmfu else None}
         except Exception as e:  # noqa: BLE001 — partial bench beats no bench
             detail["transformer_38M_seq512"] = {"error": str(e)[:200]}
+        try:
+            toks, tms, tmfu, n_params = bench_bert()
+            detail["bert_base_pretrain_seq512"] = {
+                "tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
+                "mfu_6nd": round(tmfu, 4) if tmfu else None,
+                "n_params": n_params}
+        except Exception as e:  # noqa: BLE001
+            detail["bert_base_pretrain_seq512"] = {"error": str(e)[:200]}
         try:
             wdl = bench_wdl_ps()
             wdl["servers"] = 2

@@ -1,0 +1,197 @@
+"""BERT — bidirectional encoder pretraining (MLM + NSP), TPU-native.
+
+The reference's NLP suite stops at a causal Transformer example plus the
+WordPiece tokenizer and the pretrain data pipeline
+(``python/hetu/tokenizers/bert_tokenizer.py``,
+``examples/nlp/processBertData.py``); BASELINE.md names BERT-base pretrain
+as a north-star config. This module completes the path: the encoder reuses
+the flagship transformer trunk (``models/transformer.py``) with
+``causal=False`` — same Pallas flash-attention kernel (bidirectional mask),
+same lax.scan-over-stacked-layers + remat structure, same Megatron tp
+sharding — and adds what BERT needs on top:
+
+- token-type (segment) embeddings,
+- MLM head: transform (dense+gelu+LN) then decode TIED to the token
+  embedding, plus an output bias,
+- NSP head on the pooled [CLS] vector,
+- a fused pretrain step consuming exactly the data pipeline's rows
+  (input_ids, input_mask, segment_ids, mlm_positions, mlm_ids, nsp_label).
+
+Padded batches: ``input_mask`` becomes an additive attention bias on the
+unfused path (the fused kernel assumes packed/dense batches, standard for
+pretrain throughput).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: str = "auto"
+
+    def trunk(self) -> tfm.TransformerConfig:
+        return tfm.TransformerConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_heads=self.n_heads, n_layers=self.n_layers, d_ff=self.d_ff,
+            max_seq_len=self.max_seq_len, dtype=self.dtype, remat=self.remat,
+            attn_impl=self.attn_impl, causal=False)
+
+
+BERT_BASE = BertConfig()
+
+
+def init_params(rng, cfg: BertConfig):
+    D, V = cfg.d_model, cfg.vocab_size
+    ks = jax.random.split(rng, 5)
+    params = tfm.init_params(ks[0], cfg.trunk())
+    del params["head"]   # MLM decode is TIED to the token embedding
+    params["type_emb"] = jax.random.normal(
+        ks[1], (cfg.type_vocab_size, D), jnp.float32) * 0.02
+    params["mlm_dense"] = jax.random.normal(ks[2], (D, D), jnp.float32) * 0.02
+    params["mlm_ln_scale"] = jnp.ones((D,), jnp.float32)
+    params["mlm_ln_bias"] = jnp.zeros((D,), jnp.float32)
+    params["mlm_bias"] = jnp.zeros((V,), jnp.float32)
+    params["pool_w"] = jax.random.normal(ks[3], (D, D), jnp.float32) * 0.02
+    params["pool_b"] = jnp.zeros((D,), jnp.float32)
+    params["nsp_w"] = jax.random.normal(ks[4], (D, 2), jnp.float32) * 0.02
+    params["nsp_b"] = jnp.zeros((2,), jnp.float32)
+    return params
+
+
+def param_specs(cfg: BertConfig):
+    specs = tfm.param_specs(cfg.trunk())
+    del specs["head"]
+    specs.update({
+        "type_emb": P(None, None),
+        "mlm_dense": P(None, "tp"),
+        "mlm_ln_scale": P(None),
+        "mlm_ln_bias": P(None),
+        "mlm_bias": P("tp"),
+        "pool_w": P(None, None),
+        "pool_b": P(None),
+        "nsp_w": P(None, None),
+        "nsp_b": P(None),
+    })
+    return specs
+
+
+def encode(params, input_ids, segment_ids, cfg: BertConfig,
+           mesh: Optional[Mesh] = None, input_mask=None):
+    """-> final hidden states (B, T, D) after the trunk's final LN."""
+    trunk = cfg.trunk()
+    h = tfm.embed_tokens(params, input_ids, trunk)
+    h = h + params["type_emb"][segment_ids].astype(h.dtype)
+    attn_bias = None
+    if input_mask is not None:
+        # (B, T) 1/0 -> additive (B, 1, 1, T): padded keys get -1e30
+        attn_bias = (1.0 - input_mask.astype(jnp.float32)
+                     )[:, None, None, :] * -1e30
+    h, _aux = tfm.encode(params, h, trunk, mesh, attn_bias)
+    return tfm._layer_norm(h, params["lnf_scale"], params["lnf_bias"])
+
+
+def mlm_logits(params, h, positions):
+    """Gather (B, P) masked positions from h (B, T, D), run the MLM
+    transform, decode tied to the token embedding. -> (B, P, V) f32."""
+    g = jnp.take_along_axis(h, positions[..., None], axis=1)      # (B, P, D)
+    g = jnp.einsum("bpd,de->bpe", g, params["mlm_dense"].astype(g.dtype),
+                   preferred_element_type=jnp.float32).astype(g.dtype)
+    g = jax.nn.gelu(g)
+    g = tfm._layer_norm(g, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    logits = jnp.einsum("bpd,vd->bpv", g, params["embed"].astype(g.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits + params["mlm_bias"]
+
+
+def nsp_logits(params, h):
+    """Pooled [CLS] (tanh dense) -> (B, 2) f32."""
+    cls = h[:, 0, :]
+    pooled = jnp.tanh(cls.astype(jnp.float32) @ params["pool_w"]
+                      + params["pool_b"])
+    return pooled @ params["nsp_w"] + params["nsp_b"]
+
+
+def pretrain_loss(params, batch, cfg: BertConfig, mesh=None):
+    """batch: dict with the data pipeline's rows. Returns (loss, (mlm, nsp))
+    where mlm is averaged over real (weighted) prediction slots."""
+    h = encode(params, batch["input_ids"], batch["segment_ids"], cfg, mesh,
+               batch.get("input_mask"))
+    logits = mlm_logits(params, h, batch["mlm_positions"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    per_slot = -jnp.take_along_axis(
+        logp, batch["mlm_ids"][..., None], -1)[..., 0]            # (B, P)
+    w = batch["mlm_weights"].astype(jnp.float32)
+    mlm = jnp.sum(per_slot * w) / jnp.maximum(jnp.sum(w), 1.0)
+    nl = jax.nn.log_softmax(nsp_logits(params, h), -1)
+    nsp = -jnp.mean(jnp.take_along_axis(nl, batch["nsp_label"][:, None],
+                                        -1)[:, 0])
+    return mlm + nsp, (mlm, nsp)
+
+
+def make_pretrain_step(cfg: BertConfig, mesh: Optional[Mesh] = None,
+                       lr: float = 1e-4):
+    """Jitted (params, opt_state, batch) -> (loss, (mlm, nsp), params, opt);
+    AdamW fused into the step, buffers donated, GSPMD dp/tp sharding."""
+
+    def step(params, opt_state, batch):
+        (loss, parts), grads = jax.value_and_grad(
+            pretrain_loss, has_aux=True)(params, batch, cfg, mesh)
+        new_params, new_opt = tfm.adamw_update(params, grads, opt_state,
+                                               lr=lr)
+        return loss, parts, new_params, new_opt
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    specs = param_specs(cfg)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    # pytree-prefix sharding: every batch leaf is (B, ...), dp-sharded on
+    # dim 0, whether or not the optional input_mask key is present
+    dshard = NamedSharding(mesh, P(("dp",)))
+    scalar = NamedSharding(mesh, P())
+    return jax.jit(step,
+                   in_shardings=(pshard, opt_shard, dshard),
+                   out_shardings=(scalar, (scalar, scalar), pshard,
+                                  opt_shard),
+                   donate_argnums=(0, 1))
+
+
+def batch_from_instances(instances):
+    """Stack rows from the pretrain data pipeline
+    (examples/nlp/processBertData.create_instances_from_document) into the
+    batch dict ``pretrain_loss`` consumes. Prediction-slot weights are
+    derived from the position padding (index 0 is always [CLS], which the
+    masker never selects, so pos==0 marks a padded slot)."""
+    cols = list(zip(*instances))
+    ids, mask, seg, pos, mids = (np.stack(c).astype(np.int32)
+                                 for c in cols[:5])
+    return {"input_ids": ids, "input_mask": mask, "segment_ids": seg,
+            "mlm_positions": pos, "mlm_ids": mids,
+            "mlm_weights": (pos != 0).astype(np.float32),
+            "nsp_label": np.asarray(cols[5], np.int32)}
+
+
+init_opt_state = tfm.init_opt_state
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

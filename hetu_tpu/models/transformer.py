@@ -46,6 +46,7 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16   # compute dtype
     remat: bool = True
+    causal: bool = True         # False = bidirectional encoder (BERT)
     # attention implementation: "auto" picks ring when the mesh shards the
     # sequence (sp>1), the fused Pallas kernel on TPU for block-divisible
     # sequences, and the unfused dot-product form otherwise
@@ -156,8 +157,10 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
-def _resolve_attn_impl(cfg: TransformerConfig, mesh, T):
+def _resolve_attn_impl(cfg: TransformerConfig, mesh, T, attn_bias=None):
     impl = cfg.attn_impl
+    if attn_bias is not None:
+        return "dot"   # only the unfused path applies a padding-mask bias
     if impl != "auto":
         return impl
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
@@ -167,40 +170,46 @@ def _resolve_attn_impl(cfg: TransformerConfig, mesh, T):
     return "dot"
 
 
-def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl):
+def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
+                    attn_bias=None):
     """q/k/v: (B, nh, T, hd) -> (B, nh, T, hd). Three paths:
     - ring: sequence-parallel exact attention over the sp axis (shard_map +
       ppermute ring, hetu_tpu/parallel/ring_attention.py)
     - flash: fused Pallas online-softmax kernel (hetu_tpu/kernels)
     - dot: unfused reference form (the reference framework's
-      BatchMatMul+Softmax attention)"""
+      BatchMatMul+Softmax attention); the only path that applies an
+      additive ``attn_bias`` (B, 1, 1, T) padding mask"""
     hd = q.shape[-1]
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
         from jax import shard_map
         spec = P("dp", "tp", "sp", None)
         fn = shard_map(
-            functools.partial(ring_attention, axis_name="sp", causal=True),
+            functools.partial(ring_attention, axis_name="sp",
+                              causal=cfg.causal),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
         return fn(q, k, v)
     if impl == "flash":
         from ..kernels.flash_attention import flash_attention
-        return flash_attention(q, k, v, True)
+        return flash_attention(q, k, v, cfg.causal)
     T = q.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)
-    qpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
-    scores = jnp.where(kpos <= qpos, scores, -1e30)
+    if cfg.causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        scores = jnp.where(kpos <= qpos, scores, -1e30)
+    if attn_bias is not None:
+        scores = scores + attn_bias.astype(jnp.float32)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _attention(h, p, cfg: TransformerConfig, mesh):
+def _attention(h, p, cfg: TransformerConfig, mesh, attn_bias=None):
     B, T, D = h.shape
     nh, hd = cfg.n_heads, cfg.head_dim
-    impl = _resolve_attn_impl(cfg, mesh, T)
+    impl = _resolve_attn_impl(cfg, mesh, T, attn_bias)
     qkv = jnp.einsum("btd,de->bte", h, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -215,7 +224,7 @@ def _attention(h, p, cfg: TransformerConfig, mesh):
             B, T, nh, hd).transpose(0, 2, 1, 3)
         v = _constrain(v, mesh, "dp", None, "tp").reshape(
             B, T, nh, hd).transpose(0, 2, 1, 3)
-    out = _attention_core(q, k, v, cfg, mesh, impl)
+    out = _attention_core(q, k, v, cfg, mesh, impl, attn_bias)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     return jnp.einsum("btd,de->bte", out, p["wo"].astype(h.dtype),
                       preferred_element_type=jnp.float32).astype(h.dtype)
@@ -267,10 +276,10 @@ def _moe_mlp(h, p, cfg: TransformerConfig, mesh):
     return out.reshape(B, T, D), aux
 
 
-def _block(h, layer_params, cfg: TransformerConfig, mesh):
+def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None):
     h = _constrain(h, mesh, "dp", "sp", None)
     attn_in = _layer_norm(h, layer_params["ln1_scale"], layer_params["ln1_bias"])
-    h = h + _attention(attn_in, layer_params, cfg, mesh)
+    h = h + _attention(attn_in, layer_params, cfg, mesh, attn_bias)
     h = _constrain(h, mesh, "dp", "sp", None)
     mlp_in = _layer_norm(h, layer_params["ln2_scale"], layer_params["ln2_bias"])
     if cfg.n_experts > 0:
@@ -299,22 +308,31 @@ def nll_loss(logits, targets):
     return jnp.mean(-jnp.take_along_axis(logp, targets[..., None], -1)[..., 0])
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
-    """tokens (B, T) int32 -> logits (B, T, V)."""
-    h = embed_tokens(params, tokens, cfg)
-    h = _constrain(h, mesh, "dp", "sp", None)
-
+def encode(params, h, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+           attn_bias=None):
+    """Run the block stack on embedded input h (B, T, D) -> (h, aux_sum).
+    The trunk shared by the causal LM and the bidirectional encoder (BERT);
+    ``attn_bias`` (a padding mask, constant across layers) is a scan
+    constant via closure."""
     block_fn = functools.partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
         block_fn = jax.checkpoint(block_fn)
 
     def scan_body(carry, layer_params):
         h, aux_sum = carry
-        h, aux = block_fn(h, layer_params)
+        h, aux = block_fn(h, layer_params, attn_bias=attn_bias)
         return (h, aux_sum + aux), None
 
-    (h, aux_sum), _ = jax.lax.scan(scan_body, (h, jnp.zeros((), jnp.float32)),
-                                   params["blocks"])
+    (h, aux_sum), _ = jax.lax.scan(
+        scan_body, (h, jnp.zeros((), jnp.float32)), params["blocks"])
+    return h, aux_sum
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """tokens (B, T) int32 -> logits (B, T, V)."""
+    h = embed_tokens(params, tokens, cfg)
+    h = _constrain(h, mesh, "dp", "sp", None)
+    h, aux_sum = encode(params, h, cfg, mesh)
     return lm_head(params, h), aux_sum
 
 

@@ -71,6 +71,12 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes is None and not auto_detect:
+        if local_device_count is not None:
+            raise ValueError(
+                "local_device_count was given but no coordinator/world was "
+                "specified (args, JAX_* env, or auto_detect) — for a "
+                "single-process virtual mesh use hetu_tpu.utils."
+                "ensure_devices instead")
         return False
 
     if local_device_count is not None:
