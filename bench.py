@@ -18,7 +18,6 @@ baseline = our round-1 f32 measurement (4929.1 samples/s on v5e-1).
 """
 import contextlib
 import json
-import multiprocessing
 import os
 import sys
 import time
@@ -165,55 +164,11 @@ def bench_transformer(warmup=3, iters=20):
 # comm_mode='Hybrid' (dense grads on-device, embedding rows through the PS).
 # ---------------------------------------------------------------------------
 
-_PS_PORT = int(os.environ.get("HETU_BENCH_PS_PORT", "13900"))
-
-
-def _ps_env(port):
-    return {
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
-        "DMLC_PS_ROOT_PORT": str(port),
-        "DMLC_NUM_WORKER": "1",
-        "DMLC_NUM_SERVER": "2",
-    }
-
-
-def _sched_proc(port):
-    os.environ.update(_ps_env(port))
-    os.environ["DMLC_ROLE"] = "scheduler"
-    from hetu_tpu.ps import server as srv
-    srv.start_scheduler_from_env()
-    srv.scheduler_wait()
-    srv.stop_scheduler()
-
-
-def _server_proc(port, idx):
-    os.environ.update(_ps_env(port))
-    os.environ.update({"DMLC_ROLE": "server", "SERVER_ID": str(idx),
-                       "DMLC_PS_SERVER_URI": "127.0.0.1",
-                       "DMLC_PS_SERVER_PORT": str(port + 1 + idx)})
-    import signal
-    import threading
-    from hetu_tpu.ps import server as srv
-    srv.start_server_from_env()
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    stop.wait()
-    srv.stop_server()
-
-
 def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
     """Returns {prefetch_on: (sps, ms, perf), prefetch_off: (sps, ms)} — the
     overlap A/B the reference's prefetch x ASP matrix is about."""
-    port = _PS_PORT
-    ctx = multiprocessing.get_context("spawn")
-    procs = [ctx.Process(target=_sched_proc, args=(port,))]
-    procs += [ctx.Process(target=_server_proc, args=(port, i))
-              for i in range(2)]
-    for p in procs:
-        p.start()
-    os.environ.update(_ps_env(port))
-    os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
-    try:
+    from hetu_tpu.ps.local_cluster import local_cluster
+    with local_cluster(n_servers=2, n_workers=1):
         import hetu_tpu as ht
         models = _import_models("ctr")
         from models.load_data import load_criteo_data
@@ -254,11 +209,6 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
             ex.close()
         os.environ.pop("HETU_PS_ID_BASE", None)
         return out
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            p.join(timeout=10)
 
 
 def main():

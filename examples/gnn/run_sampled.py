@@ -1,0 +1,256 @@
+"""Sampled-subgraph GCN over a live parameter server + embedding cache —
+the reference's GraphMix-style GNN training mode
+(``examples/gnn/run_dist.py:17-49``: workers train on sampled subgraphs,
+node embeddings pulled through the PS with the cache in front), rebuilt
+TPU-native:
+
+- the graph lives host-side; each step a worker samples a FIXED-size 1-hop
+  subgraph (static shapes -> ONE jitted program, no retrace per batch),
+- trainable node embeddings are a sparse table on the PS fronted by
+  ``CacheSparseTable`` (LRU/LFU/LFUOpt, bounded staleness): lookups pull
+  only the sampled rows, row gradients push back through the cache,
+- the sampler feeds the executor through ``GNNDataLoaderOp`` double
+  buffering (reference dataloader.py:98): batch N+1's cache pull is issued
+  while step N trains,
+- dense GCN weights train on-device with Adam; embedding rows arrive as a
+  placeholder and leave as an explicit gradient target (`ht.gradients`).
+
+Standalone (self-provisions a local scheduler + server):
+  python examples/gnn/run_sampled.py --num-epoch 10 --cpu
+Inside a heturun cluster (DMLC_* env set): the same command, one process
+per worker.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+# ---------------------------------------------------------------------------
+# synthetic partitioned graph (no-egress stand-in for Reddit/OGB: a planted
+# 4-community SBM whose labels are recoverable from graph structure)
+# ---------------------------------------------------------------------------
+
+def make_graph(n_nodes, n_classes, avg_degree, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, n_classes, n_nodes)
+    p_in = avg_degree / (n_nodes / n_classes) * 0.8
+    p_out = avg_degree / n_nodes * 0.2
+    adj = [[] for _ in range(n_nodes)]
+    for u in range(n_nodes):
+        same = np.where(labels == labels[u])[0]
+        diff = np.where(labels != labels[u])[0]
+        nbr = np.concatenate([
+            same[rng.rand(len(same)) < p_in],
+            diff[rng.rand(len(diff)) < p_out]])
+        for v in nbr:
+            if v != u:
+                adj[u].append(int(v))
+                adj[int(v)].append(u)
+    return [np.unique(a) for a in adj], labels
+
+
+class SubgraphSampler:
+    """Fixed-shape 1-hop sampler: NSEED seed nodes + neighbors, capped at
+    NMAX total, zero-padded. Padding is inert: padded adjacency rows/cols
+    are all-zero (no self-loop), so padded embedding rows get exactly zero
+    gradient and their (deduped) pushes are no-ops."""
+
+    def __init__(self, adj, labels, nseed, nmax, fanout, seed=0):
+        self.adj, self.labels = adj, labels
+        self.nseed, self.nmax, self.fanout = nseed, nmax, fanout
+        self.rng = np.random.RandomState(seed)
+        self.order = self.rng.permutation(len(adj))
+        self.cursor = 0
+
+    def next(self):
+        n = len(self.adj)
+        if self.cursor + self.nseed > n:
+            self.order = self.rng.permutation(n)
+            self.cursor = 0
+        seeds = self.order[self.cursor:self.cursor + self.nseed]
+        self.cursor += self.nseed
+        nodes = list(seeds)
+        seen = set(seeds.tolist())
+        for s in seeds:
+            nb = self.adj[s]
+            if len(nb) > self.fanout:
+                nb = self.rng.choice(nb, self.fanout, replace=False)
+            for v in nb:
+                if v not in seen and len(nodes) < self.nmax:
+                    seen.add(int(v))
+                    nodes.append(int(v))
+        ids = np.zeros(self.nmax, np.uint64)
+        ids[:len(nodes)] = nodes
+        pos = {v: i for i, v in enumerate(nodes)}
+        a = np.zeros((self.nmax, self.nmax), np.float32)
+        a[:len(nodes), :len(nodes)] = np.eye(len(nodes))  # self-loops
+        for i, u in enumerate(nodes):
+            for v in self.adj[u]:
+                j = pos.get(int(v))
+                if j is not None:
+                    a[i, j] = 1.0
+        deg = np.maximum(a.sum(1), 1.0)
+        dinv = 1.0 / np.sqrt(deg)
+        norm_adj = (a * dinv[:, None]) * dinv[None, :]    # D^-1/2 A D^-1/2
+        return {"adj": norm_adj, "ids": ids,
+                "y": self.labels[seeds].astype(np.float32)}
+
+
+class BatchFeed:
+    """Two-slot pipeline rotated in lockstep with ``GNNDataLoaderOp.step``:
+    the batch being BUILT becomes the op's _next (its cache pull issued
+    asynchronously now), the previous _next becomes the current batch."""
+
+    def __init__(self, sampler, table, hidden):
+        self.sampler, self.table, self.hidden = sampler, table, hidden
+        self.cur = None
+        self._next = None
+
+    def handler(self, _graph):
+        b = self.sampler.next()
+        b["rows"] = np.zeros((self.sampler.nmax, self.hidden), np.float32)
+        b["wait"] = self.table.embedding_lookup(b["ids"], b["rows"])
+        self.cur, self._next = self._next, b
+        return b["adj"]
+
+
+# ---------------------------------------------------------------------------
+# training worker
+# ---------------------------------------------------------------------------
+
+def train(client, rank, args):
+    import hetu_tpu as ht
+    from hetu_tpu.cstable import CacheSparseTable
+    from hetu_tpu.dataloader import GNNDataLoaderOp
+    from hetu_tpu.graph.gradients import gradients as ht_gradients
+
+    adj, labels = make_graph(args.nodes, args.classes, args.degree)
+    sampler = SubgraphSampler(adj, labels, args.nseed, args.nmax,
+                              args.fanout, seed=100 + rank)
+
+    client.InitTensor(args.table_id, sparse=2, length=args.nodes,
+                      width=args.hidden, init_type="normal", init_a=0.0,
+                      init_b=0.1)
+    table = CacheSparseTable(args.cache_limit, args.nodes, args.hidden,
+                             args.table_id, policy=args.cache_policy,
+                             bound=args.bound)
+    if args.cache_perf:
+        table.perf_enabled(True)
+    feed = BatchFeed(sampler, table, args.hidden)
+
+    adj_in = GNNDataLoaderOp(feed.handler)
+    x = ht.placeholder_op(name="x")
+    y_ = ht.placeholder_op(name="y")
+    w1 = ht.init.xavier_uniform((args.hidden, args.hidden), name="w1")
+    w2 = ht.init.xavier_uniform((args.hidden, args.classes), name="w2")
+    h = ht.relu_op(ht.matmul_op(adj_in, ht.matmul_op(x, w1)))
+    logits = ht.slice_op(ht.matmul_op(adj_in, ht.matmul_op(h, w2)),
+                         (0, 0), (args.nseed, args.classes))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(logits, ht.one_hot_op(y_, args.classes)),
+        [0])
+    (grad_x,) = ht_gradients(loss, [x])
+    opt = ht.optim.AdamOptimizer(learning_rate=args.learning_rate)
+    train_op = opt.minimize(loss, var_list=[w1, w2])
+    pred = ht.softmax_op(logits)
+
+    ex = ht.Executor({"train": [loss, grad_x, pred, train_op]},
+                     ctx=ht.cpu(0) if args.cpu else ht.tpu(0), seed=rank)
+
+    GNNDataLoaderOp.step(None)   # build batch 1 into _next
+    GNNDataLoaderOp.step(None)   # batch 1 -> current; batch 2 building
+    # per-epoch step count splits the graph across the LIVE cluster size
+    nworld = max(client.nrank, 1)
+    steps = max(1, args.nodes // (args.nseed * nworld))
+    history = []
+    try:
+        for epoch in range(args.num_epoch):
+            tot_loss = tot_acc = 0.0
+            t0 = time.time()
+            for _ in range(steps):
+                b = feed.cur
+                b["wait"].wait()          # this batch's rows have landed
+                lv, gx, pv, _ = ex.run("train",
+                                       feed_dict={x: b["rows"], y_: b["y"]})
+                table.embedding_update(
+                    b["ids"], -args.learning_rate * gx.asnumpy())
+                GNNDataLoaderOp.step(None)  # rotate; issue next cache pull
+                tot_loss += float(np.mean(lv.asnumpy()))
+                tot_acc += float(np.mean(np.argmax(pv.asnumpy(), 1)
+                                         == b["y"]))
+            history.append((tot_loss / steps, tot_acc / steps))
+            if rank == 0:
+                print(f"[rank {rank}] epoch {epoch}: "
+                      f"loss {history[-1][0]:.4f} acc {history[-1][1]:.3f} "
+                      f"({time.time() - t0:.2f}s)", flush=True)
+        if args.cache_perf and rank == 0:
+            print(f"cache miss rate: {table.overall_miss_rate():.3f}",
+                  flush=True)
+    finally:
+        # drain in-flight cache pulls BEFORE anyone calls Finalize — a pull
+        # mid-recv when the sockets close wedges the cache worker thread
+        for b in (feed.cur, feed._next):
+            if b is not None and "wait" in b:
+                b["wait"].wait()
+        adj_in.close()   # deregister: a later run's step() must not fire us
+        ex.close()
+    return history
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=512)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--degree", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--nseed", type=int, default=32)
+    ap.add_argument("--nmax", type=int, default=128)
+    ap.add_argument("--fanout", type=int, default=8)
+    ap.add_argument("--num-epoch", type=int, default=10)
+    ap.add_argument("--learning-rate", type=float, default=0.05)
+    ap.add_argument("--workers", type=int, default=1,
+                    help="standalone only: size of the self-provisioned "
+                         "cluster (under heturun the live nrank is used)")
+    ap.add_argument("--table-id", type=int, default=7)
+    ap.add_argument("--cache-limit", type=int, default=128)
+    ap.add_argument("--cache-policy", default="LRU",
+                    choices=["LRU", "LFU", "LFUOpt"])
+    ap.add_argument("--bound", type=int, default=2)
+    ap.add_argument("--cache-perf", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (tests / no-TPU hosts)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if "DMLC_ROLE" in os.environ:      # launched by heturun: just train
+        from hetu_tpu.ps.client import PSClient
+        client = PSClient.from_env()
+        try:
+            train(client, client.rank, args)
+        finally:
+            client.close()
+        return
+
+    from hetu_tpu.ps.local_cluster import local_cluster
+    with local_cluster(n_servers=1, n_workers=1):
+        from hetu_tpu.ps.client import PSClient
+        client = PSClient.from_env()
+        try:
+            train(client, 0, args)
+        finally:
+            client.close()
+
+
+if __name__ == "__main__":
+    main()

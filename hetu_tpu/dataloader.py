@@ -148,6 +148,13 @@ class GNNDataLoaderOp(Op):
         self._next = None
         GNNDataLoaderOp._ops.append(self)
 
+    def close(self):
+        """Deregister from the class-level step() registry — REQUIRED when a
+        training run ends but the process lives on, or a later run's
+        step() would fire this op's stale handler too."""
+        if self in GNNDataLoaderOp._ops:
+            GNNDataLoaderOp._ops.remove(self)
+
     def get_batch_num(self, name):
         return None
 

@@ -1,0 +1,81 @@
+"""Self-provisioned local PS cluster (scheduler + N servers as spawned
+processes, the calling process becomes worker 0).
+
+One shared implementation of the bootstrap that bench.py and the examples
+need when run standalone — outside a ``heturun`` launch (reference: the
+``tests/*.sh`` scripts' local mpirun clusters). The test suite's
+``tests/test_ps.run_cluster`` stays separate: it additionally runs worker
+BODIES in subprocesses and collects per-worker results, which this helper
+deliberately does not (the caller IS the worker).
+"""
+from __future__ import annotations
+
+import contextlib
+import multiprocessing
+import os
+import socket
+import tempfile
+import time
+
+
+def _ps_env(port: int, n_workers: int, n_servers: int) -> dict:
+    return {"DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(n_workers),
+            "DMLC_NUM_SERVER": str(n_servers)}
+
+
+def _sched_proc(port, n_workers, n_servers):
+    os.environ.update(_ps_env(port, n_workers, n_servers))
+    os.environ["DMLC_ROLE"] = "scheduler"
+    from . import server as srv
+    srv.start_scheduler_from_env()
+    srv.scheduler_wait()
+    srv.stop_scheduler()
+
+
+def _server_proc(port, n_workers, n_servers, idx, stopfile):
+    os.environ.update(_ps_env(port, n_workers, n_servers))
+    os.environ.update({"DMLC_ROLE": "server", "SERVER_ID": str(idx),
+                       "DMLC_PS_SERVER_URI": "127.0.0.1",
+                       # port 0: bind an OS-assigned port, registered with
+                       # the scheduler (race-free, commit 5eca2ab)
+                       "DMLC_PS_SERVER_PORT": "0"})
+    from . import server as srv
+    srv.start_server_from_env()
+    while not os.path.exists(stopfile):
+        time.sleep(0.05)
+    srv.stop_server()
+
+
+@contextlib.contextmanager
+def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
+    """Spawn scheduler + servers, set THIS process up as worker 0, yield.
+    On exit, signal the servers to stop and reap every process."""
+    if port is None:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    stopfile = tempfile.mktemp(prefix="hetu_ps_stop_")
+    ctx = multiprocessing.get_context("spawn")
+    procs = [ctx.Process(target=_sched_proc,
+                         args=(port, n_workers, n_servers))]
+    procs += [ctx.Process(target=_server_proc,
+                          args=(port, n_workers, n_servers, i, stopfile))
+              for i in range(n_servers)]
+    for p in procs:
+        p.start()
+    os.environ.update(_ps_env(port, n_workers, n_servers))
+    os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
+    try:
+        yield port
+    finally:
+        with open(stopfile, "w") as f:
+            f.write("stop")
+        for p in procs:
+            p.join(timeout=15)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        if os.path.exists(stopfile):
+            os.unlink(stopfile)
