@@ -54,6 +54,14 @@ def main():
         losses.append(float(loss))
 
     mh.barrier("final")
+    # distributed checkpoint: every process writes only its own shards of a
+    # dp-sharded array; the single-process test restores and checks it
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    if ckpt_dir:
+        from hetu_tpu import checkpoint
+        xsh = mh.host_local_batch(
+            mesh, P("dp"), np.full((4, 2), pid + 1.0, np.float32))
+        checkpoint.save(ckpt_dir, {"W": W, "xsh": xsh})
     # cross-host host-value allgather parity check
     pids = mh.process_allgather(np.array([pid], np.int32))
     seed = int(mh.broadcast_from_chief(np.array([1234 + pid], np.int32))[0])

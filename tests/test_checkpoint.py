@@ -1,0 +1,84 @@
+"""Distributed (sharded) checkpoint/resume for the flagship path:
+save on one mesh, restore on ANOTHER mesh with different specs (resharding
+on load), step-numbered retention, and exact training-resume equivalence.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu import checkpoint
+from hetu_tpu.models import transformer as tfm
+from hetu_tpu.parallel.mesh import auto_mesh
+
+CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=16,
+                            dtype=jnp.float32, remat=False)
+
+
+def test_save_restore_across_meshes(tmp_path):
+    """Params saved dp-sharded restore correctly tp-sharded (new mesh)."""
+    mesh_a = auto_mesh(8)            # all dp
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    params = tfm.shard_params(params, CFG, mesh_a)
+    checkpoint.save(tmp_path / "ck", params)
+
+    mesh_b = auto_mesh(8, tp=2)      # dp4 x tp2 — different layout
+    specs = tfm.param_specs(CFG)
+    restored = checkpoint.restore(tmp_path / "ck", like=params,
+                                  mesh=mesh_b, specs=specs)
+    # values identical, shardings re-applied on the new mesh
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    wqkv = restored["blocks"]["wqkv"]
+    assert wqkv.sharding.mesh.shape["tp"] == 2
+    assert wqkv.sharding.spec == P(None, None, "tp")
+
+
+def test_raw_restore_without_target(tmp_path):
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+             "step": np.int32(7)}
+    checkpoint.save(tmp_path / "raw", state)
+    out = checkpoint.restore(tmp_path / "raw")
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert int(out["step"]) == 7
+
+
+def test_train_resume_is_exact(tmp_path):
+    """Train 4 steps, checkpoint, train 4 more; vs restore-at-4 + 4 more:
+    identical final loss/params — the resume path loses nothing."""
+    mesh = auto_mesh(8, tp=2)
+    step_fn = tfm.make_train_step(CFG, mesh=mesh, lr=1e-2)
+    rng = np.random.RandomState(0)
+    toks = [jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+            for _ in range(8)]
+
+    params = tfm.shard_params(tfm.init_params(jax.random.PRNGKey(1), CFG),
+                              CFG, mesh)
+    opt = tfm.init_opt_state(params)
+    with checkpoint.TrainCheckpointer(tmp_path / "mgr", keep=2) as ck:
+        for i in range(4):
+            loss, params, opt = step_fn(params, opt, toks[i],
+                                        jnp.roll(toks[i], -1, 1))
+            ck.save_step(i, {"params": params, "opt": opt})
+        assert ck.latest_step() == 3
+        for i in range(4, 8):
+            loss, params, opt = step_fn(params, opt, toks[i],
+                                        jnp.roll(toks[i], -1, 1))
+        straight_loss = float(loss)
+
+    specs = tfm.param_specs(CFG)
+    opt_specs = {"m": specs, "v": specs, "t": P()}
+    with checkpoint.TrainCheckpointer(tmp_path / "mgr", keep=2) as ck:
+        like = {"params": params, "opt": opt}
+        state, step = ck.restore_latest(
+            like=like, mesh=mesh, specs={"params": specs, "opt": opt_specs})
+        assert step == 3
+        params2, opt2 = state["params"], state["opt"]
+        for i in range(4, 8):
+            loss2, params2, opt2 = step_fn(params2, opt2, toks[i],
+                                           jnp.roll(toks[i], -1, 1))
+    assert float(loss2) == pytest.approx(straight_loss, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

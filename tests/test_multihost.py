@@ -18,15 +18,16 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_world(nproc=2, timeout=180):
+def _run_world(nproc=2, timeout=180, ckpt_dir=None):
     from hetu_tpu.runner import _get_available_port
     port = _get_available_port("127.0.0.1")
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # worker configures its own platform
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    extra = [str(ckpt_dir)] if ckpt_dir else []
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
-         str(pid), str(nproc), str(port)],
+         str(pid), str(nproc), str(port)] + extra,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
         for pid in range(nproc)]
     # collect every worker's output even when one crashes or hangs — the
@@ -56,8 +57,9 @@ def _run_world(nproc=2, timeout=180):
     return results
 
 
-def test_two_process_dp_training_matches_full_batch_oracle():
-    results = _run_world()
+def test_two_process_dp_training_matches_full_batch_oracle(tmp_path):
+    ckpt = tmp_path / "mh_ckpt"
+    results = _run_world(ckpt_dir=ckpt)
     r0 = next(r for r in results if r["pid"] == 0)
     r1 = next(r for r in results if r["pid"] == 1)
 
@@ -87,3 +89,15 @@ def test_two_process_dp_training_matches_full_batch_oracle():
     # won (value is chief's 1234, not 1235)
     assert sorted(r0["gathered_pids"]) == [0, 1]
     assert r0["chief_seed"] == 1234 and r1["chief_seed"] == 1234
+
+    # the distributed checkpoint the two processes wrote (each only its own
+    # shards) restores whole in THIS single process, values intact
+    from hetu_tpu import checkpoint
+    state = checkpoint.restore(str(ckpt))
+    assert float(np.sum(state["W"])) == pytest.approx(r0["w_sum"], rel=1e-5)
+    # exact shard layout: pid 0's rows land at [0:4], pid 1's at [4:8]
+    assert state["xsh"].shape == (8, 2)
+    np.testing.assert_array_equal(state["xsh"][:4],
+                                  np.full((4, 2), 1.0, np.float32))
+    np.testing.assert_array_equal(state["xsh"][4:],
+                                  np.full((4, 2), 2.0, np.float32))
