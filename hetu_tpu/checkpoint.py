@@ -39,13 +39,17 @@ def _abstract_like(tree, mesh, specs):
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def save(path: str, state: Any) -> None:
+def save(path: str, state: Any, force: bool = False) -> None:
     """Write ``state`` (any pytree of arrays) to ``path``. Under a
     multi-process world every process participates and writes only the
-    shards it owns; the call blocks until the checkpoint is durable."""
+    shards it owns; the call blocks until the checkpoint is durable.
+    ``force=True`` overwrites an existing checkpoint at ``path`` (fixed
+    latest-checkpoint patterns); the default refuses, like the PS
+    ``ParamSave`` tmp+rename discipline, so a crash mid-save can never
+    destroy the previous good checkpoint by accident."""
     path = os.path.abspath(os.fspath(path))
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state)
+        ckptr.save(path, state, force=force)
         ckptr.wait_until_finished()
 
 
@@ -98,7 +102,12 @@ class TrainCheckpointer:
         if step is None:
             return None, None
         if like is None:
-            return self._mgr.restore(step), step
+            # raw numpy restore (inspection / different-topology recovery),
+            # same semantics as module-level restore(path)
+            d = os.path.join(str(self._mgr.directory), str(step), "default")
+            if not os.path.isdir(d):
+                d = os.path.join(str(self._mgr.directory), str(step))
+            return restore(d), step
         target = _abstract_like(like, mesh, specs)
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(target)), step

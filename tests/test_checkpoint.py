@@ -40,9 +40,24 @@ def test_raw_restore_without_target(tmp_path):
     state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
              "step": np.int32(7)}
     checkpoint.save(tmp_path / "raw", state)
+    # refuses to clobber by default; force=True overwrites in place
+    with pytest.raises(ValueError):
+        checkpoint.save(tmp_path / "raw", state)
+    checkpoint.save(tmp_path / "raw", {"w": state["w"] * 2,
+                                       "step": np.int32(8)}, force=True)
     out = checkpoint.restore(tmp_path / "raw")
-    np.testing.assert_array_equal(out["w"], state["w"])
-    assert int(out["step"]) == 7
+    np.testing.assert_array_equal(out["w"], state["w"] * 2)
+    assert int(out["step"]) == 8
+
+
+def test_manager_raw_restore_without_target(tmp_path):
+    with checkpoint.TrainCheckpointer(tmp_path / "m", keep=2) as ck:
+        ck.save_step(5, {"w": np.ones((2, 2), np.float32) * 3})
+    with checkpoint.TrainCheckpointer(tmp_path / "m", keep=2) as ck:
+        out, step = ck.restore_latest()
+        assert step == 5
+        np.testing.assert_array_equal(out["w"],
+                                      np.ones((2, 2), np.float32) * 3)
 
 
 def test_train_resume_is_exact(tmp_path):
