@@ -1,0 +1,137 @@
+"""BERT pretraining trainer — the full pipeline end to end (reference
+``examples/nlp``: tokenizer + ``processBertData`` masking + trainer; the
+reference stops at a causal transformer example, this completes the BERT
+pretrain path BASELINE.md names as a north star):
+
+  corpus sentences -> WordPiece tokenizer (hetu_tpu.tokenizers)
+    -> sentence-pair MLM/NSP instances (processBertData)
+    -> fused pretrain step on hetu_tpu.models.bert (flash attention on TPU)
+    -> step-numbered orbax checkpoints with exact resume.
+
+No egress: trains over a built-in corpus with a corpus-derived vocab.
+
+  python examples/nlp/train_hetu_bert.py --num-epoch 20 --cpu
+  python examples/nlp/train_hetu_bert.py --resume   # continue from latest
+"""
+import argparse
+import collections
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+SAMPLE_SENTENCES = [
+    "the quick brown fox jumps over the lazy dog",
+    "the dog barks at the fox near the old oak tree",
+    "the fox runs into the deep dark woods",
+    "in the woods the fox meets another clever fox",
+    "the two foxes play among the tall trees until sunset",
+    "the tired dog finds the foxes at the edge of the woods",
+    "the quick fox jumps over the sleeping dog once more",
+    "every day the dog chases the fox across the green field",
+    "every evening the fox escapes into the quiet woods",
+    "the lazy dog never learns and the quick fox never tires",
+    "a young fox watches the game from a hollow log",
+    "the old tree stands at the center of the dark woods",
+] * 4
+
+
+def build_vocab(sentences):
+    counts = collections.Counter(w for s in sentences for w in s.split())
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "[MASK]": 4}
+    for word, _ in counts.most_common():
+        vocab.setdefault(word, len(vocab))
+    return vocab
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--d-ff", type=int, default=128)
+    ap.add_argument("--max-seq-length", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epoch", type=int, default=20)
+    ap.add_argument("--learning-rate", type=float, default=3e-3)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="save a step-numbered checkpoint every N epochs")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from hetu_tpu.models import bert
+    from hetu_tpu.tokenizers import BertTokenizer
+    import processBertData as pbd
+
+    vocab = build_vocab(SAMPLE_SENTENCES)
+    tok = BertTokenizer(vocab)
+    instances = pbd.create_instances_from_document(
+        SAMPLE_SENTENCES, tok, max_seq_length=args.max_seq_length,
+        max_predictions_per_seq=5)
+    full = bert.batch_from_instances(instances)
+    n = len(full["input_ids"])
+    print(f"vocab {len(vocab)}, {n} pretrain instances", flush=True)
+
+    cfg = bert.BertConfig(
+        vocab_size=len(vocab), d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq_len=args.max_seq_length,
+        dtype=jnp.float32 if args.cpu else jnp.bfloat16, remat=False)
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    opt = bert.init_opt_state(params)
+    step_fn = bert.make_pretrain_step(cfg, lr=args.learning_rate)
+
+    ck = None
+    start_epoch = 0
+    if args.ckpt_dir:
+        from hetu_tpu import checkpoint
+        ck = checkpoint.TrainCheckpointer(args.ckpt_dir, keep=3)
+        if args.resume and ck.latest_step() is not None:
+            state, start_epoch = ck.restore_latest(
+                like={"params": params, "opt": opt})
+            params = jax.tree.map(jnp.asarray, state["params"])
+            opt = jax.tree.map(jnp.asarray, state["opt"])
+            start_epoch += 1
+            print(f"resumed from epoch {start_epoch - 1}", flush=True)
+
+    rng = np.random.RandomState(0)
+    steps = max(1, n // args.batch_size)
+    for epoch in range(start_epoch, args.num_epoch):
+        order = rng.permutation(n)
+        tot = tot_mlm = tot_nsp = 0.0
+        t0 = time.time()
+        for s in range(steps):
+            idx = order[s * args.batch_size:(s + 1) * args.batch_size]
+            batch = {k: v[idx] for k, v in full.items()}
+            loss, (mlm, nsp), params, opt = step_fn(params, opt, batch)
+            tot += float(loss)
+            tot_mlm += float(mlm)
+            tot_nsp += float(nsp)
+        print(f"epoch {epoch}: loss {tot/steps:.4f} "
+              f"(mlm {tot_mlm/steps:.4f} nsp {tot_nsp/steps:.4f}) "
+              f"{time.time()-t0:.2f}s", flush=True)
+        if ck is not None and args.ckpt_every and \
+                (epoch + 1) % args.ckpt_every == 0:
+            ck.save_step(epoch, {"params": params, "opt": opt})
+    if ck is not None:
+        ck.save_step(args.num_epoch - 1, {"params": params, "opt": opt})
+        ck.close()
+    return tot / steps
+
+
+if __name__ == "__main__":
+    main()
