@@ -218,13 +218,15 @@ def main():
               "assumed_peak_tflops": PEAK_TFLOPS}
 
     headline = 0.0
-    for bs in (128, 256):
-        for dtype, tag in ((None, "f32"), ("bfloat16", "bf16")):
-            sps, ms, mfu = bench_resnet18(batch_size=bs, dtype=dtype)
-            detail[f"resnet18_{tag}_bs{bs}"] = {
-                "samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
-                "mfu": round(mfu, 4) if mfu else None}
-            headline = max(headline, sps)
+    grid = [(128, None, "f32"), (128, "bfloat16", "bf16"),
+            (256, None, "f32"), (256, "bfloat16", "bf16"),
+            (512, "bfloat16", "bf16")]
+    for bs, dtype, tag in grid:
+        sps, ms, mfu = bench_resnet18(batch_size=bs, dtype=dtype)
+        detail[f"resnet18_{tag}_bs{bs}"] = {
+            "samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
+            "mfu": round(mfu, 4) if mfu else None}
+        headline = max(headline, sps)
 
     skip_extras = "--fast" in sys.argv
     if not skip_extras:

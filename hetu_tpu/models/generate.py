@@ -1,0 +1,140 @@
+"""Autoregressive generation with a KV cache — TPU-idiomatic decode.
+
+The reference framework stops at training + an inference subexecutor that
+re-runs the full forward; it has no incremental decoding. For an LM
+framework that is half the user surface, so this module adds it the TPU
+way: the whole generate loop is ONE ``lax.scan`` over time steps (static
+shapes, no retrace, no host round-trips), each step updating a
+(L, B, nh, max_len, hd) key/value cache via ``dynamic_update_slice`` and
+scanning the layer stack exactly like training does
+(``models/transformer.py`` keeps per-layer params stacked on a leading L
+axis).
+
+Prompt handling is teacher-forced inside the same scan: while t < len(p),
+the next input token comes from the prompt, afterwards from greedy argmax
+or temperature sampling — so prefill and decode share one compiled program.
+
+Single-program decode (mesh=None) and dense MLP blocks only (the switch
+MoE flagship path is a training configuration; decode asserts
+``n_experts == 0``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import transformer as tfm
+
+
+def _decode_layer(carry, layer_inputs, *, cfg, pos):
+    """One transformer block for ONE new token against the cache.
+
+    carry: h (B, 1, D); layer_inputs: (layer_params, k_cache, v_cache) with
+    caches (B, nh, M, hd). Returns updated caches alongside the new h.
+    """
+    h = carry
+    p, kc, vc = layer_inputs
+    B, _, D = h.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    M = kc.shape[2]
+
+    attn_in = tfm._layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+    qkv = jnp.einsum("bod,de->boe", attn_in, p["wqkv"].astype(h.dtype),
+                     preferred_element_type=jnp.float32).astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, 1, hd)
+    k = k.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
+    kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                        preferred_element_type=jnp.float32) / np.sqrt(hd)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, M), 3)
+    scores = jnp.where(kpos <= pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vc,
+                     preferred_element_type=jnp.float32).astype(h.dtype)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
+    h = h + jnp.einsum("bod,de->boe", ctx, p["wo"].astype(h.dtype),
+                       preferred_element_type=jnp.float32).astype(h.dtype)
+
+    mlp_in = tfm._layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+    h = h + tfm._dense_mlp(mlp_in, p, cfg, None)
+    return h, (kc, vc)
+
+
+def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
+    """tok (B,) int32 at position pos -> (logits (B, V), new caches)."""
+    h = (params["embed"][tok] +
+         jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
+                                      keepdims=False)).astype(cfg.dtype)
+    h = h[:, None, :]
+    h, (kcache, vcache) = jax.lax.scan(
+        functools.partial(_decode_layer, cfg=cfg, pos=pos), h,
+        (params["blocks"], kcache, vcache))
+    logits = tfm.lm_head(params, h)[:, 0]
+    return logits, kcache, vcache
+
+
+@functools.lru_cache(maxsize=32)
+def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
+                     temperature: float = 0.0):
+    """Returns jitted ``(params, prompt (B, P) int32, rng_key) ->
+    tokens (B, max_len)`` where tokens[:, :P] echoes the prompt and the
+    rest is generated. ``temperature == 0``: greedy argmax."""
+    assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
+    assert cfg.causal, "decode is autoregressive — causal configs only"
+    assert max_len <= cfg.max_seq_len
+
+    def gen(params, prompt, key):
+        B, P = prompt.shape
+        assert P <= max_len, f"prompt length {P} > max_len {max_len}"
+        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        vcache = jnp.zeros_like(kcache)
+        padded = jnp.zeros((B, max_len), jnp.int32)
+        padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
+
+        def step(carry, t):
+            tok_seq, kcache, vcache, key = carry
+            tok = jax.lax.dynamic_index_in_dim(tok_seq, t, 1, keepdims=False)
+            logits, kcache, vcache = _one_token_logits(
+                params, cfg, tok, kcache, vcache, t)
+            key, sub = jax.random.split(key)
+            if temperature > 0.0:
+                nxt = jax.random.categorical(sub, logits / temperature, -1)
+            else:
+                nxt = jnp.argmax(logits, -1)
+            nxt = nxt.astype(jnp.int32)
+            # teacher-force while the NEXT position is still in the prompt,
+            # and never write past the end (the final step's sample has no
+            # slot — its logits are still returned)
+            idx = jnp.minimum(t + 1, max_len - 1)
+            cur_next = jax.lax.dynamic_index_in_dim(tok_seq, idx, 1,
+                                                    keepdims=False)
+            nxt = jnp.where((t + 1) < P, cur_next, nxt)
+            nxt = jnp.where((t + 1) < max_len, nxt, cur_next)
+            tok_seq = jax.lax.dynamic_update_slice(
+                tok_seq, nxt[:, None], (0, idx))
+            return (tok_seq, kcache, vcache, key), logits
+
+        (tok_seq, _, _, _), logits_seq = jax.lax.scan(
+            step, (padded, kcache, vcache, key), jnp.arange(max_len))
+        return tok_seq, jnp.swapaxes(logits_seq, 0, 1)  # (B, M, V)
+
+    return jax.jit(gen)
+
+
+def generate(params, cfg: tfm.TransformerConfig, prompt, max_len: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None):
+    """Convenience one-shot wrapper around ``make_generate_fn``."""
+    fn = make_generate_fn(cfg, max_len, temperature)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    toks, _ = fn(params, jnp.asarray(prompt, jnp.int32), rng)
+    return np.asarray(toks)
