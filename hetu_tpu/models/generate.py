@@ -83,15 +83,18 @@ def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
 
 @functools.lru_cache(maxsize=32)
 def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
-                     temperature: float = 0.0):
-    """Returns jitted ``(params, prompt (B, P) int32, rng_key) ->
-    tokens (B, max_len)`` where tokens[:, :P] echoes the prompt and the
-    rest is generated. ``temperature == 0``: greedy argmax."""
+                     sample: bool = False):
+    """Returns a jitted ``(params, prompt (B, P) int32, rng_key,
+    temperature=1.0) -> (tokens (B, max_len), logits (B, max_len, V))``
+    where tokens[:, :P] echoes the prompt and the rest is generated.
+    ``sample=False``: greedy argmax (rng/temperature unused);
+    ``sample=True``: temperature sampling — temperature is a DYNAMIC
+    operand, so sweeping it never recompiles."""
     assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
     assert cfg.causal, "decode is autoregressive — causal configs only"
     assert max_len <= cfg.max_seq_len
 
-    def gen(params, prompt, key):
+    def gen(params, prompt, key, temperature=1.0):
         B, P = prompt.shape
         assert P <= max_len, f"prompt length {P} > max_len {max_len}"
         L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
@@ -106,7 +109,7 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
             logits, kcache, vcache = _one_token_logits(
                 params, cfg, tok, kcache, vcache, t)
             key, sub = jax.random.split(key)
-            if temperature > 0.0:
+            if sample:
                 nxt = jax.random.categorical(sub, logits / temperature, -1)
             else:
                 nxt = jnp.argmax(logits, -1)
@@ -127,14 +130,15 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
             step, (padded, kcache, vcache, key), jnp.arange(max_len))
         return tok_seq, jnp.swapaxes(logits_seq, 0, 1)  # (B, M, V)
 
-    return jax.jit(gen)
+    return jax.jit(gen, static_argnames=())
 
 
 def generate(params, cfg: tfm.TransformerConfig, prompt, max_len: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None):
-    """Convenience one-shot wrapper around ``make_generate_fn``."""
-    fn = make_generate_fn(cfg, max_len, temperature)
+    """Convenience one-shot wrapper: ``temperature == 0`` -> greedy."""
+    fn = make_generate_fn(cfg, max_len, sample=temperature > 0.0)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    toks, _ = fn(params, jnp.asarray(prompt, jnp.int32), rng)
+    toks, _ = fn(params, jnp.asarray(prompt, jnp.int32), rng,
+                 max(temperature, 1e-6))
     return np.asarray(toks)

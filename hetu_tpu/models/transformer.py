@@ -367,13 +367,39 @@ def adamw_update(params, grads, opt_state, lr=1e-3, b1=0.9, b2=0.999,
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    lr=1e-3):
+                    lr=1e-3, accum_steps: int = 1):
     """Returns jitted (params, opt_state, tokens, targets) ->
-    (loss, params, opt_state) with GSPMD dp/tp/sp/ep sharding."""
+    (loss, params, opt_state) with GSPMD dp/tp/sp/ep sharding.
+
+    ``accum_steps > 1``: gradient accumulation — tokens/targets gain a
+    leading accumulation axis (A, B, T); microbatch grads are averaged by a
+    ``lax.scan`` (one compiled block, sequential activation memory) before
+    the single optimizer apply, numerically identical to one big batch of
+    A*B under mean-loss."""
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
-                                                  cfg, mesh)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      targets, cfg, mesh)
+        else:
+            assert tokens.shape[0] == accum_steps, (
+                f"leading (accumulation) axis {tokens.shape[0]} != "
+                f"accum_steps {accum_steps}")
+
+            def micro(carry, xs):
+                loss_sum, gsum = carry
+                tok, tgt = xs
+                l, g = jax.value_and_grad(loss_fn)(params, tok, tgt, cfg,
+                                                   mesh)
+                return (loss_sum + l,
+                        jax.tree.map(jnp.add, gsum, g)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss_sum, gsum), _ = jax.lax.scan(
+                micro, (jnp.zeros((), jnp.float32), zeros),
+                (tokens, targets))
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
         new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
         return loss, new_params, new_opt
 
@@ -385,7 +411,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                           is_leaf=lambda x: isinstance(x, P))
     opt_shard = {"m": pshard, "v": pshard,
                  "t": NamedSharding(mesh, P())}
-    data_shard = NamedSharding(mesh, P(("dp",), None))
+    data_shard = NamedSharding(mesh, P(("dp",), None) if accum_steps == 1
+                               else P(None, ("dp",), None))
     return jax.jit(
         step,
         in_shardings=(pshard, opt_shard, data_shard, data_shard),

@@ -127,3 +127,34 @@ def test_pipeline_with_moe_and_remat():
                                  jnp.asarray(targets))
         losses.append(float(l))
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_grad_accumulation_matches_big_batch():
+    """accum_steps=4 over (4, 2, T) microbatches == one batch of 8 — the
+    scan-accumulated grads and the big-batch grads drive identical updates
+    (mean loss is linear in the batch)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=8,
+                                dtype=jnp.float32, remat=False)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 64, (8, 8)), jnp.int32)
+    tgt = jnp.roll(tok, -1, 1)
+
+    big = tfm.make_train_step(cfg, lr=1e-2)
+    p0 = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    loss_a, pa, _ = big(jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0),
+                        tok, tgt)
+
+    acc = tfm.make_train_step(cfg, lr=1e-2, accum_steps=4)
+    loss_b, pb, _ = acc(jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0),
+                        tok.reshape(4, 2, 8), tgt.reshape(4, 2, 8))
+
+    assert float(loss_a) == __import__("pytest").approx(float(loss_b),
+                                                        rel=1e-5)
+    for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
