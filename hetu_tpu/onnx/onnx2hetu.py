@@ -79,7 +79,10 @@ def _i_softmax(ctx, node, ins, attrs):
 
 @imports("MatMul")
 def _i_matmul(ctx, node, ins, attrs):
-    return O.matmul_op(ins[0], ins[1])
+    # ONNX MatMul has numpy-matmul semantics (batched over leading dims);
+    # batch_matmul_op is jnp.matmul, rank-polymorphic — matmul_op is the
+    # strictly-2D reference MatrixMult and 6D-explodes on batched inputs
+    return O.batch_matmul_op(ins[0], ins[1])
 
 
 @imports("Gemm")

@@ -220,3 +220,38 @@ def test_onnx_file_is_wellformed(tmp_path):
     assert P.value_info_shape(m.graph.input[0]) == (4, 3)
     assert len(m.graph.initializer) == 1
     assert m.graph.node[-1].op_type == "MatMul"
+
+
+def test_transformer_block_roundtrip(tmp_path):
+    """A full graph-API attention + FFN block (the nlp example's
+    multihead_attention/feed_forward) survives export -> import: BatchMatMul
+    (batched numpy-matmul semantics, incl. trans_B), LayerNorm, Softmax,
+    causal-mask broadcast, Dropout. Trained-parameter values come from the
+    executor state, like the MLP/LeNet round trips."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "nlp"))
+    import hetu_transformer as htf
+
+    B, T, D, H = 2, 4, 8, 2
+    xv = RNG.randn(B, T, D).astype(np.float32)
+    maskv = np.triu(np.full((T, T), -1e9, np.float32), k=1)[None, None]
+
+    x = ht.Variable(name="x", trainable=False)
+    mask = ht.Variable(name="mask", trainable=False)
+    h = htf.multihead_attention(x, B, T, D, H, mask, "blk", dropout_prob=0.0)
+    h = h + x
+    h = htf.layer_norm(h, D, "ln1")
+    out = ht.add_op(htf.feed_forward(h, B, T, D, 16, "ffn",
+                                     dropout_prob=0.0), h)
+    ex = ht.Executor([out], ctx=ht.cpu(0))
+    (orig,) = ex.run("default", feed_dict={x: xv, mask: maskv},
+                     convert_to_numpy_ret_vals=True)
+
+    path = str(tmp_path / "block.onnx")
+    hetu2onnx.export(ex, [x, mask], [out], path,
+                     input_shapes={x: xv.shape, mask: maskv.shape})
+    in_map, outs = onnx2hetu.load(path)
+    (imported,) = _run(outs, {in_map["x"]: xv, in_map["mask"]: maskv})
+    np.testing.assert_allclose(orig, imported, rtol=1e-4, atol=1e-5)
