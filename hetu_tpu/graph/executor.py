@@ -528,16 +528,42 @@ class SubExecutor:
         return {k.replace("_s", "_ms_per_step"): round(v / n * 1000, 3)
                 for k, v in p.items() if k != "steps"} | {"steps": n}
 
-    def last_cost_analysis(self):
-        """XLA cost analysis (flops etc.) of the latest executed step, for
-        MFU reporting (reaches the compilation cache — no recompile)."""
+    def _lowered(self):
+        """Re-lower the latest executed step (hits the compilation cache)."""
         if self._last_call is None:
             return None
         fn, args = self._last_call
+        return fn.lower(*args)
+
+    def last_cost_analysis(self):
+        """XLA cost analysis (flops etc.) of the latest executed step, for
+        MFU reporting (reaches the compilation cache — no recompile)."""
         try:
-            return fn.lower(*args).compile().cost_analysis()
+            low = self._lowered()
+            return None if low is None else low.compile().cost_analysis()
         except Exception:  # noqa: BLE001 — diagnostics only
             return None
+
+    def dump_hlo(self, path=None, stage="stablehlo"):
+        """The compiled program of the latest executed step as text — the
+        whole subexecutor is ONE XLA program, so this is the full fused
+        truth of what runs per step (the deep-debug complement to
+        graphboard's op-level topo view). ``stage``: "stablehlo" (lowered,
+        pre-optimization) or "optimized" (post-XLA-passes HLO, with fusion
+        decisions and layouts). Returns the text; also writes it when
+        ``path`` is given."""
+        if stage not in ("stablehlo", "optimized"):
+            raise ValueError(f"stage must be 'stablehlo' or 'optimized', "
+                             f"got {stage!r}")
+        lowered = self._lowered()
+        if lowered is None:
+            return None
+        text = (lowered.as_text() if stage == "stablehlo"
+                else lowered.compile().as_text())
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
 
     # ------------------------------------------------------------------
     def run(self, feed_dict=None, convert_to_numpy_ret_vals=False,

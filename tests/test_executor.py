@@ -291,3 +291,22 @@ def test_bf16_conv_bn_training():
     for st in jax.tree.leaves(ex.state["op_state"]):
         if hasattr(st, "dtype") and jnp.issubdtype(st.dtype, jnp.floating):
             assert st.dtype == jnp.float32
+
+
+def test_dump_hlo_exposes_the_compiled_step(tmp_path):
+    """dump_hlo returns the (stable)HLO of the whole jitted step and writes
+    it to disk; the optimized stage reflects XLA's pass pipeline."""
+    import hetu_tpu as ht
+
+    x = ht.Variable(name="x", trainable=False)
+    w = ht.Variable("w", value=np.eye(4, dtype=np.float32))
+    out = ht.relu_op(ht.matmul_op(x, w))
+    ex = ht.Executor([out], ctx=ht.cpu(0))
+    ex.run("default", feed_dict={x: np.ones((2, 4), np.float32)})
+
+    sub = ex.subexecutors["default"]
+    txt = sub.dump_hlo(str(tmp_path / "step.mlir"))
+    assert txt and "dot" in txt  # the matmul is in the program
+    assert (tmp_path / "step.mlir").read_text() == txt
+    opt = sub.dump_hlo(stage="optimized")
+    assert opt and opt != txt
