@@ -14,9 +14,12 @@ Prompt handling is teacher-forced inside the same scan: while t < len(p),
 the next input token comes from the prompt, afterwards from greedy argmax
 or temperature sampling — so prefill and decode share one compiled program.
 
-Single-program decode (mesh=None) and dense MLP blocks only (the switch
-MoE flagship path is a training configuration; decode asserts
-``n_experts == 0``).
+Dense MLP blocks only (the switch MoE flagship path is a training
+configuration; decode asserts ``n_experts == 0``). Decode runs
+single-program (``mesh=None``) or distributed: with a mesh, params keep
+their Megatron tp layout, the KV cache shards batch-over-dp and
+heads-over-tp, and GSPMD inserts the collectives (see
+``make_generate_fn``).
 """
 from __future__ import annotations
 
@@ -83,22 +86,39 @@ def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
 
 @functools.lru_cache(maxsize=32)
 def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
-                     sample: bool = False):
+                     sample: bool = False, top_k: int = 0,
+                     mesh=None):
     """Returns a jitted ``(params, prompt (B, P) int32, rng_key,
     temperature=1.0) -> (tokens (B, max_len), logits (B, max_len, V))``
     where tokens[:, :P] echoes the prompt and the rest is generated.
     ``sample=False``: greedy argmax (rng/temperature unused);
     ``sample=True``: temperature sampling — temperature is a DYNAMIC
-    operand, so sweeping it never recompiles."""
+    operand, so sweeping it never recompiles. ``top_k > 0`` restricts
+    sampling to the k most likely tokens.
+
+    ``mesh``: distributed decode — params stay in their Megatron layout
+    (``tfm.param_specs``: qkv/mlp column-parallel over ``tp``), the KV
+    cache is sharded batch-over-``dp`` and heads-over-``tp``, and GSPMD
+    inserts the same collectives as training. Decode never gathers the
+    weights."""
     assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
     assert cfg.causal, "decode is autoregressive — causal configs only"
     assert max_len <= cfg.max_seq_len
+    assert 0 <= top_k <= cfg.vocab_size, (
+        f"top_k {top_k} out of range [0, vocab_size={cfg.vocab_size}]")
+
+    cache_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        cache_sharding = NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, "dp", "tp", None, None))
 
     def gen(params, prompt, key, temperature=1.0):
         B, P = prompt.shape
         assert P <= max_len, f"prompt length {P} > max_len {max_len}"
         L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
-        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype,
+                           device=cache_sharding)
         vcache = jnp.zeros_like(kcache)
         padded = jnp.zeros((B, max_len), jnp.int32)
         padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
@@ -110,7 +130,11 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                 params, cfg, tok, kcache, vcache, t)
             key, sub = jax.random.split(key)
             if sample:
-                nxt = jax.random.categorical(sub, logits / temperature, -1)
+                scaled = logits / temperature
+                if top_k > 0:
+                    kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+                    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+                nxt = jax.random.categorical(sub, scaled, -1)
             else:
                 nxt = jnp.argmax(logits, -1)
             nxt = nxt.astype(jnp.int32)
