@@ -2,9 +2,11 @@
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 Headline (BASELINE.md north star): ResNet-18 / CIFAR10-shape training through
-the define-then-run Executor on the real chip, samples/sec/chip, best of
-{f32, bf16} x {bs 128, 256}. Round-3 changes: bf16 conv backward fixed,
-device-resident dataset slicing (zero per-step H2D), rng folded into the jit.
+the define-then-run Executor on the real chip, samples/sec/chip, best over
+{f32, bf16} x {bs 128, 256} plus bf16 x bs 512 (f32 falls behind well
+before bs 512, so that cell is skipped). Round-3 changes: bf16 conv backward
+fixed, device-resident dataset slicing (zero per-step H2D), rng folded into
+the jit, hard host syncs (block_until_ready reports early on the tunnel).
 ``detail`` carries each config's samples/s + step ms + MFU (XLA cost-analysis
 flops over an assumed peak), the flagship transformer tokens/s, and a
 WDL-Criteo run through a real local PS cluster (scheduler + 2 servers,
@@ -235,11 +237,11 @@ def main():
             # framework — executor overhead = twin/executor ratio
             _import_models("cnn")  # dedup-inserts examples/cnn on sys.path
             import jax_twin
-            tsps, tms = jax_twin.bench(batch_size=256, dtype="bf16")
-            detail["jax_native_twin_bf16_bs256"] = {
+            tsps, tms = jax_twin.bench(batch_size=512, dtype="bf16")
+            detail["jax_native_twin_bf16_bs512"] = {
                 "samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
         except Exception as e:  # noqa: BLE001
-            detail["jax_native_twin_bf16_bs256"] = {"error": str(e)[:200]}
+            detail["jax_native_twin_bf16_bs512"] = {"error": str(e)[:200]}
         try:
             toks, tms, tmfu = bench_transformer()
             detail["transformer_38M_seq512"] = {
