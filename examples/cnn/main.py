@@ -94,6 +94,9 @@ if __name__ == "__main__":
     ])
     if args.model in ('mlp', 'logreg'):
         loss, y = model(x, y_, num_class, input_dim)
+    elif args.model == 'vit':
+        # attention reshapes need the static batch size
+        loss, y = model(x, y_, num_class, batch=args.batch_size)
     else:
         loss, y = model(x, y_, num_class)
     train_op = opt.minimize(loss)

@@ -1,0 +1,85 @@
+"""Vision Transformer (CIFAR-sized) through the graph API.
+
+No reference counterpart (the reference zoo stops at ResNet/RNN/LSTM,
+``examples/cnn/models/``); this demonstrates attention models on the
+define-then-run API with the same building blocks the nlp example uses:
+conv patch embedding, BatchMatMul attention, LayerNorm residual blocks,
+a learned [CLS] token readout.
+"""
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import init
+
+
+def _dense(x, fan_in, fan_out, name):
+    w = init.xavier_uniform((fan_in, fan_out), name=name + '_w')
+    b = init.zeros((fan_out,), name=name + '_b')
+    y = ht.matmul_op(ht.array_reshape_op(x, (-1, fan_in)), w)
+    return y + ht.broadcastto_op(b, y)
+
+
+def _block(h, batch, tokens, d, heads, dff, name):
+    """Pre-LN transformer encoder block on (B, T, D)."""
+    hd = d // heads
+
+    def split_heads(t):
+        t = ht.array_reshape_op(t, (batch, tokens, heads, hd))
+        return ht.transpose_op(t, (0, 2, 1, 3))
+
+    ln1 = _ln(h, d, name + '_ln1')
+    q = split_heads(ht.array_reshape_op(_dense(ln1, d, d, name + '_q'),
+                                        (batch, tokens, d)))
+    k = split_heads(ht.array_reshape_op(_dense(ln1, d, d, name + '_k'),
+                                        (batch, tokens, d)))
+    v = split_heads(ht.array_reshape_op(_dense(ln1, d, d, name + '_v'),
+                                        (batch, tokens, d)))
+    scores = ht.mul_byconst_op(ht.batch_matmul_op(q, k, trans_B=True),
+                               1.0 / np.sqrt(hd))
+    attn = ht.softmax_op(scores)                       # bidirectional
+    ctx = ht.transpose_op(ht.batch_matmul_op(attn, v), (0, 2, 1, 3))
+    ctx = ht.array_reshape_op(ctx, (batch, tokens, d))
+    h = h + ht.array_reshape_op(_dense(ctx, d, d, name + '_o'),
+                                (batch, tokens, d))
+
+    ln2 = _ln(h, d, name + '_ln2')
+    f = ht.relu_op(_dense(ln2, d, dff, name + '_f1'))
+    f = ht.array_reshape_op(_dense(f, dff, d, name + '_f2'),
+                            (batch, tokens, d))
+    return h + f
+
+
+def _ln(x, d, name):
+    scale = init.ones((d,), name=name + '_scale')
+    bias = init.zeros((d,), name=name + '_bias')
+    return ht.layer_normalization_op(x, scale, bias)
+
+
+def vit(x, y_, num_class=10, batch=128, image=32, patch=4, d=64,
+        heads=4, layers=4, dff=128):
+    """x: (B, 3, H, W) NCHW CIFAR batch -> (loss, probs)."""
+    print('Building ViT model...')
+    n_patch = (image // patch) ** 2                    # 64 tokens
+    tokens = n_patch + 1                               # + [CLS]
+
+    # patch embedding: conv stride=patch, then (B, D, P, P) -> (B, P*P, D)
+    wp = init.he_normal((d, 3, patch, patch), name='vit_patch_w')
+    h = ht.conv2d_op(x, wp, padding=0, stride=patch)   # (B, D, 8, 8)
+    h = ht.array_reshape_op(h, (batch, d, n_patch))
+    h = ht.transpose_op(h, (0, 2, 1))                  # (B, 64, D)
+
+    cls = init.random_normal((1, 1, d), stddev=0.02, name='vit_cls')
+    h = ht.concat_op(ht.broadcast_shape_op(cls, (batch, 1, d)), h, axis=1)
+    pos = init.random_normal((1, tokens, d), stddev=0.02, name='vit_pos')
+    h = h + ht.broadcastto_op(pos, h)
+
+    for i in range(layers):
+        h = _block(h, batch, tokens, d, heads, dff, f'vit_l{i}')
+
+    h = _ln(h, d, 'vit_lnf')
+    cls_out = ht.slice_op(h, (0, 0, 0), (batch, 1, d))
+    logits = _dense(ht.array_reshape_op(cls_out, (batch, d)), d, num_class,
+                    'vit_head')
+    loss = ht.softmaxcrossentropy_op(logits, y_)
+    loss = ht.reduce_mean_op(loss, [0])
+    return loss, ht.softmax_op(logits)

@@ -7,3 +7,4 @@ from .VGG import vgg16, vgg19
 from .ResNet import resnet18, resnet34
 from .RNN import rnn
 from .LSTM import lstm
+from .ViT import vit

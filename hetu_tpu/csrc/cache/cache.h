@@ -123,20 +123,38 @@ class CacheBase {
   // -- async API: enqueue, get a ticket; wait(ticket) joins --------------
   using ticket_t = int64_t;
 
+  // INPUT buffers (keys, grads) are COPIED at enqueue time: callers may
+  // free them immediately, ticket kept or not — a fire-and-forget
+  // update_async must never read a buffer the caller has released (the
+  // use-after-free shows up as astronomically large "gradients" pushed to
+  // the server under concurrency). OUTPUT buffers (lookup dest) inherently
+  // must outlive the op — the result lands there; wait() before reading.
+
   ticket_t lookup_async(const cache_key_t* keys, size_t n, float* dest) {
-    return enqueue([=] { do_lookup(keys, n, dest); });
+    std::vector<cache_key_t> k(keys, keys + n);
+    return enqueue([this, k = std::move(k), n, dest] {
+      do_lookup(k.data(), n, dest);
+    });
   }
 
   ticket_t update_async(const cache_key_t* keys, const float* grads,
                         size_t n) {
-    return enqueue([=] { do_update(keys, n, grads); });
+    std::vector<cache_key_t> k(keys, keys + n);
+    std::vector<float> g(grads, grads + n * width_);
+    return enqueue([this, k = std::move(k), g = std::move(g), n] {
+      do_update(k.data(), n, g.data());
+    });
   }
 
   ticket_t push_pull_async(const cache_key_t* pull_keys, size_t n_pull,
                            float* dest, const cache_key_t* push_keys,
                            const float* grads, size_t n_push) {
-    return enqueue([=] {
-      do_push_pull(pull_keys, n_pull, dest, push_keys, grads, n_push);
+    std::vector<cache_key_t> pk(pull_keys, pull_keys + n_pull);
+    std::vector<cache_key_t> uk(push_keys, push_keys + n_push);
+    std::vector<float> g(grads, grads + n_push * width_);
+    return enqueue([this, pk = std::move(pk), uk = std::move(uk),
+                    g = std::move(g), n_pull, dest, n_push] {
+      do_push_pull(pk.data(), n_pull, dest, uk.data(), g.data(), n_push);
     });
   }
 

@@ -10,7 +10,9 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <filesystem>
 #include <memory>
 #include <mutex>
@@ -26,7 +28,10 @@ namespace hetups {
 class PsServer {
  public:
   PsServer(int rank, const std::string& host, int port)
-      : rank_(rank), host_(host), port_(port) {}
+      : rank_(rank), host_(host), port_(port) {
+    const char* v = std::getenv("DMLC_PS_VALIDATE");
+    validate_ = v && *v && *v != '0';
+  }
 
   ~PsServer() { stop(); }
 
@@ -377,11 +382,28 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
+        if (req.args[1].n_f32() != nidx * p->width ||
+            req.args[2].n_i64() != nidx)
+          throw std::runtime_error(
+              "kPushEmbedding arg length mismatch: " +
+              std::to_string(req.args[1].n_f32()) + " grads / " +
+              std::to_string(req.args[2].n_i64()) + " ups for " +
+              std::to_string(nidx) + " rows x width " +
+              std::to_string(p->width));
         begin_update(*p);
         const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
+          if (validate_)
+            for (size_t j = 0; j < p->width; ++j)
+              if (!(std::fabs(grads[i * p->width + j]) < 1e3f))
+                std::fprintf(stderr,
+                             "[hetups VALIDATE] push tensor %d row %lld "
+                             "grad[%zu]=%g nidx=%zu ups=%lld\n",
+                             key, (long long)idx[i], j,
+                             (double)grads[i * p->width + j], nidx,
+                             (long long)ups[i]);
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
           p->versions[r] += ups[i];
         }
@@ -402,11 +424,28 @@ class PsServer {
         // validate BOTH sides before any mutation (rejected => untouched)
         check_rows(*p, idx, nidx);
         check_rows(*p, sidx, ns);
+        if (req.args[1].n_f32() != nidx * p->width ||
+            req.args[2].n_i64() != nidx)
+          throw std::runtime_error(
+              "kPushSyncEmbedding arg length mismatch: " +
+              std::to_string(req.args[1].n_f32()) + " grads / " +
+              std::to_string(req.args[2].n_i64()) + " ups for " +
+              std::to_string(nidx) + " rows x width " +
+              std::to_string(p->width));
         begin_update(*p);
         const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
+          if (validate_)
+            for (size_t j = 0; j < p->width; ++j)
+              if (!(std::fabs(grads[i * p->width + j]) < 1e3f))
+                std::fprintf(stderr,
+                             "[hetups VALIDATE] push_sync tensor %d row "
+                             "%lld grad[%zu]=%g nidx=%zu ups=%lld\n",
+                             key, (long long)idx[i], j,
+                             (double)grads[i * p->width + j], nidx,
+                             (long long)ups[i]);
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
           p->versions[r] += ups[i];
         }
@@ -636,6 +675,7 @@ class PsServer {
   int rank_;
   std::string host_;
   int port_;
+  bool validate_ = false;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;

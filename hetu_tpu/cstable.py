@@ -63,7 +63,10 @@ class _Wait:
         self._lib = lib
         self._handle = handle
         self._ticket = ticket
-        self._keepalive = keepalive  # buffers must outlive the async op
+        # OUTPUT buffers must outlive the async op (results land in them);
+        # input keys/grads are copied at enqueue in the C++ layer, so a
+        # discarded wait handle is safe for fire-and-forget updates
+        self._keepalive = keepalive
 
     def wait(self):
         if self._lib.CacheWait(ctypes.c_void_p(self._handle),
@@ -128,7 +131,7 @@ class CacheSparseTable:
         ticket = self._lib.CacheEmbeddingLookup(
             ctypes.c_void_p(self._handle), k.ctypes.data_as(_u64p),
             ctypes.c_long(k.size), d.ctypes.data_as(_f32p))
-        wait = _Wait(self._lib, self._handle, ticket, (k, d))
+        wait = _Wait(self._lib, self._handle, ticket, (d,))
         if sync:
             wait.wait()
             return d
@@ -141,7 +144,7 @@ class CacheSparseTable:
         ticket = self._lib.CacheEmbeddingUpdate(
             ctypes.c_void_p(self._handle), k.ctypes.data_as(_u64p),
             g.ctypes.data_as(_f32p), ctypes.c_long(k.size))
-        wait = _Wait(self._lib, self._handle, ticket, (k, g))
+        wait = _Wait(self._lib, self._handle, ticket, None)
         if sync:
             wait.wait()
             return None
@@ -160,7 +163,7 @@ class CacheSparseTable:
             ctypes.c_long(pk.size), d.ctypes.data_as(_f32p),
             uk.ctypes.data_as(_u64p), g.ctypes.data_as(_f32p),
             ctypes.c_long(uk.size))
-        wait = _Wait(self._lib, self._handle, ticket, (pk, d, uk, g))
+        wait = _Wait(self._lib, self._handle, ticket, (d,))
         if sync:
             wait.wait()
             return d

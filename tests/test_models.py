@@ -98,3 +98,8 @@ def test_rnn():
 
 def test_lstm():
     _train_two_steps(models.lstm, (784,))
+
+
+def test_vit():
+    l0, l1 = _train_two_steps(models.vit, (3, 32, 32), lr=1e-3, batch=8)
+    assert l1 < l0 * 1.5  # attention model is stable from step one
