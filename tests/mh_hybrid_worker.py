@@ -1,0 +1,103 @@
+"""Worker for tests/test_multihost_hybrid.py (not collected by pytest).
+
+The reference's Hybrid comm mode (dense grads AllReduce, sparse embeddings
+through the PS — optimizer.py:129-136) at MULTI-HOST scale: each process is
+simultaneously
+- one host of a 2-process jax.distributed world (Gloo collectives over a
+  4-device global dp mesh) for the dense parameters, and
+- one DMLC worker of a live PS cluster for the embedding table
+  (SparsePull rows for its batch, SparsePush the row gradients).
+"""
+import json
+import sys
+
+import numpy as np
+
+N_ROWS, WIDTH, CLASSES = 32, 8, 2
+
+
+def main():
+    pid, nproc, jport = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from hetu_tpu.parallel import multihost as mh
+
+    assert mh.initialize(coordinator_address=f"127.0.0.1:{jport}",
+                         num_processes=nproc, process_id=pid,
+                         local_device_count=2)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from hetu_tpu.ps.client import PSClient
+
+    client = PSClient.from_env()      # DMLC_* env from the test harness
+    client.InitTensor(31, sparse=1, length=N_ROWS, width=WIDTH,
+                      init_type="normal", init_a=0.0, init_b=0.3)
+
+    mesh = mh.global_mesh()
+    rep = NamedSharding(mesh, P())
+
+    init_rows = np.zeros((N_ROWS, WIDTH), np.float32)
+    client.SparsePull(31, np.arange(N_ROWS, dtype=np.int64), init_rows)
+    client.Wait(31)
+
+    # deterministic data: row ids + labels; each host feeds its own half
+    rng = np.random.RandomState(0)
+    true_emb = rng.randn(N_ROWS, WIDTH).astype(np.float32)
+    true_w = rng.randn(WIDTH, CLASSES).astype(np.float32)
+    all_ids = rng.randint(0, N_ROWS, (8,)).astype(np.int64)
+    all_y = (true_emb[all_ids] @ true_w).argmax(1).astype(np.int32)
+    rows_per_host = len(all_ids) // nproc
+    lo, hi = pid * rows_per_host, (pid + 1) * rows_per_host
+
+    # same seed on every host: dense params start (and stay) identical
+    W = jnp.asarray(np.random.RandomState(7).randn(WIDTH, CLASSES) * 0.3,
+                    jnp.float32)
+
+    @jax.jit
+    def step(W, emb, y):
+        def loss_fn(W, emb):
+            logits = emb @ W
+            lp = jax.nn.log_softmax(logits, -1)
+            return -jnp.mean(jnp.take_along_axis(lp, y[:, None], -1))
+        (loss), grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(W, emb)
+        return loss, grads[0], grads[1]
+
+    lr = 0.5
+    losses = []
+    for it in range(60):
+        ids = all_ids[lo:hi]
+        rows = np.zeros((len(ids), WIDTH), np.float32)
+        client.SparsePull(31, ids, rows)           # sparse: through the PS
+        client.Wait(31)
+        emb = mh.host_local_batch(mesh, P("dp"), rows)
+        y = mh.host_local_batch(mesh, P("dp"), all_y[lo:hi])
+        loss, gW, gemb = step(W, emb, y)
+        # dense: GSPMD already summed over dp inside the jit; apply locally
+        W = jax.device_put(W - lr * gW, rep)
+        # sparse: push THIS HOST's row grads back to the PS (server += ).
+        # gemb is dp-sharded; this process's shards are exactly its own
+        # rows — order them by their global offset
+        shards = sorted(gemb.addressable_shards, key=lambda s: s.index[0].start)
+        local_rows = np.concatenate([np.asarray(s.data) for s in shards])
+        client.SparsePush(31, ids, -lr * local_rows)
+        client.Wait(31)
+        mh.barrier(f"step{it}")                    # BSP: reference's bsp mode
+        losses.append(float(loss))
+
+    # final table rows as seen by this worker
+    final_rows = np.zeros((N_ROWS, WIDTH), np.float32)
+    client.SparsePull(31, np.arange(N_ROWS, dtype=np.int64), final_rows)
+    client.Wait(31)
+    print(json.dumps({
+        "pid": pid,
+        "first_loss": losses[0],
+        "final_loss": losses[-1],
+        "w_sum": float(np.sum(mh.fetch_replicated(W))),
+        "table_digest": float(np.sum(final_rows * final_rows)),
+        "table_moved": float(np.abs(final_rows - init_rows).max()),
+    }), flush=True)
+    client.close()
+    mh.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -18,18 +18,33 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_world(nproc=2, timeout=180, ckpt_dir=None):
+def _run_world(nproc=2, timeout=180, ckpt_dir=None, script="mh_worker.py",
+               extra_env=None, per_worker_env=None):
+    """Launch ``nproc`` jax.distributed worker processes and collect one
+    JSON result line from each. Shared by the plain multihost test and the
+    hybrid (PS + Gloo) test — worker scripts take (pid, nproc, coord_port,
+    [extra argv]) and print their result as a JSON object line."""
     from hetu_tpu.runner import _get_available_port
     port = _get_available_port("127.0.0.1")
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # worker configures its own platform
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     extra = [str(ckpt_dir)] if ckpt_dir else []
-    procs = [subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
-         str(pid), str(nproc), str(port)] + extra,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
-        for pid in range(nproc)]
+    procs = []
+    try:
+        for pid in range(nproc):
+            wenv = dict(env)
+            wenv.update((per_worker_env or (lambda _: {}))(pid))
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tests", script),
+                 str(pid), str(nproc), str(port)] + extra,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=wenv,
+                text=True))
+    except Exception:
+        for q in procs:   # a failed launch must not leak live peers
+            q.kill()
+        raise
     # collect every worker's output even when one crashes or hangs — the
     # FIRST crash is the diagnosis, and a surviving peer blocks in
     # jax.distributed.initialize far longer than our timeout
