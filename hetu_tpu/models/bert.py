@@ -121,12 +121,15 @@ def mlm_logits(params, h, positions):
     return logits + params["mlm_bias"]
 
 
+def _pool(params, h):
+    """Tanh-dense pooling of the [CLS] vector -> (B, D) f32."""
+    return jnp.tanh(h[:, 0, :].astype(jnp.float32) @ params["pool_w"]
+                    + params["pool_b"])
+
+
 def nsp_logits(params, h):
-    """Pooled [CLS] (tanh dense) -> (B, 2) f32."""
-    cls = h[:, 0, :]
-    pooled = jnp.tanh(cls.astype(jnp.float32) @ params["pool_w"]
-                      + params["pool_b"])
-    return pooled @ params["nsp_w"] + params["nsp_b"]
+    """Pooled [CLS] -> (B, 2) f32."""
+    return _pool(params, h) @ params["nsp_w"] + params["nsp_b"]
 
 
 def pretrain_loss(params, batch, cfg: BertConfig, mesh=None):
@@ -173,6 +176,61 @@ def make_pretrain_step(cfg: BertConfig, mesh: Optional[Mesh] = None,
                    out_shardings=(scalar, (scalar, scalar), pshard,
                                   opt_shard),
                    donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# fine-tuning: swap the pretrain heads for a task head on the pooled [CLS]
+# (the standard BERT downstream recipe; no reference counterpart — its nlp
+# suite stops at pretraining machinery)
+# ---------------------------------------------------------------------------
+
+def init_classifier_params(rng, cfg: BertConfig, n_classes: int,
+                           pretrained=None):
+    """Task params: the (possibly pretrained) encoder trunk + pooler, with a
+    fresh classification head. ``pretrained``: params from
+    ``init_params``/pretraining — trunk and pooler are reused, MLM/NSP
+    heads dropped."""
+    k_trunk, k_head = jax.random.split(rng)
+    base = pretrained if pretrained is not None else init_params(k_trunk, cfg)
+    # deep-copy reused leaves: the fine-tune step donates its params, and a
+    # donated alias would invalidate the caller's pretrained tree
+    params = {k: jax.tree.map(jnp.array, v) for k, v in base.items()
+              if k not in ("mlm_dense", "mlm_ln_scale", "mlm_ln_bias",
+                           "mlm_bias", "nsp_w", "nsp_b")}
+    D = cfg.d_model
+    params["cls_w"] = jax.random.normal(k_head, (D, n_classes),
+                                        jnp.float32) * 0.02
+    params["cls_b"] = jnp.zeros((n_classes,), jnp.float32)
+    return params
+
+
+def classify_logits(params, input_ids, segment_ids, cfg: BertConfig,
+                    mesh=None, input_mask=None):
+    h = encode(params, input_ids, segment_ids, cfg, mesh, input_mask)
+    return _pool(params, h) @ params["cls_w"] + params["cls_b"]
+
+
+def make_finetune_step(cfg: BertConfig, lr: float = 2e-5, mesh=None):
+    """Jitted (params, opt_state, batch{input_ids, segment_ids, label,
+    [input_mask]}) -> (loss, acc, params, opt)."""
+
+    def step(params, opt_state, batch):
+        def loss_fn(params):
+            logits = classify_logits(params, batch["input_ids"],
+                                     batch["segment_ids"], cfg, mesh,
+                                     batch.get("input_mask"))
+            lp = jax.nn.log_softmax(logits, -1)
+            loss = -jnp.mean(jnp.take_along_axis(
+                lp, batch["label"][:, None], -1)[:, 0])
+            acc = jnp.mean((jnp.argmax(logits, -1) ==
+                            batch["label"]).astype(jnp.float32))
+            return loss, acc
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = tfm.adamw_update(params, grads, opt_state,
+                                               lr=lr)
+        return loss, acc, new_params, new_opt
+
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 def batch_from_instances(instances):

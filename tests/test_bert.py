@@ -157,3 +157,38 @@ def test_dp_tp_sharded_step_matches_single_device():
     for k in ("embed", "mlm_dense", "nsp_w"):
         np.testing.assert_allclose(np.asarray(new_params[k]),
                                    np.asarray(ref_params[k]), atol=1e-5)
+
+
+def test_finetune_classifier_from_pretrained_trunk():
+    """Pretrain briefly, transplant the trunk into a classifier, fine-tune
+    on a separable task (label = does the sequence contain token 5): the
+    classifier must fit it; the MLM/NSP heads are gone from the task
+    params."""
+    params = bert.init_params(jax.random.PRNGKey(0), TINY)
+    opt = bert.init_opt_state(params)
+    pstep = bert.make_pretrain_step(TINY, lr=1e-3)
+    rng = np.random.RandomState(5)
+    for _ in range(3):
+        _, _, params, opt = pstep(params, opt, _rand_batch(rng, TINY))
+
+    cparams = bert.init_classifier_params(jax.random.PRNGKey(1), TINY,
+                                          n_classes=2, pretrained=params)
+    assert "mlm_bias" not in cparams and "nsp_w" not in cparams
+    assert "cls_w" in cparams and "blocks" in cparams
+
+    B, T = 16, 16
+    ids = rng.randint(6, TINY.vocab_size, (B, T)).astype(np.int32)
+    ids[: B // 2, rng.randint(1, T)] = 5          # positives contain token 5
+    labels = (ids == 5).any(1).astype(np.int32)
+    batch = {"input_ids": ids,
+             "segment_ids": np.zeros((B, T), np.int32),
+             "label": labels}
+    fstep = bert.make_finetune_step(TINY, lr=3e-3)
+    copt = bert.init_opt_state(cparams)
+    for i in range(60):
+        loss, acc, cparams, copt = fstep(cparams, copt, batch)
+    assert float(acc) == 1.0, (float(loss), float(acc))
+    # donation of the task params must NOT have invalidated the pretrained
+    # tree (init_classifier_params deep-copies reused leaves)
+    h = bert.encode(params, batch["input_ids"], batch["segment_ids"], TINY)
+    assert np.isfinite(float(jnp.sum(h)))
