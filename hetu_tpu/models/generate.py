@@ -166,3 +166,86 @@ def generate(params, cfg: tfm.TransformerConfig, prompt, max_len: int,
     toks, _ = fn(params, jnp.asarray(prompt, jnp.int32), rng,
                  max(temperature, 1e-6))
     return np.asarray(toks)
+
+
+@functools.lru_cache(maxsize=32)
+def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
+                        beam_size: int):
+    """Returns jitted ``(params, prompt (B, P) int32) ->
+    (tokens (B, K, max_len), scores (B, K))``, beams sorted best-first by
+    total log-probability of the generated suffix. Same one-scan KV-cache
+    machinery as sampling; beam reordering gathers the cache along the
+    flattened (B*K) batch dim each step."""
+    assert cfg.n_experts == 0 and cfg.causal
+    assert max_len <= cfg.max_seq_len
+    K = beam_size
+
+    def beam(params, prompt):
+        B, P = prompt.shape
+        assert 1 <= P < max_len, "beam search must generate >= 1 token"
+        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        BK = B * K
+        V = cfg.vocab_size
+
+        # -- prefill at batch B (NOT B*K: the K copies would be identical) --
+        kc = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        vc = jnp.zeros_like(kc)
+
+        def pre(carry, t):
+            kc, vc = carry
+            tok = jax.lax.dynamic_index_in_dim(prompt, t, 1, keepdims=False)
+            logits, kc, vc = _one_token_logits(params, cfg, tok, kc, vc, t)
+            return (kc, vc), logits
+
+        (kc, vc), pre_logits = jax.lax.scan(pre, (kc, vc), jnp.arange(P))
+
+        # first expansion: top-min(K, V) continuations of the prompt seed
+        # the beams; with K > V the surplus beams start dead (-inf) and get
+        # claimed by real candidates at the next expansion (this is what
+        # makes K >= V^n exhaustive)
+        logp0 = jax.nn.log_softmax(pre_logits[-1].astype(jnp.float32), -1)
+        k0 = min(K, V)
+        scores, first_tok = jax.lax.top_k(logp0, k0)           # (B, k0)
+        if k0 < K:
+            scores = jnp.concatenate(
+                [scores, jnp.full((B, K - k0), -1e30, jnp.float32)], axis=1)
+            first_tok = jnp.concatenate(
+                [first_tok, jnp.zeros((B, K - k0), first_tok.dtype)], axis=1)
+        toks = jnp.zeros((B, K, max_len), jnp.int32)
+        toks = jax.lax.dynamic_update_slice(
+            toks, jnp.repeat(prompt[:, None, :], K, 1), (0, 0, 0))
+        toks = jax.lax.dynamic_update_slice(
+            toks, first_tok[:, :, None].astype(jnp.int32), (0, 0, P))
+        # tile the prefilled cache to B*K once
+        kcache = jnp.repeat(kc, K, axis=1)
+        vcache = jnp.repeat(vc, K, axis=1)
+
+        # -- decode: feed position t, expand into position t+1 -------------
+        def step(carry, t):
+            toks, scores, kcache, vcache = carry
+            tok = jax.lax.dynamic_index_in_dim(
+                toks.reshape(BK, max_len), t, 1, keepdims=False)
+            logits, kcache, vcache = _one_token_logits(
+                params, cfg, tok, kcache, vcache, t)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            cand = scores[:, :, None] + logp.reshape(B, K, V)
+            top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+            src_beam = top_idx // V                            # (B, K)
+            new_tok = (top_idx % V).astype(jnp.int32)
+            # reorder beams (and their caches) by ancestry
+            toks = jnp.take_along_axis(toks, src_beam[..., None], axis=1)
+            gather = (jnp.arange(B)[:, None] * K + src_beam).reshape(BK)
+            kcache = jnp.take(kcache, gather, axis=1)
+            vcache = jnp.take(vcache, gather, axis=1)
+            toks = jax.lax.dynamic_update_slice(
+                toks, new_tok[:, :, None], (0, 0, t + 1))
+            return (toks, top_scores, kcache, vcache), None
+
+        (toks, scores, _, _), _ = jax.lax.scan(
+            step, (toks, scores, kcache, vcache),
+            jnp.arange(P, max_len - 1))
+        order = jnp.argsort(-scores, axis=1)
+        return (jnp.take_along_axis(toks, order[..., None], 1),
+                jnp.take_along_axis(scores, order, 1))
+
+    return jax.jit(beam)
