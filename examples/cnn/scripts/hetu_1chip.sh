@@ -1,0 +1,4 @@
+#!/bin/bash
+# single-chip training (reference scripts/hetu_1gpu.sh)
+cd "$(dirname "$0")/.." || exit 1
+python main.py --model "${1:-resnet18}" --dataset CIFAR10 --validate --timing "${@:2}"

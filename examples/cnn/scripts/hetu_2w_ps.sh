@@ -1,0 +1,5 @@
+#!/bin/bash
+# 2 workers + 1 PS server via heturun (reference scripts/hetu_2gpu_ps.sh)
+cd "$(dirname "$0")/.." || exit 1
+PYTHONPATH="$(cd ../.. && pwd):$PYTHONPATH" exec ../../bin/heturun -c settings/local_s1_w2.yml \
+    python main.py --model "${1:-mlp}" --dataset CIFAR10 --comm-mode PS --timing "${@:2}"

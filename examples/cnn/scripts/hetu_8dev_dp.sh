@@ -1,0 +1,11 @@
+#!/bin/bash
+# 8-device data parallelism (reference scripts/hetu_8gpu.sh). On a real
+# v5e-8 the mesh is the 8 chips; off-TPU this provisions a virtual 8-CPU
+# mesh — same program either way (GSPMD inserts the gradient allreduce).
+cd "$(dirname "$0")/.." || exit 1
+if [ -z "$TPU_CHIPS" ]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="--xla_force_host_platform_device_count=8 $XLA_FLAGS"
+fi
+python main.py --model "${1:-resnet18}" --dataset CIFAR10 \
+    --comm-mode AllReduce --validate --timing "${@:2}"

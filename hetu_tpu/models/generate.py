@@ -178,6 +178,7 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
     flattened (B*K) batch dim each step."""
     assert cfg.n_experts == 0 and cfg.causal
     assert max_len <= cfg.max_seq_len
+    assert beam_size >= 1
     K = beam_size
 
     def beam(params, prompt):
@@ -195,15 +196,18 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
             kc, vc = carry
             tok = jax.lax.dynamic_index_in_dim(prompt, t, 1, keepdims=False)
             logits, kc, vc = _one_token_logits(params, cfg, tok, kc, vc, t)
-            return (kc, vc), logits
+            del logits  # only the LAST position's logits matter; stacking
+            return (kc, vc), None  # (P, B, V) would be a large dead buffer
 
-        (kc, vc), pre_logits = jax.lax.scan(pre, (kc, vc), jnp.arange(P))
+        (kc, vc), _ = jax.lax.scan(pre, (kc, vc), jnp.arange(P - 1))
+        last_logits, kc, vc = _one_token_logits(
+            params, cfg, prompt[:, P - 1], kc, vc, P - 1)
 
         # first expansion: top-min(K, V) continuations of the prompt seed
         # the beams; with K > V the surplus beams start dead (-inf) and get
         # claimed by real candidates at the next expansion (this is what
         # makes K >= V^n exhaustive)
-        logp0 = jax.nn.log_softmax(pre_logits[-1].astype(jnp.float32), -1)
+        logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), -1)
         k0 = min(K, V)
         scores, first_tok = jax.lax.top_k(logp0, k0)           # (B, k0)
         if k0 < K:
@@ -244,8 +248,8 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
         (toks, scores, _, _), _ = jax.lax.scan(
             step, (toks, scores, kcache, vcache),
             jnp.arange(P, max_len - 1))
-        order = jnp.argsort(-scores, axis=1)
-        return (jnp.take_along_axis(toks, order[..., None], 1),
-                jnp.take_along_axis(scores, order, 1))
+        # already best-first: every top_k (first expansion and each decode
+        # step) returns descending scores
+        return toks, scores
 
     return jax.jit(beam)
