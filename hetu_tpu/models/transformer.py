@@ -150,6 +150,14 @@ def _constrain(x, mesh, *spec):
 # forward
 # ---------------------------------------------------------------------------
 
+def _dropout(x, rate, rng):
+    """Inverted dropout; identity when rate == 0 or rng is None (eval)."""
+    if rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def _layer_norm(x, scale, bias, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, -1, keepdims=True)
@@ -276,16 +284,23 @@ def _moe_mlp(h, p, cfg: TransformerConfig, mesh):
     return out.reshape(B, T, D), aux
 
 
-def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None):
+def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None,
+           dropout_rng=None):
     h = _constrain(h, mesh, "dp", "sp", None)
     attn_in = _layer_norm(h, layer_params["ln1_scale"], layer_params["ln1_bias"])
-    h = h + _attention(attn_in, layer_params, cfg, mesh, attn_bias)
+    attn_out = _attention(attn_in, layer_params, cfg, mesh, attn_bias)
+    if dropout_rng is not None:
+        k1, k2 = jax.random.split(dropout_rng)
+        attn_out = _dropout(attn_out, cfg.dropout_rate, k1)
+    h = h + attn_out
     h = _constrain(h, mesh, "dp", "sp", None)
     mlp_in = _layer_norm(h, layer_params["ln2_scale"], layer_params["ln2_bias"])
     if cfg.n_experts > 0:
         out, aux = _moe_mlp(mlp_in, layer_params, cfg, mesh)
     else:
         out, aux = _dense_mlp(mlp_in, layer_params, cfg, mesh), jnp.zeros((), jnp.float32)
+    if dropout_rng is not None:
+        out = _dropout(out, cfg.dropout_rate, k2)
     return h + out, aux
 
 
@@ -309,36 +324,44 @@ def nll_loss(logits, targets):
 
 
 def encode(params, h, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-           attn_bias=None):
+           attn_bias=None, dropout_rng=None):
     """Run the block stack on embedded input h (B, T, D) -> (h, aux_sum).
     The trunk shared by the causal LM and the bidirectional encoder (BERT);
     ``attn_bias`` (a padding mask, constant across layers) is a scan
-    constant via closure."""
+    constant via closure. ``dropout_rng``: training-time dropout when
+    ``cfg.dropout_rate > 0`` — omit for deterministic eval."""
     block_fn = functools.partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
         block_fn = jax.checkpoint(block_fn)
+    L = cfg.n_layers
 
-    def scan_body(carry, layer_params):
+    def scan_body(carry, xs):
         h, aux_sum = carry
-        h, aux = block_fn(h, layer_params, attn_bias=attn_bias)
+        layer_params, li = xs
+        rng = (None if dropout_rng is None
+               else jax.random.fold_in(dropout_rng, li))
+        h, aux = block_fn(h, layer_params, attn_bias=attn_bias,
+                          dropout_rng=rng)
         return (h, aux_sum + aux), None
 
     (h, aux_sum), _ = jax.lax.scan(
-        scan_body, (h, jnp.zeros((), jnp.float32)), params["blocks"])
+        scan_body, (h, jnp.zeros((), jnp.float32)),
+        (params["blocks"], jnp.arange(L)))
     return h, aux_sum
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+            dropout_rng=None):
     """tokens (B, T) int32 -> logits (B, T, V)."""
     h = embed_tokens(params, tokens, cfg)
     h = _constrain(h, mesh, "dp", "sp", None)
-    h, aux_sum = encode(params, h, cfg, mesh)
+    h, aux_sum = encode(params, h, cfg, mesh, dropout_rng=dropout_rng)
     return lm_head(params, h), aux_sum
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
-            aux_weight=0.01):
-    logits, aux = forward(params, tokens, cfg, mesh)
+            aux_weight=0.01, dropout_rng=None):
+    logits, aux = forward(params, tokens, cfg, mesh, dropout_rng=dropout_rng)
     return nll_loss(logits, targets) + aux_weight * aux
 
 
@@ -375,12 +398,21 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     leading accumulation axis (A, B, T); microbatch grads are averaged by a
     ``lax.scan`` (one compiled block, sequential activation memory) before
     the single optimizer apply, numerically identical to one big batch of
-    A*B under mean-loss."""
+    A*B under mean-loss.
 
-    def step(params, opt_state, tokens, targets):
+    ``cfg.dropout_rate > 0``: the step takes a trailing ``dropout_rng``
+    argument (pass a fresh fold of your training key each step)."""
+    use_dropout = cfg.dropout_rate > 0.0
+
+    def step(params, opt_state, tokens, targets, dropout_rng=None):
+        if use_dropout:
+            # a forgotten key must not silently train WITHOUT dropout
+            assert dropout_rng is not None, (
+                "cfg.dropout_rate > 0: pass dropout_rng to the train step")
         if accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
-                                                      targets, cfg, mesh)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets, cfg, mesh,
+                dropout_rng=dropout_rng)
         else:
             assert tokens.shape[0] == accum_steps, (
                 f"leading (accumulation) axis {tokens.shape[0]} != "
@@ -388,23 +420,33 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 
             def micro(carry, xs):
                 loss_sum, gsum = carry
-                tok, tgt = xs
+                tok, tgt, mi = xs
+                rng = (None if dropout_rng is None
+                       else jax.random.fold_in(dropout_rng, mi))
                 l, g = jax.value_and_grad(loss_fn)(params, tok, tgt, cfg,
-                                                   mesh)
+                                                   mesh, dropout_rng=rng)
                 return (loss_sum + l,
                         jax.tree.map(jnp.add, gsum, g)), None
 
             zeros = jax.tree.map(jnp.zeros_like, params)
             (loss_sum, gsum), _ = jax.lax.scan(
                 micro, (jnp.zeros((), jnp.float32), zeros),
-                (tokens, targets))
+                (tokens, targets, jnp.arange(accum_steps)))
             loss = loss_sum / accum_steps
             grads = jax.tree.map(lambda g: g / accum_steps, gsum)
         new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
         return loss, new_params, new_opt
 
+    if not use_dropout:
+        # keep the historical 4-arg signature for deterministic configs
+        det = lambda params, opt_state, tokens, targets: step(  # noqa: E731
+            params, opt_state, tokens, targets)
+        step_fn = det
+    else:
+        step_fn = step
+
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step_fn, donate_argnums=(0, 1))
 
     specs = param_specs(cfg)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
@@ -413,9 +455,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                  "t": NamedSharding(mesh, P())}
     data_shard = NamedSharding(mesh, P(("dp",), None) if accum_steps == 1
                                else P(None, ("dp",), None))
+    in_sh = (pshard, opt_shard, data_shard, data_shard)
+    if use_dropout:
+        in_sh = in_sh + (NamedSharding(mesh, P()),)   # replicated rng key
     return jax.jit(
-        step,
-        in_shardings=(pshard, opt_shard, data_shard, data_shard),
+        step_fn,
+        in_shardings=in_sh,
         out_shardings=(NamedSharding(mesh, P()), pshard, opt_shard),
         donate_argnums=(0, 1),
     )

@@ -158,3 +158,42 @@ def test_grad_accumulation_matches_big_batch():
                                                         rel=1e-5)
     for a, b in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_dropout_trains_and_eval_is_deterministic():
+    """cfg.dropout_rate > 0: the step takes a dropout_rng; same key -> same
+    loss, different keys -> different losses; eval (no rng) is
+    deterministic and ignores the rate; rate=0 path keeps the historical
+    4-arg signature."""
+    cfg = tiny_cfg(n_layers=2, max_seq_len=8, remat=True, dropout_rate=0.3)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, 64, (4, 8)), jnp.int32)
+    tgt = jnp.roll(tok, -1, 1)
+    p0 = tfm.init_params(jax.random.PRNGKey(0), cfg)
+
+    step = tfm.make_train_step(cfg, lr=1e-2)
+    la, _, _ = step(jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0),
+                    tok, tgt, jax.random.PRNGKey(1))
+    lb, _, _ = step(jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0),
+                    tok, tgt, jax.random.PRNGKey(1))
+    lc, _, _ = step(jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0),
+                    tok, tgt, jax.random.PRNGKey(2))
+    assert float(la) == float(lb)          # same mask
+    assert float(la) != float(lc)          # different mask
+
+    # eval: no rng -> deterministic, identical to the rate=0 model
+    e1, _ = tfm.forward(p0, tok, cfg)
+    e2, _ = tfm.forward(p0, tok, tiny_cfg(n_layers=2, max_seq_len=8,
+                                          remat=True))
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-6)
+
+    # a short dropout-on training run still learns
+    params, opt = p0, tfm.init_opt_state(p0)
+    key = jax.random.PRNGKey(3)
+    first = None
+    for i in range(30):
+        key, sub = jax.random.split(key)
+        loss, params, opt = step(params, opt, tok, tgt, sub)
+        if i == 0:
+            first = float(loss)
+    assert float(loss) < first
