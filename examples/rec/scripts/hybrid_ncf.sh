@@ -1,0 +1,13 @@
+#!/bin/bash
+# NCF in Hybrid mode: dense on-device, embeddings via PS (reference
+# examples/rec/hybrid_ncf.sh)
+cd "$(dirname "$0")/.." || exit 1
+cat > /tmp/ncf_cluster.yml <<'YML'
+nodes:
+  - host: localhost
+    servers: 1
+    workers: 2
+    chief: true
+YML
+PYTHONPATH="$(cd ../.. && pwd):$PYTHONPATH" exec ../../bin/heturun \
+    -c /tmp/ncf_cluster.yml python run_hetu.py --comm Hybrid "$@"

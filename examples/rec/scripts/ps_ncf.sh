@@ -1,0 +1,12 @@
+#!/bin/bash
+# NCF through a PS cluster (reference examples/rec/ps_ncf.sh)
+cd "$(dirname "$0")/.." || exit 1
+cat > /tmp/ncf_cluster.yml <<'YML'
+nodes:
+  - host: localhost
+    servers: 1
+    workers: 2
+    chief: true
+YML
+PYTHONPATH="$(cd ../.. && pwd):$PYTHONPATH" exec ../../bin/heturun \
+    -c /tmp/ncf_cluster.yml python run_hetu.py --comm PS "$@"

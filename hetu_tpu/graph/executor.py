@@ -61,6 +61,10 @@ class HetuConfig:
         self.comm_mode = comm_mode
         self.bsp = bsp
         self.prefetch = prefetch
+        # accepted for API parity, no behavioral switch here: the PS path
+        # ALWAYS stages sparse row pulls (the reference's False mode pulls
+        # whole tables — strictly worse on TPU), and logging goes through
+        # the standard logger rather than a file path
         self.use_sparse_pull = use_sparse_pull
         self.cstable_policy = cstable_policy
         self.cache_bound = cache_bound

@@ -398,7 +398,9 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     leading accumulation axis (A, B, T); microbatch grads are averaged by a
     ``lax.scan`` (one compiled block, sequential activation memory) before
     the single optimizer apply, numerically identical to one big batch of
-    A*B under mean-loss.
+    A*B under mean-loss (with dropout OFF; each microbatch draws its own
+    dropout mask, so the dropout-on accumulation is the usual
+    independent-masks estimate, not a big-batch replica).
 
     ``cfg.dropout_rate > 0``: the step takes a trailing ``dropout_rng``
     argument (pass a fresh fold of your training key each step)."""

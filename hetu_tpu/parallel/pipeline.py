@@ -60,6 +60,12 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     pp = mesh.shape["pp"]
     M = num_microbatches
     assert cfg.n_layers % pp == 0
+    # the pipeline path does not thread dropout rngs through the stage
+    # scan; refuse rather than silently train unregularized (the same
+    # invariant make_train_step asserts per step)
+    assert cfg.dropout_rate == 0.0, (
+        "pipeline training does not support dropout yet — set "
+        "dropout_rate=0 or use make_train_step")
 
     def stage_fn(h, stage_blocks):
         """Run this device's layers over one microbatch activation."""
