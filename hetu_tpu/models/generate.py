@@ -84,6 +84,27 @@ def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
     return logits, kcache, vcache
 
 
+def _check_decode_args(cfg: tfm.TransformerConfig, max_len: int,
+                       top_k: int) -> None:
+    assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
+    assert cfg.causal, "decode is autoregressive — causal configs only"
+    assert max_len <= cfg.max_seq_len
+    assert 0 <= top_k <= cfg.vocab_size, (
+        f"top_k {top_k} out of range [0, vocab_size={cfg.vocab_size}]")
+
+
+def _next_token(logits, rng, sample: bool, top_k: int, temperature):
+    """Greedy argmax or (top-k) temperature sampling -> (B,) int32. The
+    ONE implementation shared by the scan and while_loop decode paths."""
+    if not sample:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    return jax.random.categorical(rng, scaled, -1).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=32)
 def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                      sample: bool = False, top_k: int = 0,
@@ -101,11 +122,7 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
     cache is sharded batch-over-``dp`` and heads-over-``tp``, and GSPMD
     inserts the same collectives as training. Decode never gathers the
     weights."""
-    assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
-    assert cfg.causal, "decode is autoregressive — causal configs only"
-    assert max_len <= cfg.max_seq_len
-    assert 0 <= top_k <= cfg.vocab_size, (
-        f"top_k {top_k} out of range [0, vocab_size={cfg.vocab_size}]")
+    _check_decode_args(cfg, max_len, top_k)
 
     cache_sharding = None
     if mesh is not None:
@@ -129,15 +146,7 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
             logits, kcache, vcache = _one_token_logits(
                 params, cfg, tok, kcache, vcache, t)
             key, sub = jax.random.split(key)
-            if sample:
-                scaled = logits / temperature
-                if top_k > 0:
-                    kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
-                    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-                nxt = jax.random.categorical(sub, scaled, -1)
-            else:
-                nxt = jnp.argmax(logits, -1)
-            nxt = nxt.astype(jnp.int32)
+            nxt = _next_token(logits, sub, sample, top_k, temperature)
             # teacher-force while the NEXT position is still in the prompt,
             # and never write past the end (the final step's sample has no
             # slot — its logits are still returned)
@@ -169,6 +178,65 @@ def generate(params, cfg: tfm.TransformerConfig, prompt, max_len: int,
 
 
 @functools.lru_cache(maxsize=32)
+def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
+                         eos_id: int, sample: bool = False,
+                         top_k: int = 0):
+    """EOS-aware decode: a ``lax.while_loop`` that EXITS EARLY once every
+    row has emitted ``eos_id`` — data-dependent control flow the
+    compiler-friendly way (the fixed-length scan path pays for max_len
+    steps regardless; this pays only for the longest row). Finished rows
+    keep emitting eos. Returns (tokens (B, max_len) — tail filled with
+    eos — and n_steps actually executed)."""
+    _check_decode_args(cfg, max_len, top_k)
+    assert 0 <= eos_id < cfg.vocab_size, (
+        f"eos_id {eos_id} outside vocab [0, {cfg.vocab_size}) — the model "
+        "could never emit it and the loop would never exit early")
+
+    def gen(params, prompt, key, temperature=1.0):
+        B, P = prompt.shape
+        assert P <= max_len
+        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        vcache = jnp.zeros_like(kcache)
+        padded = jnp.full((B, max_len), eos_id, jnp.int32)
+        padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
+        finished = jnp.zeros((B,), bool)
+
+        def cond(state):
+            t, _, _, _, _, finished = state
+            # finished can only be set past the prompt, so this single
+            # clause also keeps the teacher-forced prefix running
+            return jnp.logical_and(t < max_len - 1,
+                                   jnp.logical_not(jnp.all(finished)))
+
+        def body(state):
+            t, tok_seq, kcache, vcache, key = state[:5]
+            finished = state[5]
+            tok = jax.lax.dynamic_index_in_dim(tok_seq, t, 1, keepdims=False)
+            logits, kcache, vcache = _one_token_logits(
+                params, cfg, tok, kcache, vcache, t)
+            key, sub = jax.random.split(key)
+            nxt = _next_token(logits, sub, sample, top_k, temperature)
+            in_prompt = (t + 1) < P
+            cur_next = jax.lax.dynamic_index_in_dim(tok_seq, t + 1, 1,
+                                                    keepdims=False)
+            nxt = jnp.where(in_prompt, cur_next, nxt)
+            nxt = jnp.where(finished, eos_id, nxt)   # finished rows: eos
+            finished = jnp.logical_or(
+                finished,
+                jnp.logical_and(jnp.logical_not(in_prompt), nxt == eos_id))
+            tok_seq = jax.lax.dynamic_update_slice(tok_seq, nxt[:, None],
+                                                   (0, t + 1))
+            return (t + 1, tok_seq, kcache, vcache, key, finished)
+
+        t, tok_seq, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), padded, kcache, vcache, key, finished))
+        return tok_seq, t
+
+    return jax.jit(gen)
+
+
+@functools.lru_cache(maxsize=32)
 def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
                         beam_size: int):
     """Returns jitted ``(params, prompt (B, P) int32) ->
@@ -176,8 +244,7 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
     total log-probability of the generated suffix. Same one-scan KV-cache
     machinery as sampling; beam reordering gathers the cache along the
     flattened (B*K) batch dim each step."""
-    assert cfg.n_experts == 0 and cfg.causal
-    assert max_len <= cfg.max_seq_len
+    _check_decode_args(cfg, max_len, 0)
     assert beam_size >= 1
     K = beam_size
 

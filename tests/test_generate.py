@@ -155,3 +155,33 @@ def test_beam_scores_are_consistent_and_sorted():
                 np.asarray(logits, np.float64), -1))
             want = sum(lp[0, t - 1, seq[0, t]] for t in range(P, M))
             assert s[b, k] == pytest.approx(want, abs=1e-3), (b, k)
+
+
+def test_eos_decode_matches_scan_and_exits_early():
+    """EOS while_loop decode must equal the fixed-length scan decode up to
+    each row's first generated EOS (then pad with EOS), and must execute
+    FEWER steps than max_len when every row finishes early."""
+    params = tfm.init_params(jax.random.PRNGKey(9), CFG)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, CFG.vocab_size, (4, 3)).astype(np.int32)
+    M = 16
+
+    full = gen.generate(params, CFG, prompt, max_len=M)   # greedy scan
+    # choose as EOS the most common token greedy emits -> early finishes
+    gen_part = full[:, 3:]
+    eos = int(np.bincount(gen_part.ravel()).argmax())
+
+    fn = gen.make_eos_generate_fn(CFG, max_len=M, eos_id=eos)
+    toks, steps = fn(params, jnp.asarray(prompt), jax.random.PRNGKey(0))
+    toks = np.asarray(toks)
+
+    for b in range(4):
+        row_full = full[b]
+        hit = np.where(row_full[3:] == eos)[0]
+        end = (3 + hit[0] + 1) if len(hit) else M
+        np.testing.assert_array_equal(toks[b, :end], row_full[:end])
+        assert np.all(toks[b, end:] == eos)
+    if all(np.any(full[b, 3:] == eos) for b in range(4)):
+        last_eos = max((3 + np.where(full[b, 3:] == eos)[0][0])
+                       for b in range(4))
+        assert int(steps) <= last_eos + 1 < M   # genuinely exited early
