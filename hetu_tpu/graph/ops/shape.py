@@ -167,7 +167,9 @@ def broadcast_shape_op(node, shape, add_axes=(), ctx=None):
             y = jnp.expand_dims(y, ax)
         return jnp.broadcast_to(y, shape)
 
-    return FunctionalOp("BroadcastShape", _bc, [node], ctx)
+    op = FunctionalOp("BroadcastShape", _bc, [node], ctx)
+    op.export_attrs = {"shape": shape, "add_axes": add_axes}
+    return op
 
 
 def reduce_sum_op(node, axes, keepdims=False, ctx=None):

@@ -257,6 +257,35 @@ def _broadcast(ctx, op, ins, out):
     ctx.add_node("Expand", [ins[0], sname], [out])
 
 
+@handles("BroadcastShape")
+def _broadcast_shape(ctx, op, ins, out):
+    """Static-shape broadcast: optional Reshape (inserting the add_axes 1s)
+    then Expand with the target shape as an initializer. Imports back as
+    broadcast_shape_op (onnx2hetu's static-shape Expand path)."""
+    a = op.export_attrs
+    cur = ins[0]
+    if a["add_axes"]:
+        in_shape = ctx.shape(op.inputs[0])
+        if in_shape is None:
+            raise NotImplementedError(
+                f"{op.name}: exporting BroadcastShape with add_axes needs "
+                "the input rank; pass input_shapes to export()")
+        # mirror jnp.expand_dims applied sequentially over sorted axes,
+        # including negative axes (position = ndim + 1 + ax)
+        shape_list = list(in_shape)
+        for ax in sorted(a["add_axes"]):
+            pos = ax if ax >= 0 else len(shape_list) + 1 + ax
+            shape_list.insert(pos, 1)
+        rname = ctx.fresh(out + "_unsq")
+        rshape = ctx.add_initializer(np.asarray(shape_list, np.int64),
+                                     out + "_unsq_shape")
+        ctx.add_node("Reshape", [cur, rshape], [rname])
+        cur = rname
+    sname = ctx.add_initializer(np.asarray(a["shape"], np.int64),
+                                out + "_shape")
+    ctx.add_node("Expand", [cur, sname], [out])
+
+
 @handles("Conv2dBroadcastTo")
 def _conv_broadcast(ctx, op, ins, out):
     # (C,) bias -> (N,C,H,W): reshape to (1,C,1,1) then Expand to x's shape

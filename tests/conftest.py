@@ -36,3 +36,25 @@ def feed_helper(shape=None, val=None, seed=0, name="x"):
     if val is None:
         val = np.random.RandomState(seed).randn(*shape).astype(np.float32)
     return node, val
+
+
+def import_example_models(example):
+    """Import examples/<example>/models under the bare name ``models``,
+    purging any previously-imported zoo (cnn/ctr both use the name).
+    Shared by test_models / test_ctr_models / test_onnx."""
+    import importlib
+    import sys
+    path = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "examples",
+        example))
+    target = os.path.join(path, "models")
+    current = sys.modules.get("models")
+    if current is not None and \
+            os.path.normpath(os.path.dirname(current.__file__)) != target:
+        for k in [k for k in sys.modules
+                  if k == "models" or k.startswith("models.")]:
+            sys.modules.pop(k)
+    if path in sys.path:
+        sys.path.remove(path)
+    sys.path.insert(0, path)
+    return importlib.import_module("models")

@@ -14,24 +14,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 from test_ps import run_cluster
 
 
-def _import_example_models(example):
-    """Import examples/<example>/models under the bare name ``models``,
-    purging any previously-imported zoo (cnn/ctr both use the name)."""
-    import importlib
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "..", "examples", example)
-    path = os.path.normpath(path)
-    target = os.path.join(path, "models")
-    current = sys.modules.get("models")
-    if current is not None and \
-            os.path.normpath(os.path.dirname(current.__file__)) != target:
-        for k in [k for k in sys.modules
-                  if k == "models" or k.startswith("models.")]:
-            sys.modules.pop(k)
-    if path in sys.path:
-        sys.path.remove(path)
-    sys.path.insert(0, path)
-    return importlib.import_module("models")
+from conftest import import_example_models as _import_example_models
 
 
 DIM = 500  # small feature dimension for synthetic runs

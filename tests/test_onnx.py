@@ -68,6 +68,10 @@ CASES = {
     "where": lambda x, y: ht.where_op(ht.relu_op(x), x, y),
     "pad": lambda x, y: ht.pad_op(x, [(1, 1), (0, 2)]),
     "sqrt": lambda x, y: ht.sqrt_op(ht.mul_op(x, x)),
+    "broadcast_shape": lambda x, y: ht.broadcast_shape_op(
+        x, (2, 4, 6), add_axes=(0,)),
+    "broadcast_shape_neg_axis": lambda x, y: ht.broadcast_shape_op(
+        x, (4, 6, 3), add_axes=(-1,)),
 }
 
 
@@ -254,4 +258,27 @@ def test_transformer_block_roundtrip(tmp_path):
                      input_shapes={x: xv.shape, mask: maskv.shape})
     in_map, outs = onnx2hetu.load(path)
     (imported,) = _run(outs, {in_map["x"]: xv, in_map["mask"]: maskv})
+    np.testing.assert_allclose(orig, imported, rtol=1e-4, atol=1e-5)
+
+
+def test_vit_roundtrip(tmp_path):
+    """Full ViT forward (patch conv, [CLS] BroadcastShape concat, MHA
+    blocks, LayerNorm, slice head) survives export -> import."""
+    from conftest import import_example_models
+    vit = import_example_models("cnn").vit
+
+    B = 2
+    xv = RNG.randn(B, 3, 32, 32).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[RNG.randint(0, 10, B)]
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    loss, probs = vit(x, y_, 10, batch=B, d=32, heads=2, layers=2, dff=48)
+    ex = ht.Executor([probs], ctx=ht.cpu(0))
+    (orig,) = ex.run("default", feed_dict={x: xv, y_: yv},
+                     convert_to_numpy_ret_vals=True)
+
+    path = str(tmp_path / "vit.onnx")
+    hetu2onnx.export(ex, [x], [probs], path, input_shapes={x: xv.shape})
+    in_map, outs = onnx2hetu.load(path)
+    (imported,) = _run(outs, {in_map["x"]: xv})
     np.testing.assert_allclose(orig, imported, rtol=1e-4, atol=1e-5)
