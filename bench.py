@@ -21,6 +21,7 @@ baseline = our round-1 f32 measurement (4929.1 samples/s on v5e-1).
 import contextlib
 import json
 import os
+import signal
 import sys
 import time
 
@@ -132,6 +133,30 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15):
     return tokens / dt, dt * 1000, _mfu(flops, dt), n_params
 
 
+def bench_decode(batch=8, prompt_len=16, max_len=256):
+    """KV-cache greedy decode throughput on the 38M flagship (inference
+    side of the north star; one compiled scan, hard-synced)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models import transformer as tfm
+    from hetu_tpu.models import generate as gen
+
+    cfg = tfm.TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
+                                n_layers=8, d_ff=2048, max_seq_len=512)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    fn = gen.make_generate_fn(cfg, max_len=max_len)
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+    toks, _ = fn(params, prompt, jax.random.PRNGKey(0))   # compile
+    np.asarray(toks)
+    t0 = time.time()
+    toks, _ = fn(params, prompt, jax.random.PRNGKey(1))
+    np.asarray(toks)
+    dt = time.time() - t0
+    new_tokens = batch * (max_len - prompt_len)
+    return new_tokens / dt, dt / (max_len - prompt_len) * 1000
+
+
 def bench_transformer(warmup=3, iters=20):
     import jax
     import jax.numpy as jnp
@@ -213,27 +238,143 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
         return out
 
 
-def main():
+def _run_section(name):
+    """Child mode: compute ONE section, print one JSON object, exit.
+    Runs in its own process so a hung compile (degraded tunnel) can be
+    killed from outside — SIGALRM cannot interrupt a stuck C call."""
+    out = {}
+    if name.startswith("resnet:"):
+        _, bs, tag = name.split(":")
+        dtype = None if tag == "f32" else "bfloat16"
+        sps, ms, mfu = bench_resnet18(batch_size=int(bs), dtype=dtype)
+        out = {"samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
+               "mfu": round(mfu, 4) if mfu else None}
+    elif name == "twin":
+        _import_models("cnn")
+        import jax_twin
+        tsps, tms = jax_twin.bench(batch_size=512, dtype="bf16")
+        out = {"samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
+    elif name == "transformer":
+        toks, tms, tmfu = bench_transformer()
+        out = {"tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
+               "mfu_6nd": round(tmfu, 4) if tmfu else None}
+    elif name == "decode":
+        dtoks, dms = bench_decode()
+        out = {"tokens_per_sec": round(dtoks, 0),
+               "ms_per_token": round(dms, 3)}
+    elif name == "bert":
+        toks, tms, tmfu, n_params = bench_bert()
+        out = {"tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
+               "mfu_6nd": round(tmfu, 4) if tmfu else None,
+               "n_params": n_params}
+    elif name == "probe":
+        import jax
+        import jax.numpy as jnp
+        x = jnp.ones((512, 512))
+        out = {"ok": float(jnp.sum(jax.jit(lambda a: a @ a)(x))) > 0}
+    elif name == "wdl":
+        out = bench_wdl_ps()
+        out["servers"] = 2
+    else:
+        raise SystemExit(f"unknown section {name}")
     import jax
+    out["_device"] = str(jax.devices()[0].device_kind)
+    print(json.dumps(out))
 
-    detail = {"device": str(jax.devices()[0].device_kind),
-              "assumed_peak_tflops": PEAK_TFLOPS}
 
-    headline = 0.0
-    grid = [(128, None, "f32"), (128, "bfloat16", "bf16"),
-            (256, None, "f32"), (256, "bfloat16", "bf16"),
-            (512, "bfloat16", "bf16")]
-    for bs, dtype, tag in grid:
+def _section_subprocess(name, timeout):
+    """Run one section in a child process group with a hard timeout. The
+    whole GROUP is killed on timeout — the wdl section spawns a PS
+    scheduler/server that must not outlive a killed child (and whose open
+    pipes would otherwise stall communicate() after a child crash)."""
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__), "--run-section", name]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__)),
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
         try:
-            sps, ms, mfu = bench_resnet18(batch_size=bs, dtype=dtype)
-            detail[f"resnet18_{tag}_bs{bs}"] = {
-                "samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
-                "mfu": round(mfu, 4) if mfu else None}
-            headline = max(headline, sps)
-        except Exception as e:  # noqa: BLE001
-            # a failed cell must not kill the bench: the best surviving
-            # cell becomes the headline
-            detail[f"resnet18_{tag}_bs{bs}"] = {"error": str(e)[:200]}
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        return {"error": f"timed out after {timeout}s (hung compile?)"}
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()[-3:]
+        return {"error": f"rc={proc.returncode}: " + " | ".join(tail)[:300]}
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue   # progress noise that merely looks like JSON
+    return {"error": "no JSON line from section"}
+
+
+def main():
+    # the parent NEVER touches jax: a hung backend must not stall the
+    # driver's one-JSON-line contract
+    detail = {"assumed_peak_tflops": PEAK_TFLOPS}
+    headline = 0.0
+    consecutive_timeouts = 0
+
+    # cheap canary first: a dead tunnel is detected in one 180s probe
+    # instead of burning two full section timeouts
+    sections = [("_probe", "probe", 180),
+                ("resnet18_f32_bs128", "resnet:128:f32", 420),
+                ("resnet18_bf16_bs128", "resnet:128:bf16", 420),
+                ("resnet18_f32_bs256", "resnet:256:f32", 420),
+                ("resnet18_bf16_bs256", "resnet:256:bf16", 420),
+                ("resnet18_bf16_bs512", "resnet:512:bf16", 420)]
+    if "--fast" not in sys.argv:
+        sections += [("jax_native_twin_bf16_bs512", "twin", 420),
+                     ("transformer_38M_seq512", "transformer", 420),
+                     ("decode_38M_greedy", "decode", 420),
+                     ("bert_base_pretrain_seq512", "bert", 600),
+                     ("wdl_criteo_hybrid_ps", "wdl", 600)]
+
+    for key, name, timeout in sections:
+        if name == "probe":
+            out = _section_subprocess(name, timeout)
+            if "error" in out:
+                consecutive_timeouts = 2   # backend dead: skip everything
+                detail["_probe"] = out
+            else:
+                dev = out.pop("_device", None)
+                if dev:
+                    detail["device"] = dev
+            continue
+        if consecutive_timeouts >= 2:
+            # the tunnel is dead; do not burn the remaining budget
+            detail[key] = {"error": "skipped: backend unresponsive"}
+            continue
+        out = _section_subprocess(name, timeout)
+        if "error" in out:
+            # only hangs count toward "unresponsive" — an rc!=0 child DID
+            # run, so the backend is alive
+            if "timed out" in out["error"]:
+                consecutive_timeouts += 1
+            else:
+                consecutive_timeouts = 0
+        else:
+            consecutive_timeouts = 0
+            dev = out.pop("_device", None)
+            if dev and "device" not in detail:
+                detail["device"] = dev
+            if name.startswith("resnet:") and "samples_per_sec" in out:
+                headline = max(headline, out["samples_per_sec"])
+        detail[key] = out
+
     if headline == 0.0:
         # nothing survived — make it unmistakably a failure, not a
         # catastrophic-regression-shaped measurement
@@ -242,41 +383,6 @@ def main():
                           "unit": "samples/sec/chip", "vs_baseline": None,
                           "detail": detail}))
         sys.exit(1)
-
-    skip_extras = "--fast" in sys.argv
-    if not skip_extras:
-        try:
-            # in-repo A/B twin (VERDICT weak#7): same model, pure JAX, no
-            # framework — executor overhead = twin/executor ratio
-            _import_models("cnn")  # dedup-inserts examples/cnn on sys.path
-            import jax_twin
-            tsps, tms = jax_twin.bench(batch_size=512, dtype="bf16")
-            detail["jax_native_twin_bf16_bs512"] = {
-                "samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
-        except Exception as e:  # noqa: BLE001
-            detail["jax_native_twin_bf16_bs512"] = {"error": str(e)[:200]}
-        try:
-            toks, tms, tmfu = bench_transformer()
-            detail["transformer_38M_seq512"] = {
-                "tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
-                "mfu_6nd": round(tmfu, 4) if tmfu else None}
-        except Exception as e:  # noqa: BLE001 — partial bench beats no bench
-            detail["transformer_38M_seq512"] = {"error": str(e)[:200]}
-        try:
-            toks, tms, tmfu, n_params = bench_bert()
-            detail["bert_base_pretrain_seq512"] = {
-                "tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
-                "mfu_6nd": round(tmfu, 4) if tmfu else None,
-                "n_params": n_params}
-        except Exception as e:  # noqa: BLE001
-            detail["bert_base_pretrain_seq512"] = {"error": str(e)[:200]}
-        try:
-            wdl = bench_wdl_ps()
-            wdl["servers"] = 2
-            detail["wdl_criteo_hybrid_ps"] = wdl
-        except Exception as e:  # noqa: BLE001
-            detail["wdl_criteo_hybrid_ps"] = {"error": str(e)[:200]}
-
     vs = headline / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
     print(json.dumps({
         "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
@@ -288,4 +394,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--run-section" in sys.argv:
+        _run_section(sys.argv[sys.argv.index("--run-section") + 1])
+    else:
+        main()
