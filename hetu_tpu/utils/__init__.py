@@ -1,6 +1,8 @@
 """Shared utilities for examples, tests and the driver entry points."""
 from __future__ import annotations
 
+import os
+
 import jax
 
 
@@ -15,6 +17,14 @@ def ensure_devices(n_devices: int) -> None:
     live, and a sitecustomize may pin another platform, so the config updates
     are authoritative, not env vars.
     """
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # honor an explicit CPU request BEFORE the first jax.devices() call:
+        # the sitecustomize pins the tunneled platform, whose backend INIT
+        # can hang outright when the tunnel is down (observed 2026-07-30) —
+        # the driver's CPU-mesh dryrun must never depend on tunnel health.
+        # (if a backend is already live this update is a silent no-op; the
+        # device-count check below handles that case)
+        jax.config.update("jax_platforms", "cpu")
     if len(jax.devices()) >= n_devices:
         return
     import jax.extend.backend as jax_backend
