@@ -37,7 +37,7 @@ from ..ndarray import DLContext, NDArray, ND_Sparse_Array, SparseValue, cpu, tpu
 from .node import Op, PlaceholderOp, find_topo_sort
 from .gradients import gradients, GradientOp, GradientContext
 from .ops.comm import AllReduceCommunicateOp, DispatchOp, PipelineSendOp, PipelineReceiveOp
-from .ops.ps import ParameterServerCommunicateOp
+from .ops.ps import ParameterServerCommunicateOp, ParameterServerSparsePullOp
 
 _NO_OUTPUT = "<no-output>"
 _PS_RESIDENT = "<ps-resident-parameter>"
@@ -232,9 +232,14 @@ class TraceContext:
         program output; the host pushes it to the server post-step (the
         reference instead issues the RPC from the interpreter on the d2h
         stream, ParameterServerCommunicate.py:38-50)."""
-        if hasattr(grad, "dtype") and grad.dtype != jnp.float32:
-            grad = grad.astype(jnp.float32)  # PS stores/accumulates f32
-        self.ps_grad_outputs[id(op)] = grad
+        def f32(g):
+            if hasattr(g, "dtype") and g.dtype != jnp.float32:
+                return g.astype(jnp.float32)  # PS stores/accumulates f32
+            return g
+
+        # a shared-table gradient arrives as a tuple of per-lookup row grads
+        self.ps_grad_outputs[id(op)] = (
+            tuple(f32(g) for g in grad) if isinstance(grad, tuple) else f32(grad))
         return None
 
     def ps_sparse_pull(self, op, vals):
@@ -372,6 +377,11 @@ class SubExecutor:
                         f"PS-hosted lookup {op.name!r}: the index input "
                         f"{idx_node.name!r} must be a feed or dataloader "
                         "node (its value is needed host-side to pull rows)")
+        # staged lookups grouped by table: a shared table (several lookup
+        # ops) pulls the union of its indices once per step
+        self._staged_by_table: dict[int, list] = {}
+        for op in self.ps_staged_ops:
+            self._staged_by_table.setdefault(id(op.embed_node), []).append(op)
 
         # -- device-resident datasets (TPU infeed design) -------------------
         # A small, sequential (no shuffle/func, drop_last) dataset uploads to
@@ -409,6 +419,19 @@ class SubExecutor:
         opt_tokens = tuple(n.optimizer.cache_token() for n in self.optimizer_nodes)
         return (tuple(sig(v) for v in feed_vals),
                 tuple(sig(v) for v in batch_vals), opt_tokens)
+
+    @staticmethod
+    def _push_idx(op, staged_idx):
+        """Index argument for one PS grad push: None (dense), one array
+        (single lookup), or a tuple of per-lookup arrays (shared table —
+        the runtime concatenates and dedup-sums, matching the reference's
+        IndexedSlices accumulation)."""
+        lks = getattr(op, "staged_lookups", None)
+        if not lks:
+            return None
+        if len(lks) == 1:
+            return staged_idx[id(lks[0])]
+        return tuple(staged_idx[id(lk)] for lk in lks)
 
     def _host_value(self, node, feed_dict, batch_host):
         """Host-side numpy value of a feed/dataloader node (pre device_put)."""
@@ -600,17 +623,37 @@ class SubExecutor:
             self._dl_cursor[id(n)] = cur + 1
 
         # -- PS pre-step: pull this batch's embedding rows ------------------
+        # Lookups are grouped by table: a table feeding several lookup ops
+        # (shared CTR embeddings) pulls the UNION of its row indices once,
+        # then distributes rows to each lookup — one RPC instead of k.
         ps = ex.ps_runtime
         staged_idx: dict[int, np.ndarray] = {}
-        ps_staged_vals = []
-        for op in self.ps_staged_ops:
-            idx = self._host_value(op.inputs[1], feed_dict, batch_host)
-            staged_idx[id(op)] = idx
-            p = ps.params[id(op.embed_node)]
-            rows = ps.take_prefetched(id(op), idx) if ps.async_enabled else None
-            if rows is None:
-                rows = ps.stage_lookup(p, idx)
-            ps_staged_vals.append(ex._prepare_input(rows))
+        staged_rows: dict[int, np.ndarray] = {}
+        for tid, ops in self._staged_by_table.items():
+            p = ps.params[tid]
+            for op in ops:
+                staged_idx[id(op)] = self._host_value(op.inputs[1], feed_dict,
+                                                      batch_host)
+            if len(ops) == 1:
+                op = ops[0]
+                idx = staged_idx[id(op)]
+                rows = (ps.take_prefetched(id(op), idx)
+                        if ps.async_enabled else None)
+                if rows is None:
+                    rows = ps.stage_lookup(p, idx)
+                staged_rows[id(op)] = rows
+            else:
+                flat = [np.ascontiguousarray(staged_idx[id(op)],
+                                             np.int64).ravel() for op in ops]
+                union = np.unique(np.concatenate(flat))
+                urows = ps.stage_lookup(p, union)          # (U, *tail)
+                tail = tuple(p.shape[1:])
+                for op, f in zip(ops, flat):
+                    pos = np.searchsorted(union, f)
+                    staged_rows[id(op)] = urows[pos].reshape(
+                        tuple(np.shape(staged_idx[id(op)])) + tail)
+        ps_staged_vals = [ex._prepare_input(staged_rows[id(op)])
+                          for op in self.ps_staged_ops]
         ps_dense_vals = []
         for n in self.ps_dense_vars:
             p = ps.params[id(n)]
@@ -663,18 +706,18 @@ class SubExecutor:
             items = []
             for op, grad in zip(self.ps_comm_ops, ps_grads):
                 p = ps.params[id(op.ps_param_node)]
-                idx = (staged_idx[id(op.staged_lookup)]
-                       if getattr(op, "staged_lookup", None) is not None
-                       else None)
+                idx = self._push_idx(op, staged_idx)
                 items.append((p, grad, idx))
             if items:
                 ps.push_grads_async(items, step)
-            # prefetch pulls for batch N+1 (dataloader-fed lookups only):
+            # prefetch pulls for batch N+1 (dataloader-fed lookups only, and
+            # only single-lookup tables — shared tables ride the union pull):
             # issued now, so under ASP they overlap this step's compute and
             # its pushes — the reference's prefetch-stream semantics
             for op in self.ps_staged_ops:
                 idx_node = op.inputs[1]
-                if idx_node in self.dataloader_nodes \
+                if len(self._staged_by_table[id(op.embed_node)]) == 1 \
+                        and idx_node in self.dataloader_nodes \
                         and hasattr(idx_node, "peek_batch"):
                     nxt = np.asarray(idx_node.peek_batch(self.name))
                     ps.prefetch_lookup(id(op), ps.params[id(op.embed_node)],
@@ -682,10 +725,8 @@ class SubExecutor:
         else:
             for op, grad in zip(self.ps_comm_ops, ps_grads):
                 p = ps.params[id(op.ps_param_node)]
-                idx = (staged_idx[id(op.staged_lookup)]
-                       if getattr(op, "staged_lookup", None) is not None
-                       else None)
-                ps.push_grad(p, np.asarray(grad), idx, step=step)
+                idx = self._push_idx(op, staged_idx)
+                ps.push_grad(p, grad, idx, step=step)
 
         if self.training:
             for node, val in zip(ex.param_nodes, new_params):
@@ -819,6 +860,7 @@ class Executor:
         """Point each PS comm op's gradient at the lookup OUTPUT rather than
         the table variable, so the traced grad is (batch_rows, width) instead
         of a full-table scatter (the reference's IndexedSlices analogue)."""
+        loss_topo_ids: dict[int, set] = {}  # per-loss memo for this pass
         for node in topo:
             if not isinstance(node, ParameterServerCommunicateOp):
                 continue
@@ -832,20 +874,48 @@ class Executor:
             node.ps_param_node = var
             if not p.sparse:
                 continue  # dense PS params are fed whole; grad wrt var is fine
-            if len(p.lookup_ops) != 1:
-                raise NotImplementedError(
-                    f"PS-hosted embedding {var.name!r} feeds "
-                    f"{len(p.lookup_ops)} lookup ops; exactly one is "
-                    "supported per table (split the table or share the "
-                    "lookup node)")
-            lookup = p.lookup_ops[0]
-            node.staged_lookup = lookup
-            grad_node.x = lookup
-            grad_node.inputs = [grad_node.gctx.loss, lookup]
+            # Scope to lookups on THIS gradient's loss graph: the table may
+            # also feed other eval targets (a validate head with its own
+            # lookup node) whose rows are staged by their own subexecutor and
+            # never produce gradients. Inference-only sparse pulls are not
+            # differentiation targets either (their zero grads would corrupt
+            # stateful server-optimizer rows).
+            loss = grad_node.gctx.loss
+            loss_ids = loss_topo_ids.get(id(loss))
+            if loss_ids is None:
+                loss_ids = {id(n) for n in find_topo_sort([loss])}
+                loss_topo_ids[id(loss)] = loss_ids
+            lookups = [lk for lk in p.lookup_ops
+                       if id(lk) in loss_ids
+                       and not isinstance(lk, ParameterServerSparsePullOp)]
+            if not lookups:
+                raise ValueError(
+                    f"PS-hosted embedding {var.name!r} has a gradient but no "
+                    "lookup op reads it on the loss graph — sparse PS tables "
+                    "are only trainable through embedding_lookup_op")
+            node.staged_lookups = lookups
             xs = grad_node.gctx.xs
-            for i, x in enumerate(xs):
-                if x is var:
-                    xs[i] = lookup
+            if len(lookups) == 1:
+                lookup = lookups[0]
+                grad_node.x = lookup
+                grad_node.inputs = [grad_node.gctx.loss, lookup]
+                for i, x in enumerate(xs):
+                    if x is var:
+                        xs[i] = lookup
+            else:
+                # one table, k lookups (the reference accumulates the grads
+                # as IndexedSlices, optimizer.py:64-82): differentiate wrt
+                # EACH lookup output; the push path concatenates the per-
+                # lookup (rows, width) grads and dedup-sums before the RPC
+                grad_node.x = lookups[0]
+                grad_node.multi_x = lookups
+                grad_node.inputs = [grad_node.gctx.loss] + lookups
+                for i, x in enumerate(xs):
+                    if x is var:
+                        xs[i] = lookups[0]
+                for lk in lookups[1:]:
+                    if all(x is not lk for x in xs):
+                        xs.append(lk)
 
     def _prepare_input(self, value, batch=True):
         """Stage one host value onto the device/mesh.

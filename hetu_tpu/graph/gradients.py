@@ -42,7 +42,13 @@ class GradientContext:
 
 class GradientOp(Op):
     """d(loss)/d(x) for one x. Inputs = [loss, x] so topo ordering places the
-    full forward graph before the gradient is needed."""
+    full forward graph before the gradient is needed.
+
+    ``multi_x``: when the executor rewires a PS-table gradient onto SEVERAL
+    lookup outputs (one shared table feeding k lookup ops, the reference's
+    IndexedSlices accumulation — optimizer.py:64-82), the node produces a
+    TUPLE of per-lookup row gradients instead of one array; the PS push path
+    concatenates and dedup-sums them host-side."""
 
     is_gradient = True
 
@@ -50,9 +56,12 @@ class GradientOp(Op):
         super().__init__([gctx.loss, x], ctx=x.raw_ctx)
         self.gctx = gctx
         self.x = x
+        self.multi_x = None
         self.name = f"Gradient({x.name})"
 
     def compute(self, input_vals, tc):
+        if self.multi_x is not None:
+            return tuple(tc.gradient_of(self.gctx, x) for x in self.multi_x)
         return tc.gradient_of(self.gctx, self.x)
 
 

@@ -300,8 +300,18 @@ class PSRuntime:
         return dest.reshape(tuple(idx.shape) + tuple(p.shape[1:]))
 
     def stage_lookup(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
-        """Pull the batch's rows (reference EmbeddingLookUp.py:27-40)."""
+        """Pull the batch's rows (reference EmbeddingLookUp.py:27-40).
+
+        When async I/O is on, the pull rides the pull STREAM instead of
+        running inline: under BSP the pull stream is the push stream, so the
+        pull queues behind this worker's in-flight pushes (and the barrier) —
+        a direct inline pull could read rows the step-N pushes haven't
+        reached yet. Covers prefetch misses, feed-fed lookups, and the
+        shared-table union pull alike."""
         self.perf["sync_pulls"] += 1
+        if self.async_enabled:
+            return self._io_pull.submit(
+                lambda: self._pull_rows(p, idx)).result()
         return self._pull_rows(p, idx)
 
     def prefetch_lookup(self, key: int, p: PSParam, idx: np.ndarray):
@@ -335,13 +345,30 @@ class PSRuntime:
     # ------------------------------------------------------------------
     # post-step: push gradients
     # ------------------------------------------------------------------
-    def _push_one(self, p: PSParam, grad: np.ndarray,
-                  idx: Optional[np.ndarray], step: int):
+    def _push_one(self, p: PSParam, grad, idx, step: int):
         opt = self._server_opt
         if p.sparse:
             width = int(np.prod(p.shape[1:]))
-            flat_idx = np.ascontiguousarray(idx, dtype=np.int64).ravel()
-            g = np.asarray(grad, np.float32).reshape(flat_idx.size, width)
+            if isinstance(grad, (tuple, list)):
+                # shared table: concatenate the per-lookup row grads/indices
+                # (the reference's IndexedSlices accumulation)
+                flat_idx = np.concatenate(
+                    [np.ascontiguousarray(i, np.int64).ravel() for i in idx])
+                g = np.concatenate(
+                    [np.asarray(gi, np.float32).reshape(-1, width)
+                     for gi in grad], axis=0)
+            else:
+                flat_idx = np.ascontiguousarray(idx, dtype=np.int64).ravel()
+                g = np.asarray(grad, np.float32).reshape(flat_idx.size, width)
+            # dedup-sum duplicate rows host-side: a stateful server optimizer
+            # (momentum/adagrad/adam) must see ONE summed grad per row per
+            # step, not one state update per occurrence; for prescaled SGD
+            # this is equivalent and just shrinks the RPC
+            uniq, inv = np.unique(flat_idx, return_inverse=True)
+            if uniq.size != flat_idx.size:
+                acc = np.zeros((uniq.size, width), np.float32)
+                np.add.at(acc, inv, g)
+                flat_idx, g = uniq, acc
             if opt["prescale"]:
                 g = -self._prescale_lr(step) * g
             if p.cache is not None:
@@ -378,7 +405,7 @@ class PSRuntime:
 
         def _do():
             for p, grad, idx in items:
-                self._push_one(p, np.asarray(grad), idx, step)
+                self._push_one(p, grad, idx, step)
             if self.bsp:
                 self.comm.BarrierWorker()
             self.perf["async_pushes"] += len(items)

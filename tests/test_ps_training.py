@@ -205,6 +205,104 @@ def _bsp_prefetch_off(client, rank, tmpdir):
     _bsp_prefetch_losses(client, rank, tmpdir, prefetch=False)
 
 
+def _shared_table_two_lookups(client, rank, tmpdir):
+    """One PS table feeding TWO lookup ops (shared CTR embedding) must train
+    identically to the single-lookup refactoring (lookup on the concatenated
+    index sets) — the reference accumulates such grads as IndexedSlices
+    (optimizer.py:64-82). Momentum runs server-side, so this also proves the
+    host-side dedup-sum: the optimizer state must advance once per row per
+    step regardless of how many lookups/slots referenced the row."""
+    import os
+    import hetu_tpu as ht
+    S1, S2 = 2, 3
+    rng0 = np.random.RandomState(11)
+    table0 = rng0.randn(NROWS, WIDTH).astype(np.float32) * 0.1
+    w0 = rng0.randn((S1 + S2) * WIDTH, 1).astype(np.float32) * 0.3
+
+    def build(shared):
+        embed = ht.Variable(name="embed", value=table0.copy(), is_embed=True)
+        y_ = ht.Variable(name="y_", trainable=False)
+        if shared:
+            i1 = ht.Variable(name="i1", trainable=False)
+            i2 = ht.Variable(name="i2", trainable=False)
+            v1 = ht.embedding_lookup_op(embed, i1)      # (B, S1, W)
+            v2 = ht.embedding_lookup_op(embed, i2)      # (B, S2, W)
+            flat = ht.concat_op(
+                ht.array_reshape_op(v1, (-1, S1 * WIDTH)),
+                ht.array_reshape_op(v2, (-1, S2 * WIDTH)), axis=1)
+            feeds = (i1, i2)
+        else:
+            ic = ht.Variable(name="ic", trainable=False)
+            vec = ht.embedding_lookup_op(embed, ic)     # (B, S1+S2, W)
+            flat = ht.array_reshape_op(vec, (-1, (S1 + S2) * WIDTH))
+            feeds = (ic,)
+        w = ht.Variable(name="w", value=w0.copy())
+        prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+        loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+        opt = ht.optim.MomentumOptimizer(0.1, momentum=0.9)
+        train_op = opt.minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         comm_mode="Hybrid")
+        return ex, feeds, y_, embed
+
+    os.environ["HETU_PS_ID_BASE"] = "0"
+    exA, feedsA, yA, embA = build(shared=True)
+    os.environ["HETU_PS_ID_BASE"] = "100"
+    exB, feedsB, yB, embB = build(shared=False)
+
+    rng = np.random.RandomState(7)
+    for step in range(12):
+        # duplicate rows across (and within) the two index sets on purpose
+        i1 = rng.randint(0, NROWS, (BATCH, S1)).astype(np.float32)
+        i2 = rng.randint(0, NROWS, (BATCH, S2)).astype(np.float32)
+        by = (rng.rand(BATCH, 1) > 0.5).astype(np.float32)
+        la = exA.run("train", feed_dict={feedsA[0]: i1, feedsA[1]: i2,
+                                         yA: by})[0].asnumpy()
+        lb = exB.run("train", feed_dict={
+            feedsB[0]: np.concatenate([i1, i2], axis=1), yB: by})[0].asnumpy()
+        np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"step {step}")
+    pA = exA.ps_runtime.params[id(embA)]
+    pB = exB.ps_runtime.params[id(embB)]
+    rows = np.arange(NROWS)
+    ta = exA.ps_runtime.pull_sparse_rows(pA, rows)
+    tb = exB.ps_runtime.pull_sparse_rows(pB, rows)
+    np.testing.assert_allclose(ta, tb, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(ta, table0)  # the table actually trained
+
+    # cross-target: the same table ALSO feeds a validate head through its
+    # own lookup node. Only the train-graph lookup may become a gradient
+    # target (the validate lookup stages rows but never pushes grads).
+    os.environ["HETU_PS_ID_BASE"] = "200"
+    embed = ht.Variable(name="embed2", value=table0.copy(), is_embed=True)
+    it = ht.Variable(name="it", trainable=False)
+    iv = ht.Variable(name="iv", trainable=False)
+    y2 = ht.Variable(name="y2", trainable=False)
+    wt = ht.Variable(name="wt", value=w0[:S1 * WIDTH].copy())
+    flat_t = ht.array_reshape_op(ht.embedding_lookup_op(embed, it),
+                                 (-1, S1 * WIDTH))
+    prob_t = ht.sigmoid_op(ht.matmul_op(flat_t, wt))
+    loss_t = ht.reduce_mean_op(ht.binarycrossentropy_op(prob_t, y2), [0])
+    train2 = ht.optim.MomentumOptimizer(0.1, momentum=0.9).minimize(loss_t)
+    flat_v = ht.array_reshape_op(ht.embedding_lookup_op(embed, iv),
+                                 (-1, S1 * WIDTH))
+    prob_v = ht.sigmoid_op(ht.matmul_op(flat_v, wt))
+    ex2 = ht.Executor({"train": [loss_t, train2], "validate": [prob_v]},
+                      ctx=ht.cpu(0), comm_mode="Hybrid")
+    for _ in range(3):
+        i1 = rng.randint(0, NROWS, (BATCH, S1)).astype(np.float32)
+        by = (rng.rand(BATCH, 1) > 0.5).astype(np.float32)
+        l2 = ex2.run("train", feed_dict={it: i1, y2: by})[0].asnumpy()
+        assert np.isfinite(l2)
+    pv = ex2.run("validate", feed_dict={
+        iv: rng.randint(0, NROWS, (BATCH, S1)).astype(np.float32)})[0].asnumpy()
+    assert np.all(np.isfinite(pv))
+
+
+def test_shared_table_two_lookups(tmp_path):
+    run_cluster(_shared_table_two_lookups, tmp_path, n_workers=1, timeout=300)
+
+
 def test_prefetch_overlap(tmp_path):
     run_cluster(_prefetch_overlap, tmp_path, n_workers=1, timeout=300)
 
