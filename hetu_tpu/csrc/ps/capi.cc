@@ -196,6 +196,13 @@ void Push(int node, const float* grad, long len) {
   guard([&] { worker().push(node, grad, static_cast<size_t>(len)); });
 }
 
+// Per-step optimizer overrides for subsequent pushes of `node`:
+// lr(step) schedule value, l2 regularization, decoupled weight decay.
+// lr < 0 with l2reg == wd == 0 clears the override.
+void SetPushOpts(int node, float lr, float l2reg, float weight_decay) {
+  guard([&] { worker().set_push_opts(node, lr, l2reg, weight_decay); });
+}
+
 void Pull(int node, float* out, long len) {
   guard([&] { worker().pull(node, out, static_cast<size_t>(len)); });
 }

@@ -161,6 +161,24 @@ class PsServer {
   }
 
   // ---------------------------------------------------------------------
+  // Optional per-request optimizer overrides: push messages may carry a
+  // trailing f32 [lr, l2reg, weight_decay] arg beyond their base arg count
+  // (store.h UpdateOpts) — how workers honor lr schedules and l2/weight
+  // decay on stateful server-side optimizers.
+  static UpdateOpts parse_opts(const Message& req, size_t base_args) {
+    UpdateOpts uo;
+    if (req.args.size() > base_args) {
+      const Arg& a = req.args[base_args];
+      if (a.dtype == ArgType::kF32 && a.n_f32() >= 3) {
+        const float* f = a.as_f32();
+        uo.lr = f[0];
+        uo.l2reg = f[1];
+        uo.weight_decay = f[2];
+      }
+    }
+    return uo;
+  }
+
   void handle(Message& req, Message* rsp) {
     const auto type = static_cast<PsfType>(req.head.type);
     const int32_t key = req.head.tensor_id;
@@ -203,7 +221,8 @@ class PsServer {
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
         begin_update(*p);
-        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32());
+        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
+                     parse_opts(req, 1));
         break;
       }
       case PsfType::kDensePull: {
@@ -218,7 +237,8 @@ class PsServer {
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
         begin_update(*p);
-        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32());
+        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
+                     parse_opts(req, 1));
         rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
         break;
       }
@@ -231,10 +251,11 @@ class PsServer {
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
         begin_update(*p);
+        const UpdateOpts uo = parse_opts(req, 2);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
-                       vals + i * p->width, p->width);
+                       vals + i * p->width, p->width, uo);
         break;
       }
       case PsfType::kSparsePull: {
@@ -261,10 +282,11 @@ class PsServer {
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
         begin_update(*p);
+        const UpdateOpts uo = parse_opts(req, 2);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
-                       vals + i * p->width, p->width);
+                       vals + i * p->width, p->width, uo);
         rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
         break;
       }
@@ -282,10 +304,11 @@ class PsServer {
         check_rows(*p, idx, nidx);
         check_rows(*p, oidx, no);
         begin_update(*p);
+        const UpdateOpts uo = parse_opts(req, 3);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
-                       vals + i * p->width, p->width);
+                       vals + i * p->width, p->width, uo);
         std::vector<float> out(no * p->width);
         for (size_t i = 0; i < no; ++i)
           std::memcpy(out.data() + i * p->width,

@@ -101,44 +101,73 @@ inline void begin_update(Param& p) {
   if (p.otype == OptType::kAdam) p.step += 1;
 }
 
-inline void apply_update(Param& p, size_t off, const float* grad, size_t n) {
+// Per-REQUEST optimizer overrides, carried as an optional trailing f32 arg
+// [lr, l2reg, weight_decay] on push messages. Lets workers honor lr
+// schedules on stateful server optimizers (the init-time p.lrs[0] is only a
+// fallback) and apply l2 regularization / decoupled weight decay against
+// the CURRENT server value under the param lock — matching the device
+// path's grad + l2reg*w (optimizer.py apply_gradient) and AdamW's
+// w -= lr*wd*w. lr < 0 means "not provided".
+struct UpdateOpts {
+  float lr = -1.0f;
+  float l2reg = 0.0f;
+  float weight_decay = 0.0f;
+};
+
+inline void apply_update(Param& p, size_t off, const float* grad, size_t n,
+                         const UpdateOpts& uo = {}) {
   float* w = p.data.data() + off;
+  const float l2 = uo.l2reg;
   switch (p.otype) {
     case OptType::kSGD: {
-      for (size_t i = 0; i < n; ++i) w[i] += grad[i];
+      // grads arrive pre-scaled by -lr (worker-side schedule); the l2 term
+      // needs an explicit lr — the per-request one if provided, else the
+      // init-time fallback (consistent with the stateful optimizers below)
+      if (l2 != 0.0f) {
+        const float lr = uo.lr >= 0.0f ? uo.lr
+                                       : (p.lrs.empty() ? 0.01f : p.lrs[0]);
+        const float s = lr * l2;
+        for (size_t i = 0; i < n; ++i) w[i] += grad[i] - s * w[i];
+      } else {
+        for (size_t i = 0; i < n; ++i) w[i] += grad[i];
+      }
       break;
     }
     case OptType::kMomentum:
     case OptType::kNesterov: {
-      const float lr = p.lrs.empty() ? 0.01f : p.lrs[0];
+      const float lr = uo.lr >= 0.0f ? uo.lr
+                                     : (p.lrs.empty() ? 0.01f : p.lrs[0]);
       const float mom = p.lrs.size() > 1 ? p.lrs[1] : 0.9f;
       float* v = p.accum.data() + off;
       if (p.otype == OptType::kMomentum) {
         for (size_t i = 0; i < n; ++i) {
-          v[i] = mom * v[i] - lr * grad[i];
+          v[i] = mom * v[i] - lr * (grad[i] + l2 * w[i]);
           w[i] += v[i];
         }
       } else {
         for (size_t i = 0; i < n; ++i) {
           float prev = v[i];
-          v[i] = mom * v[i] - lr * grad[i];
+          v[i] = mom * v[i] - lr * (grad[i] + l2 * w[i]);
           w[i] += -mom * prev + (1.0f + mom) * v[i];
         }
       }
       break;
     }
     case OptType::kAdaGrad: {
-      const float lr = p.lrs.empty() ? 0.01f : p.lrs[0];
+      const float lr = uo.lr >= 0.0f ? uo.lr
+                                     : (p.lrs.empty() ? 0.01f : p.lrs[0]);
       const float eps = p.lrs.size() > 1 ? p.lrs[1] : 1e-7f;
       float* a = p.accum.data() + off;
       for (size_t i = 0; i < n; ++i) {
-        a[i] += grad[i] * grad[i];
-        w[i] -= lr * grad[i] / (std::sqrt(a[i]) + eps);
+        const float g = grad[i] + l2 * w[i];
+        a[i] += g * g;
+        w[i] -= lr * g / (std::sqrt(a[i]) + eps);
       }
       break;
     }
     case OptType::kAdam: {
-      const float lr = p.lrs.empty() ? 0.01f : p.lrs[0];
+      const float lr = uo.lr >= 0.0f ? uo.lr
+                                     : (p.lrs.empty() ? 0.01f : p.lrs[0]);
       const float b1 = p.lrs.size() > 1 ? p.lrs[1] : 0.9f;
       const float b2 = p.lrs.size() > 2 ? p.lrs[2] : 0.999f;
       const float eps = p.lrs.size() > 3 ? p.lrs[3] : 1e-7f;
@@ -147,9 +176,14 @@ inline void apply_update(Param& p, size_t off, const float* grad, size_t n) {
       float* m = p.accum.data() + off;
       float* v = p.accum2.data() + off;
       for (size_t i = 0; i < n; ++i) {
-        m[i] = b1 * m[i] + (1.0f - b1) * grad[i];
-        v[i] = b2 * v[i] + (1.0f - b2) * grad[i] * grad[i];
+        const float w_old = w[i];
+        const float g = grad[i] + l2 * w_old;
+        m[i] = b1 * m[i] + (1.0f - b1) * g;
+        v[i] = b2 * v[i] + (1.0f - b2) * g * g;
         w[i] -= lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + eps);
+        // decoupled weight decay (AdamW) against the PRE-update value —
+        // mirrors optimizer.py's new_param -= lr * weight_decay * param
+        if (uo.weight_decay != 0.0f) w[i] -= lr * uo.weight_decay * w_old;
       }
       break;
     }

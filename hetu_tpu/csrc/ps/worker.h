@@ -9,6 +9,7 @@
 #pragma once
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <deque>
@@ -235,6 +236,28 @@ class PsWorker {
     }
   }
 
+  // -- per-step optimizer overrides --------------------------------------
+  // [lr, l2reg, weight_decay] attached as a trailing f32 arg to this
+  // tensor's subsequent push RPCs (server parse_opts -> store.h UpdateOpts).
+  // How lr schedules + regularization reach stateful SERVER-side optimizers:
+  // the worker refreshes lr(step) before each step's pushes. lr < 0 with
+  // zero l2/wd clears the override.
+  void set_push_opts(int32_t key, float lr, float l2reg, float wd) {
+    std::lock_guard<std::mutex> g(opts_mu_);
+    if (lr < 0.0f && l2reg == 0.0f && wd == 0.0f)
+      push_opts_.erase(key);
+    else
+      push_opts_[key] = {lr, l2reg, wd};
+  }
+
+  bool get_push_opts(int32_t key, std::array<float, 3>* out) {
+    std::lock_guard<std::mutex> g(opts_mu_);
+    auto it = push_opts_.find(key);
+    if (it == push_opts_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
   const TensorMeta& meta(int32_t key) {
     std::lock_guard<std::mutex> g(meta_mu_);
     auto it = metas_.find(key);
@@ -258,6 +281,8 @@ class PsWorker {
   void push(int32_t key, const float* grad, size_t len) {
     auto m = meta(key);
     check_len(m, key, len);
+    std::array<float, 3> uo;
+    const bool has_uo = get_push_opts(key, &uo);  // snapshot in caller thread
     pending_.add(key, static_cast<int>(servers_.size()));
     for (size_t s = 0; s < servers_.size(); ++s) {
       auto [lo, hi] = dense_range(m.len, s);
@@ -267,6 +292,7 @@ class PsWorker {
           req.head.type = static_cast<int32_t>(PsfType::kDensePush);
           req.head.tensor_id = key;
           req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           rpc(s, req);
           record("push", (hi - lo) * 4);
         });
@@ -296,6 +322,8 @@ class PsWorker {
   void dd_pushpull(int32_t key, const float* grad, float* out, size_t len) {
     auto m = meta(key);
     check_len(m, key, len);
+    std::array<float, 3> uo;
+    const bool has_uo = get_push_opts(key, &uo);
     pending_.add(key, static_cast<int>(servers_.size()));
     for (size_t s = 0; s < servers_.size(); ++s) {
       auto [lo, hi] = dense_range(m.len, s);
@@ -305,6 +333,7 @@ class PsWorker {
           req.head.type = static_cast<int32_t>(PsfType::kDDPushPull);
           req.head.tensor_id = key;
           req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           Message rsp = rpc(s, req);
           std::memcpy(out + lo, rsp.args[0].as_f32(), (hi - lo) * 4);
           record("ddpushpull", (hi - lo) * 8);
@@ -370,6 +399,8 @@ class PsWorker {
       const float* src = vals + i * m.width;
       for (size_t j = 0; j < m.width; ++j) dst[j] += src[j];
     }
+    std::array<float, 3> uo;
+    const bool has_uo = get_push_opts(key, &uo);
     pending_.add(key, static_cast<int>(servers_.size()));
     auto sk_p = std::make_shared<ShardedKeys>(std::move(sk));
     for (size_t s = 0; s < servers_.size(); ++s) {
@@ -387,6 +418,7 @@ class PsWorker {
           req.head.tensor_id = key;
           req.args.push_back(Arg::i64(loc.data(), loc.size()));
           req.args.push_back(Arg::f32(shard_vals.data(), shard_vals.size()));
+          if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           rpc(s, req);
           record("sparse_push", shard_vals.size() * 4);
         });
@@ -888,6 +920,8 @@ class PsWorker {
   PendingTracker pending_;
   std::mutex meta_mu_;
   std::unordered_map<int32_t, TensorMeta> metas_;
+  std::mutex opts_mu_;
+  std::unordered_map<int32_t, std::array<float, 3>> push_opts_;
   std::atomic<query_t> next_query_{1};
   std::mutex loads_mu_;
   std::string record_dir_;

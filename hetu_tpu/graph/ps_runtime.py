@@ -183,40 +183,57 @@ class PSRuntime:
 
     # ------------------------------------------------------------------
     def _deduce_server_opt(self):
-        import warnings
+        """Map the graph optimizer onto the server-side optimizer config.
+
+        lr schedules, l2reg, and decoupled weight decay are honored through
+        PER-STEP push opts: before each step's pushes the worker refreshes
+        [lr(step), l2reg, weight_decay] on the tensor (SetPushOpts), carried
+        as a trailing arg on the push RPC and applied under the param lock
+        (store.h UpdateOpts) — reference behavior is the server applying
+        whatever lr arrives with the push (optimizer.h:15-75)."""
         for opt_node in self._opt_nodes:
             o = opt_node.optimizer
             name = type(o).__name__
             scheduled = hasattr(o.learning_rate, "get") or hasattr(
                 o.learning_rate, "get_traced")
             lr = float(o.lr_value(0))
-            if getattr(o, "l2reg", 0.0):
-                raise NotImplementedError(
-                    "l2reg is not applied server-side; PS-hosted params would "
-                    "silently skip regularization — use l2reg=0 with "
-                    "comm_mode PS/Hybrid or keep the param device-resident")
+            l2reg = float(getattr(o, "l2reg", 0.0) or 0.0)
+            wd = float(getattr(o, "weight_decay", 0.0) or 0.0)
             if name == "SGDOptimizer":
                 # prescale: the worker multiplies by -lr(step) each push, so
-                # lr schedules are honored (reference _mult_lr)
+                # lr schedules are honored (reference _mult_lr); the l2 term
+                # additionally needs the raw lr server-side, so l2reg rides
+                # the push opts (server: w += grad - lr*l2reg*w)
                 return {"otype": "sgd", "lrs": (lr,), "prescale": True,
-                        "opt": o}
-            if scheduled:
-                raise NotImplementedError(
-                    f"{name} with an lr scheduler: server-side optimizer "
-                    "state is configured once at init, so the schedule would "
-                    "be silently frozen — use SGDOptimizer (worker-side lr) "
-                    "for PS-hosted params or a fixed lr")
+                        "opt": o, "l2reg": l2reg, "wd": 0.0,
+                        "per_step": l2reg > 0.0}
+            base = None
             if name == "MomentumOptimizer":
-                return {"otype": "nesterov" if o.nesterov else "momentum",
-                        "lrs": (lr, o.momentum), "prescale": False, "opt": o}
-            if name == "AdaGradOptimizer":
-                return {"otype": "adagrad", "lrs": (lr, o.eps),
-                        "prescale": False, "opt": o}
-            if name in ("AdamOptimizer", "AdamWOptimizer"):
-                return {"otype": "adam",
-                        "lrs": (lr, o.beta1, o.beta2, o.epsilon),
-                        "prescale": False, "opt": o}
-        return {"otype": "sgd", "lrs": (0.01,), "prescale": True, "opt": None}
+                base = {"otype": "nesterov" if o.nesterov else "momentum",
+                        "lrs": (lr, o.momentum)}
+            elif name == "AdaGradOptimizer":
+                base = {"otype": "adagrad", "lrs": (lr, o.eps)}
+            elif name in ("AdamOptimizer", "AdamWOptimizer"):
+                base = {"otype": "adam",
+                        "lrs": (lr, o.beta1, o.beta2, o.epsilon)}
+            if base is not None:
+                base.update(prescale=False, opt=o, l2reg=l2reg, wd=wd,
+                            per_step=scheduled or l2reg > 0.0 or wd > 0.0)
+                if (l2reg > 0.0 or wd > 0.0) and any(
+                        p.sparse for p in self.params.values()):
+                    # lazy regularization: the server shrinks only the rows a
+                    # step pushes. Standard for sparse training, but it is a
+                    # semantic difference from a device-resident table (dense
+                    # grads regularize every row every step) — say so once.
+                    import warnings
+                    warnings.warn(
+                        "l2reg/weight_decay on PS-hosted sparse embeddings "
+                        "is LAZY: only rows present in a batch are "
+                        "regularized (device-resident tables shrink all rows "
+                        "every step)", stacklevel=3)
+                return base
+        return {"otype": "sgd", "lrs": (0.01,), "prescale": True, "opt": None,
+                "l2reg": 0.0, "wd": 0.0, "per_step": False}
 
     def _prescale_lr(self, step: int) -> float:
         o = self._server_opt.get("opt")
@@ -226,12 +243,14 @@ class PSRuntime:
 
     def _init_params(self):
         cfg = self.config
-        if cfg.cstable_policy and not self._server_opt["prescale"]:
+        if cfg.cstable_policy and (not self._server_opt["prescale"]
+                                   or self._server_opt["l2reg"] > 0.0):
             raise NotImplementedError(
-                "cstable_policy requires worker-side lr-scaled SGD: the "
-                "cache applies raw pushed grads to its local rows, which "
-                "diverges from a stateful server optimizer (the reference "
-                "has the same restriction, ParameterServerCommunicate.py)")
+                "cstable_policy requires worker-side lr-scaled SGD without "
+                "l2reg: the cache applies raw pushed grads to its local "
+                "rows, which diverges from a stateful/regularizing server "
+                "optimizer (the reference has the same restriction, "
+                "ParameterServerCommunicate.py)")
         for p in self.params.values():
             opt = self._server_opt
             if p.sparse:
@@ -345,8 +364,20 @@ class PSRuntime:
     # ------------------------------------------------------------------
     # post-step: push gradients
     # ------------------------------------------------------------------
+    def _refresh_push_opts(self, p: PSParam, step: int):
+        """Refresh this tensor's per-step [lr(step), l2reg, weight_decay]
+        push opts before the step's pushes (no-op unless the optimizer needs
+        them: schedule on a stateful server optimizer, l2reg, or AdamW wd)."""
+        opt = self._server_opt
+        if not opt.get("per_step"):
+            return
+        o = opt.get("opt")
+        lr = float(o.lr_value(step)) if o is not None else float(opt["lrs"][0])
+        self.comm.SetPushOpts(p.ps_id, lr, opt["l2reg"], opt["wd"])
+
     def _push_one(self, p: PSParam, grad, idx, step: int):
         opt = self._server_opt
+        self._refresh_push_opts(p, step)
         if p.sparse:
             width = int(np.prod(p.shape[1:]))
             if isinstance(grad, (tuple, list)):

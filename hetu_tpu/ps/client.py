@@ -31,6 +31,8 @@ def _load_lib():
     lib.getLoads.restype = ctypes.c_char_p
     lib.PushData.restype = ctypes.c_long
     lib.PullData.restype = ctypes.c_long
+    lib.SetPushOpts.argtypes = [ctypes.c_int, ctypes.c_float, ctypes.c_float,
+                                ctypes.c_float]
     lib.rank.restype = ctypes.c_int
     lib.nrank.restype = ctypes.c_int
     lib.num_servers.restype = ctypes.c_int
@@ -112,6 +114,16 @@ class PSClient:
             ctypes.c_double(float(init_b)), ctypes.c_ulonglong(int(seed)),
             ctypes.c_int(int(opt_type)), lrs_arr.ctypes.data_as(_f32p),
             ctypes.c_int(len(lrs_arr)))
+        self._check()
+
+    def SetPushOpts(self, node, lr=-1.0, l2reg=0.0, weight_decay=0.0):
+        """Attach per-step optimizer overrides [lr, l2reg, weight_decay] to
+        this tensor's subsequent pushes — how lr schedules and l2/weight
+        decay reach stateful SERVER-side optimizers (store.h UpdateOpts).
+        lr < 0 with zero l2reg/weight_decay clears the override."""
+        self._lib.SetPushOpts(ctypes.c_int(int(node)), ctypes.c_float(lr),
+                              ctypes.c_float(l2reg),
+                              ctypes.c_float(weight_decay))
         self._check()
 
     # -- dense --------------------------------------------------------------

@@ -303,6 +303,112 @@ def test_shared_table_two_lookups(tmp_path):
     run_cluster(_shared_table_two_lookups, tmp_path, n_workers=1, timeout=300)
 
 
+def _server_opt_schedule_sparse(client, rank, tmpdir):
+    """Momentum + StepScheduler on a PS-hosted embedding must match the
+    device-resident oracle exactly: the per-step lr rides the push opts
+    (SetPushOpts -> store.h UpdateOpts), so the schedule is no longer frozen
+    at init (reference: server applies whatever lr arrives with the push,
+    ps-lite optimizer.h:15-75). Every row is touched every step so device
+    (dense momentum) and server (pushed-rows-only momentum) agree."""
+    import hetu_tpu as ht
+    SLOTS_ = 4
+    B = NROWS // SLOTS_
+    rng0 = np.random.RandomState(21)
+    table0 = rng0.randn(NROWS, WIDTH).astype(np.float32) * 0.1
+    w0 = rng0.randn(SLOTS_ * WIDTH, 1).astype(np.float32) * 0.3
+
+    def build(comm_mode, **kw):
+        embed = ht.Variable(name="embed", value=table0.copy(), is_embed=True)
+        idx = ht.Variable(name="idx", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        vec = ht.embedding_lookup_op(embed, idx)
+        flat = ht.array_reshape_op(vec, (-1, SLOTS_ * WIDTH))
+        w = ht.Variable(name="w", value=w0.copy())
+        prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+        loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+        opt = ht.optim.MomentumOptimizer(
+            ht.lr.StepScheduler(0.2, step_size=3, gamma=0.5), momentum=0.9)
+        train_op = opt.minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         comm_mode=comm_mode, **kw)
+        return ex, embed, idx, y_
+
+    import os
+    os.environ["HETU_PS_ID_BASE"] = "300"
+    exP, embP, idxP, yP = build("Hybrid", bsp=True)
+    exD, embD, idxD, yD = build(None)
+
+    rng = np.random.RandomState(5)
+    for step in range(8):
+        bidx = rng.permutation(NROWS).reshape(B, SLOTS_).astype(np.float32)
+        by = (rng.rand(B, 1) > 0.5).astype(np.float32)
+        lp = exP.run("train", feed_dict={idxP: bidx, yP: by})[0].asnumpy()
+        ld = exD.run("train", feed_dict={idxD: bidx, yD: by})[0].asnumpy()
+        np.testing.assert_allclose(lp, ld, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"step {step}")
+    pP = exP.ps_runtime.params[id(embP)]
+    served = exP.ps_runtime.pull_sparse_rows(pP, np.arange(NROWS))
+    device = np.asarray(exD.state["params"][id(embD)])
+    np.testing.assert_allclose(served, device, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(served, table0)
+
+
+def _server_opt_l2_wd_dense(client, rank, tmpdir):
+    """comm_mode='PS' dense params with (a) Adam + l2reg + schedule and
+    (b) AdamW + decoupled weight decay must match device oracles: l2reg and
+    weight_decay ride the push opts and apply against the CURRENT server
+    value under the param lock."""
+    import os
+    import hetu_tpu as ht
+    rng0 = np.random.RandomState(31)
+    w0 = rng0.randn(6, 3).astype(np.float32) * 0.5
+
+    def build(opt, comm_mode, base):
+        os.environ["HETU_PS_ID_BASE"] = str(base)
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        w = ht.Variable(name="w", value=w0.copy())
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+        train_op = opt.minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         comm_mode=comm_mode)
+        return ex, x, y_, w
+
+    cases = [
+        ("adam+l2reg+schedule",
+         lambda: ht.optim.AdamOptimizer(
+             ht.lr.StepScheduler(0.05, step_size=3, gamma=0.5), l2reg=0.02)),
+        ("adamw+wd",
+         lambda: ht.optim.AdamWOptimizer(0.05, weight_decay=0.1)),
+    ]
+    rng = np.random.RandomState(9)
+    for i, (label, mk) in enumerate(cases):
+        exP, xP, yP, wP = build(mk(), "PS", 400 + 10 * i)
+        exD, xD, yD, wD = build(mk(), None, 400 + 10 * i + 5)
+        for step in range(8):
+            bx = rng.randn(BATCH, 6).astype(np.float32)
+            by = np.eye(3, dtype=np.float32)[rng.randint(0, 3, BATCH)]
+            lp = exP.run("train", feed_dict={xP: bx, yP: by})[0].asnumpy()
+            ld = exD.run("train", feed_dict={xD: bx, yD: by})[0].asnumpy()
+            np.testing.assert_allclose(
+                lp, ld, rtol=1e-5, atol=1e-6, err_msg=f"{label} step {step}")
+        served = exP.ps_runtime.pull_dense_value(
+            exP.ps_runtime.params[id(wP)])
+        device = np.asarray(exD.state["params"][id(wD)])
+        np.testing.assert_allclose(served, device, rtol=1e-4, atol=1e-5,
+                                   err_msg=label)
+
+
+def test_server_opt_schedule_sparse(tmp_path):
+    run_cluster(_server_opt_schedule_sparse, tmp_path, n_workers=1,
+                timeout=300)
+
+
+def test_server_opt_l2_wd_dense(tmp_path):
+    run_cluster(_server_opt_l2_wd_dense, tmp_path, n_workers=1, timeout=300)
+
+
 def test_prefetch_overlap(tmp_path):
     run_cluster(_prefetch_overlap, tmp_path, n_workers=1, timeout=300)
 
