@@ -60,36 +60,43 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
     pp = mesh.shape["pp"]
     M = num_microbatches
     assert cfg.n_layers % pp == 0
-    # the pipeline path does not thread dropout rngs through the stage
-    # scan; refuse rather than silently train unregularized (the same
-    # invariant make_train_step asserts per step)
-    assert cfg.dropout_rate == 0.0, (
-        "pipeline training does not support dropout yet — set "
-        "dropout_rate=0 or use make_train_step")
+    layers_per_stage = cfg.n_layers // pp
+    use_dropout = cfg.dropout_rate > 0.0
 
-    def stage_fn(h, stage_blocks):
-        """Run this device's layers over one microbatch activation."""
+    def stage_fn(h, stage_blocks, stage, rng_mb):
+        """Run this device's layers over one microbatch activation.
+
+        ``rng_mb``: this microbatch's dropout key (None when dropout is
+        off). Each layer folds in its GLOBAL index, so key(mb, layer)
+        matches the non-pipelined trunk's grad-accumulation schedule
+        (make_train_step: fold_in(rng, mi) then encode's fold_in(·, li))."""
         block = functools.partial(tfm._block, cfg=cfg, mesh=None)
         if cfg.remat:
             block = jax.checkpoint(block)
+        first_layer = stage * layers_per_stage
 
-        def body(carry, layer_params):
+        def body(carry, xs):
             h, aux = carry
-            h, a = block(h, layer_params)
+            layer_params, li = xs
+            rng = (None if rng_mb is None
+                   else jax.random.fold_in(rng_mb, first_layer + li))
+            h, a = block(h, layer_params, dropout_rng=rng)
             return (h, aux + a), None
 
         aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
-        (h, aux), _ = jax.lax.scan(body, (h, aux0), stage_blocks)
+        (h, aux), _ = jax.lax.scan(
+            body, (h, aux0), (stage_blocks, jnp.arange(layers_per_stage)))
         return h, aux
 
-    def fwd_loss(params, tokens, targets):
+    def fwd_loss(params, tokens, targets, dropout_rng=None):
         """Pipelined forward + loss, manual over pp via shard_map."""
         stage_blocks = params["blocks"]  # (1, L/pp, ...) local slice per stage
         other = {k: v for k, v in params.items() if k != "blocks"}
         B, T = tokens.shape[1], tokens.shape[2]
         state0 = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
 
-        def pipelined(stage_blocks, other, tokens, targets, state0):
+        def pipelined(stage_blocks, other, tokens, targets, state0,
+                      dropout_rng=None):
             # inside: manual over 'pp' — axis_index tells us our stage
             stage = jax.lax.axis_index("pp")
             local_blocks = jax.tree.map(lambda x: x[0], stage_blocks)
@@ -111,7 +118,13 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                     tokens, mb_idx, 0, keepdims=False)
                 inject = tfm.embed_tokens(other, mb_tokens, cfg)
                 state = jnp.where((stage == 0) & (t < M), inject, state)
-                out, aux = stage_fn(state, local_blocks)
+                # the microbatch THIS stage is working on at tick t (garbage
+                # outside the [stage, stage+M) window — its loss is never
+                # taken, so the garbage dropout key is harmless)
+                rng_mb = (None if dropout_rng is None
+                          else jax.random.fold_in(
+                              dropout_rng, jnp.clip(t - stage, 0, M - 1)))
+                out, aux = stage_fn(state, local_blocks, stage, rng_mb)
                 # this stage holds a real microbatch only during its window
                 valid = (t >= stage) & (t < stage + M)
                 aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
@@ -136,16 +149,26 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
 
         block_in_spec = jax.tree.map(lambda _: P("pp"), stage_blocks)
         other_spec = jax.tree.map(lambda _: P(), other)
+        in_specs = [block_in_spec, other_spec, P(), P(), P()]
+        args = [stage_blocks, other, tokens, targets, state0]
+        if dropout_rng is not None:
+            in_specs.append(P())
+            args.append(dropout_rng)
         return jax.shard_map(
             pipelined,
             mesh=mesh,
-            in_specs=(block_in_spec, other_spec, P(), P(), P()),
+            in_specs=tuple(in_specs),
             out_specs=P(),
             axis_names=frozenset({"pp"}),
-        )(stage_blocks, other, tokens, targets, state0)
+        )(*args)
 
-    def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(fwd_loss)(params, tokens, targets)
+    def step(params, opt_state, tokens, targets, dropout_rng=None):
+        if use_dropout:
+            # a forgotten key must not silently train WITHOUT dropout
+            assert dropout_rng is not None, (
+                "cfg.dropout_rate > 0: pass dropout_rng to the pipeline step")
+        loss, grads = jax.value_and_grad(fwd_loss)(
+            params, tokens, targets, dropout_rng=dropout_rng)
         new_params, new_opt = tfm.adamw_update(params, grads, opt_state, lr=lr)
         return loss, new_params, new_opt
 
@@ -154,9 +177,17 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                           is_leaf=lambda x: isinstance(x, P))
     opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
     data_shard = NamedSharding(mesh, P(None, "dp", None))
+    in_sh = [pshard, opt_shard, data_shard, data_shard]
+    if use_dropout:
+        step_fn = step
+        in_sh.append(NamedSharding(mesh, P()))
+    else:
+        # keep the historical 4-arg signature for deterministic configs
+        step_fn = lambda params, opt_state, tokens, targets: step(  # noqa: E731
+            params, opt_state, tokens, targets)
     return jax.jit(
-        step,
-        in_shardings=(pshard, opt_shard, data_shard, data_shard),
+        step_fn,
+        in_shardings=tuple(in_sh),
         out_shardings=(NamedSharding(mesh, P()), pshard, opt_shard),
         donate_argnums=(0, 1),
     )

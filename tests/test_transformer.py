@@ -109,6 +109,47 @@ def test_pipeline_matches_dense():
     assert losses[-1] < losses[0], losses
 
 
+def test_pipeline_dropout_matches_trunk():
+    """pp2 training WITH dropout must match the single-device trunk running
+    grad accumulation with the same key: the pipeline folds key(mb, global
+    layer) exactly like make_train_step's fold_in(rng, mi) -> encode's
+    fold_in(·, li), so losses and updated params agree step for step."""
+    cfg = tiny_cfg(dropout_rate=0.25)
+    mesh = meshlib.make_mesh(dp=4, pp=2, tp=1, sp=1, ep=1)
+    M, mb = 2, 4
+    rng = np.random.RandomState(11)
+    tokens = rng.randint(0, cfg.vocab_size, (M, mb, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=2).astype(np.int32)
+
+    p0 = tfm.init_params(jax.random.PRNGKey(7), cfg)
+    trunk = tfm.make_train_step(cfg, lr=1e-2, accum_steps=M)
+    tparams, topt = jax.tree.map(jnp.copy, p0), tfm.init_opt_state(p0)
+
+    pparams = pplib.init_pipeline_params(jax.random.PRNGKey(7), cfg, mesh)
+    popt = tfm.init_opt_state(pparams)
+    pstep = pplib.make_pipeline_train_step(cfg, mesh, num_microbatches=M,
+                                           lr=1e-2)
+    key = jax.random.PRNGKey(42)
+    for step in range(3):
+        krng = jax.random.fold_in(key, step)
+        tl, tparams, topt = trunk(tparams, topt, jnp.asarray(tokens),
+                                  jnp.asarray(targets), krng)
+        pl, pparams, popt = pstep(pparams, popt, jnp.asarray(tokens),
+                                  jnp.asarray(targets), krng)
+        np.testing.assert_allclose(float(pl), float(tl), rtol=2e-4,
+                                   err_msg=f"step {step}")
+    # updated params agree (pipeline blocks are (pp, L/pp, ...) stacked)
+    tblocks = {k: v.reshape(pparams["blocks"][k].shape)
+               for k, v in tparams["blocks"].items()}
+    for k in tblocks:
+        np.testing.assert_allclose(np.asarray(pparams["blocks"][k]),
+                                   np.asarray(tblocks[k]), atol=2e-4,
+                                   err_msg=k)
+    # a forgotten key fails loudly (jit arity or the explicit assert)
+    with pytest.raises((AssertionError, ValueError)):
+        pstep(pparams, popt, jnp.asarray(tokens), jnp.asarray(targets))
+
+
 def test_pipeline_with_moe_and_remat():
     """pp x ep x dp with remat — the combination that exercises pcast on
     every scan carry in the manual region."""
