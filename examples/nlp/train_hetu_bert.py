@@ -108,10 +108,20 @@ def main(argv=None):
             start_epoch += 1
             print(f"resumed from epoch {start_epoch - 1}", flush=True)
 
-    rng = np.random.RandomState(0)
+    if start_epoch >= args.num_epoch:
+        # resumed a run that had already finished: nothing to train, nothing
+        # to (re-)save; returns None (not an avg loss — no epoch ran)
+        if ck is not None:
+            ck.close()
+        print("training already complete; nothing to do", flush=True)
+        return None
+
     steps = max(1, n // args.batch_size)
+    tot = 0.0
     for epoch in range(start_epoch, args.num_epoch):
-        order = rng.permutation(n)
+        # per-EPOCH seed: a resumed run sees the same epoch permutations an
+        # uninterrupted run would (an advancing shared RNG would diverge)
+        order = np.random.RandomState(epoch).permutation(n)
         tot = tot_mlm = tot_nsp = 0.0
         t0 = time.time()
         for s in range(steps):
@@ -128,7 +138,8 @@ def main(argv=None):
                 (epoch + 1) % args.ckpt_every == 0:
             ck.save_step(epoch, {"params": params, "opt": opt})
     if ck is not None:
-        ck.save_step(args.num_epoch - 1, {"params": params, "opt": opt})
+        if ck.latest_step() != args.num_epoch - 1:
+            ck.save_step(args.num_epoch - 1, {"params": params, "opt": opt})
         ck.close()
     return tot / steps
 

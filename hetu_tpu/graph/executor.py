@@ -937,9 +937,16 @@ class Executor:
         mesh = self.config.mesh
         if mesh is not None:
             dp = self.config.dp_size
-            if batch and arr.ndim >= 1 and dp > 1 and arr.shape[0] % dp == 0:
-                return jax.device_put(
-                    arr, NamedSharding(mesh, P(self.config.dp_axis)))
+            if batch and arr.ndim >= 1 and dp > 1:
+                if arr.shape[0] % dp == 0:
+                    return jax.device_put(
+                        arr, NamedSharding(mesh, P(self.config.dp_axis)))
+                import warnings
+                warnings.warn(
+                    f"batch dim {arr.shape[0]} is not divisible by dp={dp}: "
+                    "the feed is REPLICATED across the dp axis instead of "
+                    "sharded (correct but slow) — pad the batch or use "
+                    "drop_last", stacklevel=3)
             return jax.device_put(arr, NamedSharding(mesh, P()))
         if self.config.device is not None:
             return jax.device_put(arr, self.config.device)

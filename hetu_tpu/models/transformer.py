@@ -168,7 +168,16 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 def _resolve_attn_impl(cfg: TransformerConfig, mesh, T, attn_bias=None):
     impl = cfg.attn_impl
     if attn_bias is not None:
-        return "dot"   # only the unfused path applies a padding-mask bias
+        # only the unfused path applies a padding-mask bias; an explicitly
+        # requested fused/ring impl must not degrade SILENTLY — masked
+        # batches materialize full (B, nh, T, T) f32 scores per layer
+        if impl not in ("auto", "dot"):
+            import warnings
+            warnings.warn(
+                f"attn_impl={impl!r} requested but a padding mask "
+                "(attn_bias) is present: falling back to the unfused 'dot' "
+                "path for masked batches", stacklevel=3)
+        return "dot"
     if impl != "auto":
         return impl
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:

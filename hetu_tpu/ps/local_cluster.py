@@ -14,6 +14,7 @@ import contextlib
 import multiprocessing
 import os
 import socket
+import shutil
 import tempfile
 import time
 
@@ -56,7 +57,10 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
-    stopfile = tempfile.mktemp(prefix="hetu_ps_stop_")
+    # signal-by-creation file inside a fresh private dir (mktemp is
+    # race-prone: the generated name can be claimed by another process)
+    stopdir = tempfile.mkdtemp(prefix="hetu_ps_stop_")
+    stopfile = os.path.join(stopdir, "stop")
     ctx = multiprocessing.get_context("spawn")
     procs = [ctx.Process(target=_sched_proc,
                          args=(port, n_workers, n_servers))]
@@ -77,5 +81,4 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
         for p in procs:
             if p.is_alive():
                 p.terminate()
-        if os.path.exists(stopfile):
-            os.unlink(stopfile)
+        shutil.rmtree(stopdir, ignore_errors=True)

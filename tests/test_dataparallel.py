@@ -61,6 +61,24 @@ def test_allreduce_matches_single_device():
     np.testing.assert_allclose(w1, w8, rtol=1e-5, atol=1e-6)
 
 
+def test_nondivisible_batch_warns_and_replicates():
+    """batch % dp != 0 must not silently replicate: a warning fires and the
+    run still computes correctly (replicated = every device sees the full
+    batch, so the result matches the single-device oracle)."""
+    xv, yv = make_data(n=13)  # 13 % 8 != 0
+    x1, y1, _, loss1, train1 = build()
+    ex1 = ht.Executor({"train": [loss1, train1]}, ctx=ht.cpu(0))
+    ref, _ = ex1.run("train", feed_dict={x1: xv, y1: yv},
+                     convert_to_numpy_ret_vals=True)
+    x, y_, w, loss, train_op = build()
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="AllReduce")
+    with pytest.warns(UserWarning, match="not divisible by dp"):
+        lv, _ = ex.run("train", feed_dict={x: xv, y_: yv},
+                       convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(lv, ref, rtol=1e-5)
+
+
 def test_allreduce_feeds_are_sharded():
     xv, yv = make_data()
     x, y_, w, loss, train_op = build()

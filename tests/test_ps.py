@@ -9,6 +9,7 @@ threads deadlocks).
 """
 import multiprocessing as mp
 import os
+import shutil
 import tempfile
 import time
 
@@ -81,7 +82,8 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
     assert every worker body passed."""
     ctx = mp.get_context("spawn")
     port = next(_port_iter)
-    stopfile = tempfile.mktemp(prefix="hetups_stop_")
+    stopdir = tempfile.mkdtemp(prefix="hetups_stop_")
+    stopfile = os.path.join(stopdir, "stop")
     result_q = ctx.Queue()
     procs = [ctx.Process(target=_run_scheduler,
                          args=(port, n_workers, n_servers))]
@@ -108,7 +110,7 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
         for p in procs:
             if p.is_alive():
                 p.terminate()
-        os.unlink(stopfile)
+        shutil.rmtree(stopdir, ignore_errors=True)
     for rank, (status, err) in sorted(results.items()):
         assert status == "ok", f"worker {rank} failed:\n{err}"
     assert len(results) == n_workers, "some workers produced no result"
