@@ -83,8 +83,10 @@ class PsServer {
 
   // Per-client resend dedup (the server half of the reference's resender.h
   // contract): a worker that resends after a lost response must not have the
-  // request applied twice. One slot per client suffices because each worker
-  // serializes its requests to one server.
+  // request applied twice. One slot per client_id suffices because each
+  // worker CHANNEL serializes its requests to one server (client_id encodes
+  // rank*2 + channel — the bulk and fast channels are independent streams
+  // with independently monotonic req_ids).
   struct ClientSlot {
     std::mutex mu;
     uint64_t last_id = 0;

@@ -144,7 +144,16 @@ class PsWorker {
     while (std::getline(ss, line)) {
       if (line.empty()) continue;
       server_addrs_.push_back(line);
+      // TWO connections per server — a BULK channel for gradient-payload
+      // messages and a FAST channel for pulls/control — so a small pull is
+      // never head-of-line-blocked behind a megabyte push on the same
+      // socket. TPU-native equivalent of the reference's priority p3 van
+      // (ps-lite/src/p3_van.h:1-71, selected at van.cc:29-42): instead of
+      // slicing big messages into priority-scheduled chunks, the two
+      // classes ride separate TCP streams served by separate server
+      // threads (per-param shared_mutex still orders conflicting applies).
       servers_.push_back(std::make_unique<Conn>(connect_addr(line)));
+      servers_fast_.push_back(std::make_unique<Conn>(connect_addr(line)));
     }
     if (servers_.empty()) throw std::runtime_error("no servers in address book");
   }
@@ -157,12 +166,14 @@ class PsWorker {
     pool_.shutdown();
     Message bye;
     bye.head.type = static_cast<int32_t>(PsfType::kShutdown);
-    for (auto& s : servers_) {
-      try {
-        s->send(bye);
-      } catch (...) {
+    for (auto* chan : {&servers_, &servers_fast_}) {
+      for (auto& s : *chan) {
+        try {
+          s->send(bye);
+        } catch (...) {
+        }
+        s->close();
       }
-      s->close();
     }
     try {
       sched_->send(bye);
@@ -763,6 +774,11 @@ class PsWorker {
   // Current address + liveness of one server, per the scheduler's heartbeat
   // ledger. Uses a fresh short-lived connection (the registered scheduler
   // connection may be parked inside a barrier).
+  std::string cached_addr(size_t server) {
+    std::lock_guard<std::mutex> g(addr_mu_);
+    return server_addrs_[server];
+  }
+
   std::pair<std::string, bool> query_server_status(size_t server) {
     try {
       Conn c(connect_to(sched_host_, sched_port_, /*retries=*/20,
@@ -773,7 +789,7 @@ class PsWorker {
       c.send(q);
       Message rsp;
       if (!c.recv(&rsp) || rsp.args.size() < 2)
-        return {server_addrs_[server], true};
+        return {cached_addr(server), true};
       std::vector<std::string> addrs;
       std::istringstream ss(rsp.args[0].as_str());
       std::string line;
@@ -786,7 +802,7 @@ class PsWorker {
       // scheduler unreachable: fall back to the cached address and let the
       // reconnect below decide
     }
-    return {server_addrs_[server], true};
+    return {cached_addr(server), true};
   }
 
   // One reliable request/response round trip (the role of the reference's
@@ -795,20 +811,53 @@ class PsWorker {
   // that rank, so a recovered server is picked up) and a RESEND — servers
   // dedup on (client_id, req_id) so a request that executed but whose
   // response was lost is not applied twice.
+  // Gradient-payload messages ride the bulk channel; pulls and small
+  // control messages ride the fast channel (see the p3-van note in the
+  // constructor). kDDPushPull is bulk on BOTH legs (grad out, full param
+  // back); raw assignments carry whole-tensor payloads too.
+  static bool is_bulk(PsfType t) {
+    switch (t) {
+      case PsfType::kDensePush:
+      case PsfType::kDDPushPull:
+      case PsfType::kSparsePush:
+      case PsfType::kSDPushPull:
+      case PsfType::kSSPushPull:
+      case PsfType::kPushEmbedding:
+      case PsfType::kPushSyncEmbedding:
+      case PsfType::kDataPush:
+      case PsfType::kParamAssign:
+      case PsfType::kParamAssignRows:
+        return true;
+      default:
+        return false;
+    }
+  }
+
   Message rpc(size_t server, Message& req) {
-    // serialize the whole round trip per server connection: concurrency
-    // comes from the pool issuing to different servers in parallel
-    std::lock_guard<std::mutex> g(server_mu_[server % kMaxServers]);
+    // serialize the whole round trip per (server, channel) connection:
+    // concurrency comes from the pool issuing to different servers — and
+    // from fast-channel requests overtaking bulk transfers
+    const int ch = is_bulk(static_cast<PsfType>(req.head.type)) ? 0 : 1;
+    auto& conns = ch == 0 ? servers_ : servers_fast_;
+    std::lock_guard<std::mutex> g(server_mu_[ch][server % kMaxServers]);
     req.head.req_id = next_req_id_.fetch_add(1);
-    req.head.client_id = rank_;
+    // per-channel client identity: the server's resend-dedup slot assumes
+    // monotonic req_ids per client, which holds per channel but not across
+    // the two interleaved channels
+    req.head.client_id = rank_ * 2 + ch;
     std::string last_err;
     for (int attempt = 0; attempt <= max_retry_; ++attempt) {
       if (attempt > 0) {
         auto st = query_server_status(server);
-        server_addrs_[server] = st.first;
+        {
+          // both channels' retry paths may relocate the same server
+          // concurrently (they hold different per-channel mutexes)
+          std::lock_guard<std::mutex> ag(addr_mu_);
+          server_addrs_[server] = st.first;
+        }
         if (!st.second && attempt == max_retry_) break;  // declared dead
         try {
-          servers_[server] = std::make_unique<Conn>(
+          conns[server] = std::make_unique<Conn>(
               connect_addr(st.first, /*retries=*/30, /*wait_ms=*/100));
         } catch (const std::exception& e) {
           last_err = e.what();
@@ -816,7 +865,7 @@ class PsWorker {
         }
       }
       try {
-        auto& conn = *servers_[server];
+        auto& conn = *conns[server];
         conn.send(req);
         Message rsp;
         if (!conn.recv(&rsp))
@@ -829,7 +878,7 @@ class PsWorker {
         std::string what = e.what();
         if (what.rfind("server error:", 0) == 0) throw;  // app-level: no retry
         last_err = what;
-        servers_[server]->close();
+        conns[server]->close();
       }
     }
     throw std::runtime_error(
@@ -913,9 +962,11 @@ class PsWorker {
   std::atomic<uint64_t> next_req_id_{1};
   std::unique_ptr<Conn> sched_;
   std::mutex sched_mu_;
+  std::mutex addr_mu_;   // guards server_addrs_ (both channels' retries)
   std::vector<std::string> server_addrs_;
-  std::vector<std::unique_ptr<Conn>> servers_;
-  std::mutex server_mu_[kMaxServers];
+  std::vector<std::unique_ptr<Conn>> servers_;       // bulk channel
+  std::vector<std::unique_ptr<Conn>> servers_fast_;  // pulls/control channel
+  std::mutex server_mu_[2][kMaxServers];
   ThreadPool pool_;
   PendingTracker pending_;
   std::mutex meta_mu_;

@@ -1,0 +1,82 @@
+"""Worker process for the 4-process dp2 x tp2 multi-host test (not collected
+by pytest).
+
+Four processes with ONE virtual CPU device each form a (dp=2, tp=2) mesh
+whose tp groups SPAN processes (devices are enumerated process-major, so the
+tp pairs are (p0, p1) and (p2, p3)). A linear model with the weight sharded
+over tp columns and the batch over dp trains against a single-process numpy
+GD oracle — covering rank arithmetic (per-group batch feeding, cross-process
+tp collectives) that a 2-process world cannot exercise.
+
+Reference scale-out story: 2-node 16-GPU dp x mp worlds via mpirun
+(``runner.py:204,250-265``, ``communicator/mpi_nccl_comm.py:54-152``).
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    assert nproc == 4
+    from hetu_tpu.parallel import multihost as mh
+
+    assert mh.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid,
+                         local_device_count=1)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 4 and jax.device_count() == 4
+    devs = np.array(jax.devices()).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+
+    B, DIN, DOUT = 8, 4, 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(B, DIN).astype(np.float32)
+    W_true = rng.randn(DIN, DOUT).astype(np.float32)
+    Y = X @ W_true
+
+    # this process's dp group feeds its half of the batch (both tp peers in
+    # a group feed the SAME rows — host-level data parallelism)
+    dp_i = pid // 2
+    lo, hi = dp_i * (B // 2), (dp_i + 1) * (B // 2)
+
+    wsh = NamedSharding(mesh, P(None, "tp"))
+    rep = NamedSharding(mesh, P())
+    W0 = np.zeros((DIN, DOUT), np.float32)
+    W = jax.make_array_from_callback((DIN, DOUT), wsh, lambda idx: W0[idx])
+
+    @jax.jit
+    def step(W, x, y):
+        def loss_fn(W):
+            return jnp.mean((x @ W - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(W)
+        newW = jax.lax.with_sharding_constraint(W - 0.1 * g, wsh)
+        return loss, newW
+
+    wsum_fn = jax.jit(jnp.sum, out_shardings=rep)
+
+    losses = []
+    for _ in range(10):
+        x = mh.host_local_batch(mesh, P("dp", None), X[lo:hi])
+        y = mh.host_local_batch(mesh, P("dp", None), Y[lo:hi])
+        loss, W = step(W, x, y)
+        losses.append(float(loss))
+
+    mh.barrier("dptp_final")
+    pids = mh.process_allgather(np.array([pid], np.int32))
+    print(json.dumps({
+        "pid": pid,
+        "first_loss": losses[0],
+        "final_loss": losses[-1],
+        "w_sum": float(wsum_fn(W)),
+        "gathered_pids": np.asarray(pids).ravel().tolist(),
+    }), flush=True)
+    mh.shutdown()
+
+
+if __name__ == "__main__":
+    main()

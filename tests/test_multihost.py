@@ -72,6 +72,49 @@ def _run_world(nproc=2, timeout=180, ckpt_dir=None, script="mh_worker.py",
     return results
 
 
+def _full_batch_gd_oracle(steps=20, dout=2):
+    """Replay the mh_worker*.py training loop on the full batch in numpy
+    (X ~ RandomState(0), W_true from the same stream, lr 0.1, W0 = 0).
+    Returns (losses, final W)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    W_true = rng.randn(4, dout).astype(np.float32)
+    Y = X @ W_true
+    W = np.zeros((4, dout), np.float32)
+    losses = []
+    for _ in range(steps):
+        err = X @ W - Y
+        losses.append(float(np.mean(err ** 2)))
+        W -= 0.1 * (2.0 / err.size) * (X.T @ err)
+    return losses, W
+
+
+def test_four_process_dp_training_matches_full_batch_oracle():
+    """dp=4 over four REAL processes (8 global devices): per-host batch
+    slicing and the world build must survive beyond nproc=2 — rank
+    arithmetic that two processes cannot expose."""
+    results = _run_world(nproc=4, timeout=300)
+    oracle, _ = _full_batch_gd_oracle(steps=20)
+    for r in results:
+        assert sorted(r["gathered_pids"]) == [0, 1, 2, 3]
+        assert r["final_loss"] == pytest.approx(oracle[-1], rel=1e-3)
+        assert r["first_loss"] == pytest.approx(oracle[0], rel=1e-4)
+        assert r["w_sum"] == pytest.approx(results[0]["w_sum"], rel=1e-5)
+
+
+def test_four_process_dp2_tp2_spans_processes():
+    """(dp=2, tp=2) mesh over four 1-device processes: the tp groups span
+    process boundaries, so weight-sharded matmul grads ride cross-process
+    collectives; must match the numpy GD oracle."""
+    results = _run_world(nproc=4, timeout=300, script="mh_worker_dptp.py")
+    losses, W = _full_batch_gd_oracle(steps=10, dout=8)
+    for r in results:
+        assert sorted(r["gathered_pids"]) == [0, 1, 2, 3]
+        assert r["first_loss"] == pytest.approx(losses[0], rel=1e-4)
+        assert r["final_loss"] == pytest.approx(losses[-1], rel=1e-3)
+        assert r["w_sum"] == pytest.approx(float(np.sum(W)), rel=1e-3)
+
+
 def test_two_process_dp_training_matches_full_batch_oracle(tmp_path):
     ckpt = tmp_path / "mh_ckpt"
     results = _run_world(ckpt_dir=ckpt)
@@ -82,22 +125,10 @@ def test_two_process_dp_training_matches_full_batch_oracle(tmp_path):
     # replicated weights
     assert r0["final_loss"] == pytest.approx(r1["final_loss"], rel=1e-5)
     assert r0["w_sum"] == pytest.approx(r1["w_sum"], rel=1e-5)
-    # data-parallel mean over the dp axis == full-batch GD: replay the same
-    # 20 steps on the full batch in numpy
-    rng = np.random.RandomState(0)
-    X = rng.randn(8, 4).astype(np.float32)
-    W_true = rng.randn(4, 2).astype(np.float32)
-    Y = X @ W_true
-    W = np.zeros((4, 2), np.float32)
-    first = last = None
-    for i in range(20):
-        err = X @ W - Y
-        last = float(np.mean(err ** 2))
-        if i == 0:
-            first = last
-        W -= 0.1 * (2.0 / err.size) * (X.T @ err)
-    assert r0["first_loss"] == pytest.approx(first, rel=1e-4)
-    assert r0["final_loss"] == pytest.approx(last, rel=1e-3)
+    # data-parallel mean over the dp axis == full-batch GD
+    losses, _ = _full_batch_gd_oracle(steps=20)
+    assert r0["first_loss"] == pytest.approx(losses[0], rel=1e-4)
+    assert r0["final_loss"] == pytest.approx(losses[-1], rel=1e-3)
     assert r0["final_loss"] < r0["first_loss"] * 0.05  # actually trained
 
     # host-level collectives: allgather saw both processes, chief broadcast
