@@ -40,6 +40,18 @@ def _mfu(flops_per_step, step_s):
     return flops_per_step / step_s / (PEAK_TFLOPS * 1e12)
 
 
+def _attn_flops(batch, seq, n_layers, d_model, causal):
+    """Attention-score matmul FLOPs per training step (fwd+bwd), which the
+    6ND rule EXCLUDES (they scale with T^2, not with N): per layer the
+    forward QK^T and PV matmuls cost 2*2*B*T^2*d; backward doubles it ->
+    12*B*T^2*d*L for a bidirectional encoder. A causal decoder only
+    computes the lower triangle (the flash kernel skips upper blocks), so
+    half. Reporting MFU against 6ND alone OVERSTATES utilization at long
+    seq — both denominators are reported."""
+    full = 12.0 * batch * seq * seq * d_model * n_layers
+    return full / 2.0 if causal else full
+
+
 def _import_models(suite):
     """Import examples/<suite>/models fresh — the cnn and ctr suites both
     name their package ``models``, so the cached module must be dropped."""
@@ -95,14 +107,16 @@ def _bench_resnet18(batch_size, warmup, iters, dtype):
     return batch_size / dt, dt * 1000, _mfu(flops, dt)
 
 
-def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15):
+def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None):
     """BERT-base MLM+NSP pretrain step (BASELINE.md north star: 'BERT-base
     pretrain (Pallas attention)'). Dense packed batches -> the fused
-    bidirectional flash kernel; tokens/s and 6ND MFU."""
+    bidirectional flash kernel; tokens/s with BOTH the 6ND and the
+    attention-inclusive MFU."""
     import jax
     from hetu_tpu.models import bert
 
-    cfg = bert.BERT_BASE
+    if cfg is None:
+        cfg = bert.BERT_BASE
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
     n_params = bert.count_params(params)
     opt = bert.init_opt_state(params)
@@ -129,8 +143,17 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15):
     float(np.asarray(loss))   # one transfer for the whole window
     dt = (time.time() - t0) / iters
     tokens = batch_size * seq_len
-    flops = 6.0 * n_params * tokens
-    return tokens / dt, dt * 1000, _mfu(flops, dt), n_params
+    flops_6nd = 6.0 * n_params * tokens
+    flops_attn = _attn_flops(batch_size, seq_len, cfg.n_layers, cfg.d_model,
+                             causal=False)
+    from hetu_tpu.models import transformer as tfm
+    impl = tfm._resolve_attn_impl(cfg.trunk(), None, seq_len)
+    return {"tokens_per_sec": round(tokens / dt, 0),
+            "step_ms": round(dt * 1000, 2),
+            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
+            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
+            "attn_impl": impl,
+            "n_params": n_params}
 
 
 def bench_decode(batch=8, prompt_len=16, max_len=256):
@@ -157,19 +180,20 @@ def bench_decode(batch=8, prompt_len=16, max_len=256):
     return new_tokens / dt, dt / (max_len - prompt_len) * 1000
 
 
-def bench_transformer(warmup=3, iters=20):
+def bench_transformer(cfg=None, batch=16, seq=512, warmup=3, iters=20):
     import jax
     import jax.numpy as jnp
     from hetu_tpu.models import transformer as tfm
 
-    cfg = tfm.TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
-                                n_layers=8, d_ff=2048, max_seq_len=512)
+    if cfg is None:
+        cfg = tfm.TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
+                                    n_layers=8, d_ff=2048, max_seq_len=512)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     opt = tfm.init_opt_state(params)
     step = tfm.make_train_step(cfg, mesh=None, lr=3e-4)
     rng = np.random.RandomState(0)
-    tok = jnp.asarray(rng.randint(0, 8192, (16, 512)), jnp.int32)
+    tok = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     tgt = jnp.roll(tok, -1, axis=1)
     for _ in range(warmup):
         loss, params, opt = step(params, opt, tok, tgt)
@@ -179,10 +203,18 @@ def bench_transformer(warmup=3, iters=20):
         loss, params, opt = step(params, opt, tok, tgt)
     float(np.asarray(loss))
     dt = (time.time() - t0) / iters
-    tokens = 16 * 512
-    # 6ND: fwd+bwd matmul flops for a decoder-only transformer
-    flops = 6.0 * n_params * tokens
-    return tokens / dt, dt * 1000, _mfu(flops, dt)
+    tokens = batch * seq
+    # 6ND: fwd+bwd matmul flops for a decoder-only transformer; the
+    # attention-inclusive denominator adds the T^2-scaling score matmuls
+    flops_6nd = 6.0 * n_params * tokens
+    flops_attn = _attn_flops(batch, seq, cfg.n_layers, cfg.d_model,
+                             causal=True)
+    return {"tokens_per_sec": round(tokens / dt, 0),
+            "step_ms": round(dt * 1000, 2),
+            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
+            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
+            "attn_impl": tfm._resolve_attn_impl(cfg, None, seq),
+            "n_params": n_params}
 
 
 # ---------------------------------------------------------------------------
@@ -255,18 +287,21 @@ def _run_section(name):
         tsps, tms = jax_twin.bench(batch_size=512, dtype="bf16")
         out = {"samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
     elif name == "transformer":
-        toks, tms, tmfu = bench_transformer()
-        out = {"tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
-               "mfu_6nd": round(tmfu, 4) if tmfu else None}
+        out = bench_transformer()
+    elif name == "transformer350":
+        # flagship-scale proof point (~350M params): MFU must rise with
+        # model size if the 38M config is shape-bound, as claimed
+        from hetu_tpu.models import transformer as tfm
+        cfg = tfm.TransformerConfig(vocab_size=32768, d_model=1024,
+                                    n_heads=16, n_layers=24, d_ff=4096,
+                                    max_seq_len=512, remat=True)
+        out = bench_transformer(cfg=cfg, batch=8, seq=512, warmup=2, iters=8)
     elif name == "decode":
         dtoks, dms = bench_decode()
         out = {"tokens_per_sec": round(dtoks, 0),
                "ms_per_token": round(dms, 3)}
     elif name == "bert":
-        toks, tms, tmfu, n_params = bench_bert()
-        out = {"tokens_per_sec": round(toks, 0), "step_ms": round(tms, 2),
-               "mfu_6nd": round(tmfu, 4) if tmfu else None,
-               "n_params": n_params}
+        out = bench_bert()
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -339,6 +374,7 @@ def main():
     if "--fast" not in sys.argv:
         sections += [("jax_native_twin_bf16_bs512", "twin", 420),
                      ("transformer_38M_seq512", "transformer", 420),
+                     ("transformer_350M_seq512", "transformer350", 600),
                      ("decode_38M_greedy", "decode", 420),
                      ("bert_base_pretrain_seq512", "bert", 600),
                      ("wdl_criteo_hybrid_ps", "wdl", 600)]

@@ -73,7 +73,9 @@ struct MsgHeader {
   uint64_t req_id = 0;    // per-client monotonic; servers dedup resends on it
   int32_t n_args = 0;
   int32_t flags = 0;
-  int32_t client_id = -1; // worker rank (for resend dedup); -1 = untracked
+  int32_t client_id = -1; // rank*2 + channel (bulk=0/fast=1) — the server's
+                          // resend-dedup slot key; ids must be monotonic
+                          // PER client_id stream. -1 = untracked
   int32_t pad = 0;
 };
 

@@ -811,20 +811,26 @@ class PsWorker {
   // that rank, so a recovered server is picked up) and a RESEND — servers
   // dedup on (client_id, req_id) so a request that executed but whose
   // response was lost is not applied twice.
-  // Gradient-payload messages ride the bulk channel; pulls and small
-  // control messages ride the fast channel (see the p3-van note in the
-  // constructor). kDDPushPull is bulk on BOTH legs (grad out, full param
-  // back); raw assignments carry whole-tensor payloads too.
+  // Channel classification is by the size of EITHER leg: anything that can
+  // carry a whole-tensor payload — in the request (pushes, assigns) or in
+  // the response (kDensePull/kDataPull return full shards, kDDPushPull
+  // both) — rides the bulk channel. The fast channel carries the latency-
+  // critical per-batch row pulls (kSparsePull, kSyncEmbedding) and small
+  // control messages, so they are never stuck behind a multi-MB transfer
+  // (see the p3-van note in the constructor).
   static bool is_bulk(PsfType t) {
     switch (t) {
       case PsfType::kDensePush:
+      case PsfType::kDensePull:
       case PsfType::kDDPushPull:
       case PsfType::kSparsePush:
-      case PsfType::kSDPushPull:
-      case PsfType::kSSPushPull:
+      case PsfType::kSDPushPull:    // never sent by this worker (decomposed
+      case PsfType::kSSPushPull:    // into push+pull) — kept bulk for any
+                                    // external client of the wire protocol
       case PsfType::kPushEmbedding:
       case PsfType::kPushSyncEmbedding:
       case PsfType::kDataPush:
+      case PsfType::kDataPull:
       case PsfType::kParamAssign:
       case PsfType::kParamAssignRows:
         return true;
