@@ -14,7 +14,12 @@ only (block_q, block_k) tiles ever exist:
   `lax.scan` recomputation in XLA serves as fallback and numerical oracle.
 
 Public entry: ``flash_attention(q, k, v, causal=True)`` with shapes
-(batch, heads, seq, head_dim), differentiable via custom_vjp.
+(batch, heads, seq, head_dim), differentiable via custom_vjp. An optional
+``k_bias`` (batch, seq) float is ADDED to every score column — the key-
+padding mask form (0 valid / -1e9 padded) the BERT encoder uses — so masked
+batches keep the fused kernel instead of falling back to the unfused path.
+All-padded rows degenerate to a uniform softmax, exactly like the unfused
+form (softmax is shift-invariant), so the semantics match the dot path.
 """
 from __future__ import annotations
 
@@ -56,8 +61,8 @@ def _causal_upper_kb(q_start, block_q, block_k):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale,
+                causal, use_bias, block_k, seq_len):
     # grid: (batch*heads, q_blocks); refs carry one q block and the full k/v
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
@@ -72,6 +77,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         v_blk = v_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if use_bias:
+            s = s + bias_ref[0, pl.ds(kj * block_k, block_k), 0][None, :]
         if causal:
             s = _causal_mask(s, q_start, kj * block_k, block_q, block_k)
         m_cur = jnp.max(s, axis=1)
@@ -95,22 +102,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, :, 0] = m + jnp.log(l)
 
 
-def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+def _expand_bias(k_bias, b, h, s):
+    """(b, s) per-key bias -> (b*h, s, 1) column blocks for the kernels."""
+    kb = jnp.broadcast_to(k_bias.astype(jnp.float32)[:, None, :], (b, h, s))
+    return kb.reshape(b * h, s, 1)
+
+
+def _fwd_pallas(q, k, v, k_bias, scale, causal, block_q, block_k, interpret):
     b, h, s, d = q.shape
     bh = b * h
     qf = q.reshape(bh, s, d)
     kf = k.reshape(bh, s, d)
     vf = v.reshape(bh, s, d)
     grid = (bh, s // block_q)
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_len=s),
-        grid=grid,
-        in_specs=[
+    use_bias = k_bias is not None
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             use_bias=use_bias, block_k=block_k, seq_len=s)
+    if not use_bias:
+        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref):  # noqa: F811
+            return _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                               scale=scale, causal=causal, use_bias=False,
+                               block_k=block_k, seq_len=s)
+    in_specs = [
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-        ],
+    ]
+    ops = [qf, kf, vf]
+    if use_bias:
+        in_specs.append(pl.BlockSpec((1, s, 1), lambda i, j: (i, 0, 0)))
+        ops.append(_expand_bias(k_bias, b, h, s))
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             # trailing singleton keeps the block's last-two dims TPU-tileable
@@ -121,7 +146,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*ops)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
 
 
@@ -131,8 +156,9 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
 # (S, S)-shaped ever exists. delta = rowsum(dO * O) is precomputed in XLA.
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k, seq_len):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   bias_ref, dq_ref, *, scale, causal, use_bias, block_k,
+                   seq_len):
     # grid: (batch*heads, q_blocks); owns one q block, loops over k blocks
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                  # (block_q, d)
@@ -148,6 +174,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v_blk = v_ref[0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if use_bias:
+            s = s + bias_ref[0, pl.ds(kj * block_k, block_k), 0][None, :]
         if causal:
             s = _causal_mask(s, q_start, kj * block_k, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
@@ -165,7 +193,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, seq_len):
+                    bias_ref, dk_ref, dv_ref, *, scale, causal, use_bias,
+                    block_q, seq_len):
     # grid: (batch*heads, k_blocks); owns one k/v block, loops over q blocks
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)              # (block_k, d)
@@ -182,6 +211,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if use_bias:
+            # this kernel owns ONE k block: its bias column is constant
+            s = s + bias_ref[0, :, 0][None, :]
         if causal:
             s = _causal_mask(s, qi * block_q, k_start, block_q, block_k)
         p = jnp.exp(s - lse[:, None])                 # (block_q, block_k)
@@ -206,9 +238,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(res, do, *, scale, causal, block_q, block_k, interpret):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, k_bias = res
     b, h, s, d = q.shape
     bh = b * h
+    use_bias = k_bias is not None
+    biasf = _expand_bias(k_bias, b, h, s) if use_bias else None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                           # (b, h, s)
     qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
@@ -219,32 +253,62 @@ def _bwd_pallas(res, do, *, scale, causal, block_q, block_k, interpret):
     full = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0))
     col = pl.BlockSpec((1, s, 1), lambda i, j: (i, 0, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_len=s),
-        grid=(bh, s // block_q),
-        in_specs=[
+    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                use_bias=use_bias, block_k=block_k,
+                                seq_len=s)
+    if not use_bias:
+        def dq_kern(q_ref, k_ref, v_ref, do_ref, lse_ref,  # noqa: F811
+                    delta_ref, dq_ref):
+            return _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                  delta_ref, None, dq_ref, scale=scale,
+                                  causal=causal, use_bias=False,
+                                  block_k=block_k, seq_len=s)
+    dq_specs = [
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             full, full,
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-        ],
+    ]
+    dq_ops = [qf, kf, vf, dof, lsef, deltaf]
+    if use_bias:
+        dq_specs.append(col)
+        dq_ops.append(biasf)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, s // block_q),
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dq_ops)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_len=s),
-        grid=(bh, s // block_k),
-        in_specs=[
+    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                 causal=causal, use_bias=use_bias,
+                                 block_q=block_q, seq_len=s)
+    if not use_bias:
+        def dkv_kern(q_ref, k_ref, v_ref, do_ref, lse_ref,  # noqa: F811
+                     delta_ref, dk_ref, dv_ref):
+            return _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                   delta_ref, None, dk_ref, dv_ref,
+                                   scale=scale, causal=causal,
+                                   use_bias=False, block_q=block_q,
+                                   seq_len=s)
+    dkv_specs = [
             full,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             full, col, col,
-        ],
+    ]
+    dkv_ops = [qf, kf, vf, dof, lsef, deltaf]
+    if use_bias:
+        dkv_specs.append(
+            pl.BlockSpec((1, block_k, 1), lambda i, j: (i, j, 0)))
+        dkv_ops.append(biasf)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, s // block_k),
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -254,7 +318,7 @@ def _bwd_pallas(res, do, *, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*dkv_ops)
 
     return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
             dv.reshape(b, h, s, d))
@@ -266,7 +330,7 @@ def _bwd_pallas(res, do, *, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _bwd_blockwise(res, do, *, scale, causal, block_k):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, k_bias = res
     b, h, s, d = q.shape
     nkb = s // block_k
     do_f = do.astype(jnp.float32)
@@ -282,6 +346,10 @@ def _bwd_blockwise(res, do, *, scale, causal, block_k):
         v_blk = jax.lax.dynamic_slice_in_dim(v, ks, block_k, 2)
         s_blk = jnp.einsum("bhqd,bhkd->bhqk", q_f,
                            k_blk.astype(jnp.float32)) * scale
+        if k_bias is not None:
+            kb = jax.lax.dynamic_slice_in_dim(
+                k_bias.astype(jnp.float32), ks, block_k, 1)
+            s_blk = s_blk + kb[:, None, None, :]
         if causal:
             mask = q_pos[:, None] >= (ks + jnp.arange(block_k))[None, :]
             s_blk = jnp.where(mask, s_blk, _NEG_INF)
@@ -308,12 +376,21 @@ def _bwd_blockwise(res, do, *, scale, causal, block_k):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Fused causal attention. q/k/v: (batch, heads, seq, head_dim)."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, k_bias, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, k_bias, causal, scale, block_q, block_k)
     return out
+
+
+def flash_attention(q, k, v, causal=True, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    k_bias=None):
+    """Fused attention. q/k/v: (batch, heads, seq, head_dim).
+
+    ``k_bias``: optional (batch, seq) float added to every score column —
+    the key-padding mask form (0 valid / -1e9 padded). Non-trainable: its
+    cotangent is zero."""
+    return _flash(q, k, v, k_bias, causal, scale, block_q, block_k)
 
 
 def _resolve(q, scale, block_q, block_k):
@@ -326,32 +403,39 @@ def _resolve(q, scale, block_q, block_k):
     return scale, block_q, block_k
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, k_bias, causal, scale, block_q, block_k):
     scale, block_q, block_k = _resolve(q, scale, block_q, block_k)
-    out, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+    out, lse = _fwd_pallas(q, k, v, k_bias, scale, causal, block_q, block_k,
                            interpret=not _on_tpu())
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, k_bias)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, do):
     q = res[0]
     scale, block_q, block_k = _resolve(q, scale, block_q, block_k)
     if _on_tpu():
-        return _bwd_pallas(res, do, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k, interpret=False)
-    return _bwd_blockwise(res, do, scale=scale, causal=causal,
-                          block_k=block_k)
+        grads = _bwd_pallas(res, do, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=False)
+    else:
+        grads = _bwd_blockwise(res, do, scale=scale, causal=causal,
+                               block_k=block_k)
+    k_bias = res[5]
+    dbias = None if k_bias is None else jnp.zeros_like(k_bias)
+    return grads + (dbias,)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def mha_reference(q, k, v, causal=True, scale=None):
+def mha_reference(q, k, v, causal=True, scale=None, k_bias=None):
     """Unfused reference (the reference framework's BatchMatMul+Softmax
     attention) — used as the numerical oracle in tests."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if k_bias is not None:
+        s = s + k_bias.astype(jnp.float32)[:, None, None, :]
     if causal:
         n = q.shape[2]
         mask = jnp.tril(jnp.ones((n, n), bool))

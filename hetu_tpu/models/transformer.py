@@ -165,23 +165,47 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
 
 
+def _is_key_padding_bias(attn_bias):
+    """A (B, 1, 1, T) additive bias is per-KEY (the padding-mask form BERT
+    builds from input_mask) — the flash kernel folds it into its score
+    blocks. Any other bias shape needs the unfused path."""
+    return (attn_bias is not None and attn_bias.ndim == 4
+            and attn_bias.shape[1] == 1 and attn_bias.shape[2] == 1)
+
+
 def _resolve_attn_impl(cfg: TransformerConfig, mesh, T, attn_bias=None):
     impl = cfg.attn_impl
-    if attn_bias is not None:
-        # only the unfused path applies a padding-mask bias; an explicitly
-        # requested fused/ring impl must not degrade SILENTLY — masked
-        # batches materialize full (B, nh, T, T) f32 scores per layer
+    if attn_bias is not None and not _is_key_padding_bias(attn_bias):
+        # only the unfused path applies a general additive bias; an
+        # explicitly requested fused/ring impl must not degrade SILENTLY —
+        # such batches materialize full (B, nh, T, T) f32 scores per layer
         if impl not in ("auto", "dot"):
             import warnings
             warnings.warn(
-                f"attn_impl={impl!r} requested but a padding mask "
-                "(attn_bias) is present: falling back to the unfused 'dot' "
-                "path for masked batches", stacklevel=3)
+                f"attn_impl={impl!r} requested but a non-key-padding "
+                "attn_bias is present: falling back to the unfused 'dot' "
+                "path", stacklevel=3)
+        return "dot"
+    if attn_bias is not None and impl == "ring":
+        # the sp ring does not fold biases yet
+        import warnings
+        warnings.warn("attn_impl='ring' requested but attn_bias is present: "
+                      "falling back to the unfused 'dot' path", stacklevel=3)
+        return "dot"
+    if attn_bias is not None and impl == "flash" and T % min(128, T):
+        # masked configs used to ride the unfused fallback regardless of T;
+        # keep that grace instead of letting the kernel's block-divisibility
+        # check raise on a previously-working masked batch
+        import warnings
+        warnings.warn(
+            f"attn_impl='flash' with a padding mask needs seq_len divisible "
+            f"by 128 (got {T}): falling back to the unfused 'dot' path",
+            stacklevel=3)
         return "dot"
     if impl != "auto":
         return impl
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        return "ring"
+        return "dot" if attn_bias is not None else "ring"
     if jax.default_backend() == "tpu" and T % 128 == 0:
         return "flash"
     return "dot"
@@ -192,10 +216,10 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
     """q/k/v: (B, nh, T, hd) -> (B, nh, T, hd). Three paths:
     - ring: sequence-parallel exact attention over the sp axis (shard_map +
       ppermute ring, hetu_tpu/parallel/ring_attention.py)
-    - flash: fused Pallas online-softmax kernel (hetu_tpu/kernels)
+    - flash: fused Pallas online-softmax kernel (hetu_tpu/kernels); folds a
+      key-padding ``attn_bias`` (B, 1, 1, T) into its score blocks
     - dot: unfused reference form (the reference framework's
-      BatchMatMul+Softmax attention); the only path that applies an
-      additive ``attn_bias`` (B, 1, 1, T) padding mask"""
+      BatchMatMul+Softmax attention); applies any additive ``attn_bias``"""
     hd = q.shape[-1]
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
@@ -208,7 +232,9 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
         return fn(q, k, v)
     if impl == "flash":
         from ..kernels.flash_attention import flash_attention
-        return flash_attention(q, k, v, cfg.causal)
+        k_bias = (attn_bias.reshape(attn_bias.shape[0], attn_bias.shape[-1])
+                  if attn_bias is not None else None)
+        return flash_attention(q, k, v, cfg.causal, k_bias=k_bias)
     T = q.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)
