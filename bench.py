@@ -134,26 +134,48 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None):
         "mlm_weights": np.ones((batch_size, P), np.float32),
         "nsp_label": rng.randint(0, 2, (batch_size,)).astype(np.int32),
     }
-    for _ in range(warmup):
-        loss, _, params, opt = step(params, opt, batch)
-    float(np.asarray(loss))   # hard sync: block_until_ready does not wait
-    t0 = time.time()          # for remote execution on the tunneled chip
-    for _ in range(iters):
-        loss, _, params, opt = step(params, opt, batch)
-    float(np.asarray(loss))   # one transfer for the whole window
-    dt = (time.time() - t0) / iters
+    def timed(params, opt, batch, n_warm):
+        """Warmup then one hard-synced timing window. The float(np.asarray)
+        sync matters: block_until_ready does not wait for remote execution
+        on the tunneled chip; one transfer per window, not per step."""
+        loss = None
+        for _ in range(n_warm):
+            loss, _, params, opt = step(params, opt, batch)
+        float(np.asarray(loss))
+        t0 = time.time()
+        for _ in range(iters):
+            loss, _, params, opt = step(params, opt, batch)
+        float(np.asarray(loss))
+        return (time.time() - t0) / iters, params, opt
+
+    dt, params, opt = timed(params, opt, batch, warmup)
     tokens = batch_size * seq_len
     flops_6nd = 6.0 * n_params * tokens
     flops_attn = _attn_flops(batch_size, seq_len, cfg.n_layers, cfg.d_model,
                              causal=False)
     from hetu_tpu.models import transformer as tfm
     impl = tfm._resolve_attn_impl(cfg.trunk(), None, seq_len)
-    return {"tokens_per_sec": round(tokens / dt, 0),
-            "step_ms": round(dt * 1000, 2),
-            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
-            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
-            "attn_impl": impl,
-            "n_params": n_params}
+    out = {"tokens_per_sec": round(tokens / dt, 0),
+           "step_ms": round(dt * 1000, 2),
+           "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
+           "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
+           "attn_impl": impl,
+           "n_params": n_params}
+
+    # masked A/B: padded batches keep the fused kernel via the key-padding
+    # bias (before round 4 a mask forced the unfused (B,nh,T,T) path)
+    batch["input_mask"] = (
+        np.arange(seq_len)[None, :]
+        < rng.randint(seq_len // 2, seq_len + 1, (batch_size, 1))
+    ).astype(np.int32)
+    dtm, params, opt = timed(params, opt, batch, max(1, warmup - 1))
+    bias = jax.numpy.zeros((batch_size, 1, 1, seq_len))
+    out["masked"] = {
+        "tokens_per_sec": round(tokens / dtm, 0),
+        "step_ms": round(dtm * 1000, 2),
+        "attn_impl": tfm._resolve_attn_impl(cfg.trunk(), None, seq_len, bias),
+    }
+    return out
 
 
 def bench_decode(batch=8, prompt_len=16, max_len=256):
