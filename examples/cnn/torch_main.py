@@ -1,0 +1,129 @@
+"""PyTorch competitor twin (reference ``examples/cnn/torch_main.py``): the
+same models on the same data through ANOTHER framework, for A/B against the
+graph-API executor and the pure-JAX twin. CPU build of torch in this image;
+optional DataParallel-style multi-process DDP over gloo when launched with
+the standard torch.distributed env (WORLD_SIZE/RANK/MASTER_ADDR), mirroring
+the reference's DDP mode (torch_main.py worker(): init_process_group +
+DistributedDataParallel).
+
+Run:  python torch_main.py --model mlp --dataset MNIST --num-epochs 1
+DDP:  torchrun --nproc-per-node 2 torch_main.py --model mlp --dataset MNIST
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def build_model(name, dataset):
+    n_cls = 10
+    if name == "mlp":
+        in_dim = 784 if dataset == "MNIST" else 3072
+        return nn.Sequential(nn.Flatten(), nn.Linear(in_dim, 256), nn.ReLU(),
+                             nn.Linear(256, 256), nn.ReLU(),
+                             nn.Linear(256, n_cls))
+    if name == "lenet":
+        in_ch = 1 if dataset == "MNIST" else 3
+        side = 28 if dataset == "MNIST" else 32
+        flat = 16 * ((side // 4 - 2) ** 2)
+        return nn.Sequential(
+            nn.Conv2d(in_ch, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2d(2),
+            nn.Conv2d(6, 16, 5), nn.ReLU(), nn.MaxPool2d(2),
+            nn.Flatten(), nn.Linear(flat, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, n_cls))
+    raise SystemExit(f"unknown model {name!r} (torch twin has mlp, lenet)")
+
+
+def load_data(dataset, model):
+    """Same loaders as the hetu_tpu examples (synthetic fallback, no
+    egress) so the A/B trains on identical bytes."""
+    from hetu_tpu import data as htdata
+    if dataset == "MNIST":
+        (tx, ty), (vx, vy), _ = htdata.mnist(onehot=False)
+        if model != "mlp":
+            tx = tx.reshape(-1, 1, 28, 28)
+            vx = vx.reshape(-1, 1, 28, 28)
+    else:
+        tx, ty, vx, vy = htdata.normalize_cifar(onehot=False)
+        if model == "mlp":
+            tx = tx.reshape(len(tx), -1)
+            vx = vx.reshape(len(vx), -1)
+    return (tx.astype(np.float32), np.asarray(ty, np.int64),
+            vx.astype(np.float32), np.asarray(vy, np.int64))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="mlp")
+    ap.add_argument("--dataset", default="MNIST",
+                    choices=("MNIST", "CIFAR10"))
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--validate", action="store_true")
+    ap.add_argument("--timing", action="store_true")
+    args = ap.parse_args(argv)
+
+    ddp = int(os.environ.get("WORLD_SIZE", "1")) > 1
+    rank = int(os.environ.get("RANK", "0"))
+    if ddp:
+        import torch.distributed as dist
+        dist.init_process_group("gloo")
+    torch.manual_seed(0)
+
+    model = build_model(args.model, args.dataset)
+    if ddp:
+        model = nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.SGD(model.parameters(), lr=args.learning_rate,
+                          momentum=0.9)
+    loss_fn = nn.CrossEntropyLoss()
+    tx, ty, vx, vy = load_data(args.dataset, args.model)
+
+    n = len(tx)
+    last_acc = 0.0
+    for epoch in range(args.num_epochs):
+        order = np.random.RandomState(epoch).permutation(n)
+        if ddp:  # each rank trains its own shard of the epoch (DDP averages)
+            order = order[rank::int(os.environ["WORLD_SIZE"])]
+        t0 = time.time()
+        tot, correct, seen = 0.0, 0, 0
+        for s in range(len(order) // args.batch_size):
+            idx = order[s * args.batch_size:(s + 1) * args.batch_size]
+            x = torch.from_numpy(tx[idx])
+            y = torch.from_numpy(ty[idx])
+            opt.zero_grad()
+            out = model(x)
+            loss = loss_fn(out, y)
+            loss.backward()
+            opt.step()
+            tot += float(loss.detach())
+            correct += int((out.argmax(1) == y).sum())
+            seen += len(idx)
+        last_acc = correct / max(seen, 1)
+        if rank == 0:
+            msg = (f"epoch {epoch}: loss {tot / max(1, len(order) // args.batch_size):.4f} "
+                   f"acc {last_acc:.4f}")
+            if args.timing:
+                msg += f" time {time.time() - t0:.2f}s"
+            print(msg, flush=True)
+        if args.validate and rank == 0:
+            with torch.no_grad():
+                out = model(torch.from_numpy(vx[:2048]))
+                vacc = float((out.argmax(1)
+                              == torch.from_numpy(vy[:2048])).float().mean())
+            print(f"  validate acc {vacc:.4f}", flush=True)
+    if ddp:
+        import torch.distributed as dist
+        dist.destroy_process_group()
+    return last_acc
+
+
+if __name__ == "__main__":
+    main()
