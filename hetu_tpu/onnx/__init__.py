@@ -5,6 +5,6 @@ Self-contained: serialization uses the vendored wire codec in ``proto.py``
 (the ``onnx`` pip package is not required); files written/read are standard
 ``.onnx`` protobufs.
 """
-from . import hetu2onnx, onnx2hetu, proto
+from . import hetu2onnx, onnx2hetu, proto, x2hetu
 
-__all__ = ["hetu2onnx", "onnx2hetu", "proto"]
+__all__ = ["hetu2onnx", "onnx2hetu", "proto", "x2hetu"]

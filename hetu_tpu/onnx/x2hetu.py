@@ -1,0 +1,253 @@
+"""X2hetu: import a TensorFlow GraphDef into the hetu_tpu op graph.
+
+Reference: ``python/hetu/onnx/X2hetu/handler.py`` (TF1 graph -> hetu graph,
+per-op handler registry). TF is not installable in this image, so this
+importer reads the GraphDef protobuf DIRECTLY with the same hand-written
+wire codec the ONNX bridge uses (``proto.py`` Message) — field numbers per
+tensorflow/core/framework/{graph,node_def,attr_value,tensor,tensor_shape,
+types}.proto. The supported op set covers the frozen inference graphs the
+reference handler targets: Placeholder/Const/Identity, MatMul, Add/AddV2/
+BiasAdd/Sub/Mul, Relu/Sigmoid/Tanh/Softmax, Reshape.
+
+Usage::
+
+    nodes = tf2hetu(graphdef_bytes_or_path)
+    y = nodes["softmax"]          # any TF node name -> hetu op
+    ex = ht.Executor([y])
+    ex.run(feed_dict={nodes["x"]: batch})
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .proto import Message
+
+# ---------------------------------------------------------------------------
+# TF protobuf schema subset
+# ---------------------------------------------------------------------------
+
+
+class TfDim(Message):
+    FIELDS = {"size": (1, "int"), "name": (2, "string")}
+
+
+class TfTensorShape(Message):
+    FIELDS = {"dim": (2, [TfDim]), "unknown_rank": (3, "int")}
+
+
+class TfTensor(Message):
+    FIELDS = {
+        "dtype": (1, "int"),
+        "tensor_shape": (2, TfTensorShape),
+        "version_number": (3, "int"),
+        "tensor_content": (4, "bytes"),
+        "float_val": (5, ["float"]),
+        # double_val (6) intentionally omitted: packed 8-byte doubles would
+        # misparse as floats — unknown fields are skipped, and DT_DOUBLE
+        # constants arrive via tensor_content (frombuffer handles them)
+        "int_val": (7, ["int"]),
+        "int64_val": (10, ["int"]),
+    }
+
+
+class TfAttrValue(Message):
+    FIELDS = {
+        "s": (2, "bytes"),
+        "i": (3, "int"),
+        "f": (4, "float"),
+        "b": (5, "int"),
+        "type": (6, "int"),
+        "shape": (7, TfTensorShape),
+        "tensor": (8, TfTensor),
+    }
+
+
+class TfAttrEntry(Message):   # map<string, AttrValue> entry
+    FIELDS = {"key": (1, "string"), "value": (2, TfAttrValue)}
+
+
+class TfNodeDef(Message):
+    FIELDS = {
+        "name": (1, "string"),
+        "op": (2, "string"),
+        "input": (3, ["string"]),
+        "device": (4, "string"),
+        "attr": (5, [TfAttrEntry]),
+    }
+
+
+class TfGraphDef(Message):
+    FIELDS = {"node": (1, [TfNodeDef])}
+
+
+# TF DataType enum values we accept
+DT_FLOAT, DT_DOUBLE, DT_INT32, DT_INT64 = 1, 2, 3, 9
+_DT_NUMPY = {DT_FLOAT: np.float32, DT_DOUBLE: np.float64,
+             DT_INT32: np.int32, DT_INT64: np.int64}
+
+
+def tensor_to_numpy(t: TfTensor) -> np.ndarray:
+    dt = _DT_NUMPY.get(t.dtype)
+    if dt is None:
+        raise NotImplementedError(f"TF dtype enum {t.dtype}")
+    shape = tuple(int(d.size) for d in (t.tensor_shape.dim
+                                        if t.tensor_shape else []))
+    n = int(np.prod(shape)) if shape else 1
+    if t.tensor_content:
+        arr = np.frombuffer(t.tensor_content, dtype=dt)
+    elif t.float_val:
+        arr = np.asarray(t.float_val, dt)
+    elif t.int_val:
+        arr = np.asarray(t.int_val, dt)
+    elif t.int64_val:
+        arr = np.asarray(t.int64_val, dt)
+    elif n == 0:
+        arr = np.zeros(0, dt)
+    else:
+        # TF never emits a value-less non-empty TensorProto; a "zeros"
+        # guess here would be silently wrong numerics (e.g. a DT_DOUBLE
+        # scalar stored in double_val, which this codec does not parse)
+        raise NotImplementedError(
+            "TF tensor carries no parseable values (tensor_content/"
+            "float_val/int_val/int64_val all empty) — unsupported encoding")
+    if arr.size == 1 and n > 1:     # splat-encoded constant
+        arr = np.full(n, arr.ravel()[0], dt)
+    return arr.reshape(shape)
+
+
+def _attrs(node: TfNodeDef) -> dict:
+    return {e.key: e.value for e in node.attr}
+
+
+def _clean(name: str) -> str:
+    """'node:0' output refs and '^ctrl' control deps -> plain node name."""
+    if name.startswith("^"):
+        return ""
+    return name.split(":")[0]
+
+
+# ---------------------------------------------------------------------------
+# per-op handlers (reference handler.py's registry shape)
+# ---------------------------------------------------------------------------
+
+_HANDLERS = {}
+
+
+def _handles(*ops):
+    def reg(fn):
+        for o in ops:
+            _HANDLERS[o] = fn
+        return fn
+    return reg
+
+
+@_handles("Placeholder")
+def _placeholder(ht, node, inputs, attrs, consts):
+    return ht.Variable(name=node.name, trainable=False)
+
+
+@_handles("Const")
+def _const(ht, node, inputs, attrs, consts):
+    value = tensor_to_numpy(attrs["value"].tensor)
+    return ht.Variable(name=node.name, value=value, trainable=False,
+                       dtype=value.dtype)
+
+
+@_handles("Identity")
+def _identity(ht, node, inputs, attrs, consts):
+    return inputs[0]
+
+
+@_handles("MatMul")
+def _matmul(ht, node, inputs, attrs, consts):
+    ta = bool(attrs["transpose_a"].b) if "transpose_a" in attrs else False
+    tb = bool(attrs["transpose_b"].b) if "transpose_b" in attrs else False
+    return ht.matmul_op(inputs[0], inputs[1], trans_A=ta, trans_B=tb)
+
+
+@_handles("Add", "AddV2", "BiasAdd")
+def _add(ht, node, inputs, attrs, consts):
+    if node.op == "BiasAdd" and "data_format" in attrs \
+            and attrs["data_format"].s == b"NCHW":
+        raise NotImplementedError(
+            f"BiasAdd {node.name!r} with data_format=NCHW: only the "
+            "default NHWC/last-axis broadcast is supported")
+    return ht.add_op(inputs[0], inputs[1])
+
+
+@_handles("Sub")
+def _sub(ht, node, inputs, attrs, consts):
+    # opposite_op (jnp.negative) preserves integer dtypes, matching the
+    # ONNX importer's Sub lowering (onnx2hetu.py)
+    return ht.add_op(inputs[0], ht.opposite_op(inputs[1]))
+
+
+@_handles("Mul")
+def _mul(ht, node, inputs, attrs, consts):
+    return ht.mul_op(inputs[0], inputs[1])
+
+
+@_handles("Relu")
+def _relu(ht, node, inputs, attrs, consts):
+    return ht.relu_op(inputs[0])
+
+
+@_handles("Sigmoid")
+def _sigmoid(ht, node, inputs, attrs, consts):
+    return ht.sigmoid_op(inputs[0])
+
+
+@_handles("Tanh")
+def _tanh(ht, node, inputs, attrs, consts):
+    return ht.tanh_op(inputs[0])
+
+
+@_handles("Softmax")
+def _softmax(ht, node, inputs, attrs, consts):
+    return ht.softmax_op(inputs[0])
+
+
+@_handles("Reshape")
+def _reshape(ht, node, inputs, attrs, consts):
+    shape = consts.get(id(inputs[1]))
+    if shape is None:
+        raise NotImplementedError(
+            f"Reshape {node.name!r}: target shape must be a Const")
+    return ht.array_reshape_op(inputs[0], tuple(int(s) for s in shape))
+
+
+# ---------------------------------------------------------------------------
+# importer
+# ---------------------------------------------------------------------------
+
+def tf2hetu(graphdef) -> dict:
+    """Import a serialized TF GraphDef (bytes or file path). Returns
+    {tf node name: hetu op}; Placeholders become feedable Variables."""
+    import hetu_tpu as ht
+
+    if isinstance(graphdef, str):
+        with open(graphdef, "rb") as f:
+            graphdef = f.read()
+    g = TfGraphDef.FromString(graphdef)
+
+    nodes: dict[str, object] = {}
+    consts: dict[int, np.ndarray] = {}   # id(ht node) -> const value
+    for node in g.node:
+        handler = _HANDLERS.get(node.op)
+        if handler is None:
+            raise NotImplementedError(
+                f"TF op {node.op!r} (node {node.name!r}) has no X2hetu "
+                f"handler; supported: {sorted(_HANDLERS)}")
+        in_names = [_clean(i) for i in node.input]
+        inputs = [nodes[i] for i in in_names if i]
+        attrs = _attrs(node)
+        out = handler(ht, node, inputs, attrs, consts)
+        if node.op == "Const":
+            consts[id(out)] = out.value
+        nodes[node.name] = out
+    return nodes
+
+
+def save_graphdef(g: TfGraphDef, path: str):
+    with open(path, "wb") as f:
+        f.write(g.SerializeToString())
