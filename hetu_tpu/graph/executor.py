@@ -646,7 +646,12 @@ class SubExecutor:
                 flat = [np.ascontiguousarray(staged_idx[id(op)],
                                              np.int64).ravel() for op in ops]
                 union = np.unique(np.concatenate(flat))
-                urows = ps.stage_lookup(p, union)          # (U, *tail)
+                # union prefetch (keyed by table): issued post-step from the
+                # peeked next batches, consumed here when they match
+                urows = (ps.take_prefetched(tid, union)
+                         if ps.async_enabled else None)
+                if urows is None:
+                    urows = ps.stage_lookup(p, union)      # (U, *tail)
                 tail = tuple(p.shape[1:])
                 for op, f in zip(ops, flat):
                     pos = np.searchsorted(union, f)
@@ -710,18 +715,27 @@ class SubExecutor:
                 items.append((p, grad, idx))
             if items:
                 ps.push_grads_async(items, step)
-            # prefetch pulls for batch N+1 (dataloader-fed lookups only, and
-            # only single-lookup tables — shared tables ride the union pull):
+            # prefetch pulls for batch N+1 (dataloader-fed lookups only):
             # issued now, so under ASP they overlap this step's compute and
-            # its pushes — the reference's prefetch-stream semantics
-            for op in self.ps_staged_ops:
-                idx_node = op.inputs[1]
-                if len(self._staged_by_table[id(op.embed_node)]) == 1 \
-                        and idx_node in self.dataloader_nodes \
-                        and hasattr(idx_node, "peek_batch"):
-                    nxt = np.asarray(idx_node.peek_batch(self.name))
-                    ps.prefetch_lookup(id(op), ps.params[id(op.embed_node)],
-                                       nxt)
+            # its pushes — the reference's prefetch-stream semantics.
+            # Single-lookup tables prefetch per op; a shared table
+            # prefetches the UNION of its peeked next batches (keyed by
+            # table id, matching the union pull in the pre-step).
+            for tid, ops in self._staged_by_table.items():
+                idx_nodes = [op.inputs[1] for op in ops]
+                if not all(n in self.dataloader_nodes
+                           and hasattr(n, "peek_batch") for n in idx_nodes):
+                    continue
+                if len(ops) == 1:
+                    ps.prefetch_lookup(
+                        id(ops[0]), ps.params[tid],
+                        np.asarray(idx_nodes[0].peek_batch(self.name)))
+                else:
+                    nxt = np.unique(np.concatenate(
+                        [np.ascontiguousarray(
+                            np.asarray(n.peek_batch(self.name)),
+                            np.int64).ravel() for n in idx_nodes]))
+                    ps.prefetch_lookup(tid, ps.params[tid], nxt)
         else:
             for op, grad in zip(self.ps_comm_ops, ps_grads):
                 p = ps.params[id(op.ps_param_node)]

@@ -94,6 +94,52 @@ def test_gpipe_stage_devices_distinct():
             assert exp.state["params"][id(node)].devices() == {st.device}
 
 
+def test_gpipe_dropout_trains_and_eval_is_deterministic():
+    """Dropout under the graph-API pipeline (reference: dropout works in any
+    placement, gpu_ops/Dropout.py): per-(microbatch, stage) rng keys give
+    distinct masks, training still converges on a separable task, and a
+    forward-only validate entry (training=False) is mask-free: two runs
+    agree exactly."""
+    M, mb = 2, 16
+    rng = np.random.RandomState(4)
+    w_true = rng.randn(20, 10).astype(np.float32)
+    xv = rng.randn(M * mb * 4, 20).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[(xv @ w_true).argmax(1)]
+
+    ctx0, ctx1 = ht.cpu(0), ht.cpu(1)
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y", trainable=False)
+    w1 = ht.Variable("w1", value=(rng.randn(20, 64) * 0.2).astype(np.float32),
+                     ctx=ctx0)
+    h = ht.relu_op(ht.matmul_op(x, w1, ctx=ctx0), ctx=ctx0)
+    h = ht.dropout_op(h, 0.8, ctx=ctx0)
+    w2 = ht.Variable("w2", value=(rng.randn(64, 10) * 0.2).astype(np.float32),
+                     ctx=ctx1)
+    logits = ht.matmul_op(h, w2, ctx=ctx1)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_, ctx=ctx1),
+                             [0], ctx=ctx1)
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    exp = ht.Executor({"train": [loss, train_op], "validate": [logits]},
+                      gpipe=True, seed=5)
+
+    losses = []
+    n = M * mb
+    for step in range(30):
+        lo = (step * n) % len(xv)
+        fdl = [{x: xv[lo + m * mb:lo + (m + 1) * mb],
+                y_: yv[lo + m * mb:lo + (m + 1) * mb]} for m in range(M)]
+        ret = exp.run("train", feed_dict=fdl, convert_to_numpy_ret_vals=True)
+        losses.append(float(np.mean([np.mean(v) for v in ret[0]])))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, (
+        losses[:5], losses[-5:])
+
+    # eval is deterministic (dropout off outside training)
+    vfd = [{x: xv[:mb]}]
+    a = exp.run("validate", feed_dict=vfd, convert_to_numpy_ret_vals=True)
+    b = exp.run("validate", feed_dict=vfd, convert_to_numpy_ret_vals=True)
+    np.testing.assert_array_equal(np.asarray(a[0][0]), np.asarray(b[0][0]))
+
+
 def test_gpipe_validate_entry_pipelines():
     """A forward-only eval target must also run through the stage pipeline:
     after a train step the params are committed to per-stage devices."""

@@ -400,6 +400,56 @@ def _server_opt_l2_wd_dense(client, rank, tmpdir):
                                    err_msg=label)
 
 
+def _shared_table_union_prefetch(client, rank, tmpdir):
+    """A shared table with dataloader-fed lookups prefetches the UNION of
+    the peeked next batches: after step 0 every pre-step pull is a hit, and
+    under BSP the losses match the prefetch-off run exactly."""
+    import hetu_tpu as ht
+    S1, S2, steps = 2, 3, 12
+    rng0 = np.random.RandomState(17)
+    i1 = rng0.randint(0, NROWS, (steps * BATCH, S1)).astype(np.float32)
+    i2 = rng0.randint(0, NROWS, (steps * BATCH, S2)).astype(np.float32)
+    by = (rng0.rand(steps * BATCH, 1) > 0.5).astype(np.float32)
+    table0 = rng0.randn(NROWS, WIDTH).astype(np.float32) * 0.1
+    w0 = rng0.randn((S1 + S2) * WIDTH, 1).astype(np.float32) * 0.3
+
+    import os
+
+    def run(prefetch, base):
+        os.environ["HETU_PS_ID_BASE"] = str(base)
+        embed = ht.Variable(name="embed", value=table0.copy(), is_embed=True)
+        d1 = ht.dataloader_op([ht.Dataloader(i1, BATCH, "train")])
+        d2 = ht.dataloader_op([ht.Dataloader(i2, BATCH, "train")])
+        dy = ht.dataloader_op([ht.Dataloader(by, BATCH, "train")])
+        v1 = ht.embedding_lookup_op(embed, d1)
+        v2 = ht.embedding_lookup_op(embed, d2)
+        flat = ht.concat_op(
+            ht.array_reshape_op(v1, (-1, S1 * WIDTH)),
+            ht.array_reshape_op(v2, (-1, S2 * WIDTH)), axis=1)
+        w = ht.Variable(name="w", value=w0.copy())
+        prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+        loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, dy), [0])
+        train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         comm_mode="Hybrid", bsp=True, prefetch=prefetch)
+        losses = [float(ex.run("train")[0].asnumpy()) for _ in range(steps)]
+        perf = dict(ex.ps_runtime.perf)
+        ex.ps_runtime.drain()
+        return losses, perf
+
+    on_losses, on_perf = run(True, 500)
+    off_losses, _ = run(False, 600)
+    np.testing.assert_allclose(on_losses, off_losses, rtol=1e-6, atol=1e-7)
+    # union prefetch engaged: after the first step every pull hits
+    assert on_perf["prefetch_hits"] >= steps - 1, on_perf
+    assert on_perf["prefetch_misses"] == 0, on_perf
+
+
+def test_shared_table_union_prefetch(tmp_path):
+    run_cluster(_shared_table_union_prefetch, tmp_path, n_workers=1,
+                timeout=300)
+
+
 def test_server_opt_schedule_sparse(tmp_path):
     run_cluster(_server_opt_schedule_sparse, tmp_path, n_workers=1,
                 timeout=300)
