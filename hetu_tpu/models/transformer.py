@@ -186,12 +186,6 @@ def _resolve_attn_impl(cfg: TransformerConfig, mesh, T, attn_bias=None):
                 "attn_bias is present: falling back to the unfused 'dot' "
                 "path", stacklevel=3)
         return "dot"
-    if attn_bias is not None and impl == "ring":
-        # the sp ring does not fold biases yet
-        import warnings
-        warnings.warn("attn_impl='ring' requested but attn_bias is present: "
-                      "falling back to the unfused 'dot' path", stacklevel=3)
-        return "dot"
     if attn_bias is not None and impl == "flash" and T % min(128, T):
         # masked configs used to ride the unfused fallback regardless of T;
         # keep that grace instead of letting the kernel's block-divisibility
@@ -205,7 +199,7 @@ def _resolve_attn_impl(cfg: TransformerConfig, mesh, T, attn_bias=None):
     if impl != "auto":
         return impl
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        return "dot" if attn_bias is not None else "ring"
+        return "ring"   # key-padding biases rotate with the k/v chunks
     if jax.default_backend() == "tpu" and T % 128 == 0:
         return "flash"
     return "dot"
@@ -221,20 +215,33 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
     - dot: unfused reference form (the reference framework's
       BatchMatMul+Softmax attention); applies any additive ``attn_bias``"""
     hd = q.shape[-1]
+    # (B, 1, 1, T) key-padding bias -> (B, T) per-key form shared by the
+    # fused paths; a broadcast-batch (1, 1, 1, T) mask expands to the real
+    # batch so dp/sp sharding of the bias is always well-formed
+    kb = None
+    if attn_bias is not None:
+        kb = attn_bias.reshape(attn_bias.shape[0], attn_bias.shape[-1])
+        if kb.shape[0] == 1 and q.shape[0] > 1:
+            kb = jnp.broadcast_to(kb, (q.shape[0], kb.shape[1]))
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
         from jax import shard_map
         spec = P("dp", "tp", "sp", None)
-        fn = shard_map(
-            functools.partial(ring_attention, axis_name="sp",
-                              causal=cfg.causal),
-            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        fn_part = functools.partial(ring_attention, axis_name="sp",
+                                    causal=cfg.causal)
+        if kb is not None:
+            # the bias shards like k's sequence axis; each column rotates
+            # around the ring with its k/v chunk
+            fn = shard_map(fn_part, mesh=mesh,
+                           in_specs=(spec, spec, spec, P("dp", "sp")),
+                           out_specs=spec)
+            return fn(q, k, v, kb)
+        fn = shard_map(fn_part, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec)
         return fn(q, k, v)
     if impl == "flash":
         from ..kernels.flash_attention import flash_attention
-        k_bias = (attn_bias.reshape(attn_bias.shape[0], attn_bias.shape[-1])
-                  if attn_bias is not None else None)
-        return flash_attention(q, k, v, cfg.causal, k_bias=k_bias)
+        return flash_attention(q, k, v, cfg.causal, k_bias=kb)
     T = q.shape[2]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)

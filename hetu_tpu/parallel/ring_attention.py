@@ -26,14 +26,18 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, scale, mask):
+def _block_attend(q, k, v, scale, mask, k_bias=None):
     """One (local_q x chunk_k) attention block.
 
+    ``k_bias``: optional (b, chunk_k) additive per-key bias (key-padding
+    form, 0 valid / -1e9 padded), applied before the causal mask.
     Returns (out, lse): ``out`` is the chunk-local softmax(s) @ v (normalized
     within the chunk) and ``lse`` its log-sum-exp, so two results combine
     exactly as out_new = Σ out_c * exp(lse_c - logaddexp(lse...))."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if k_bias is not None:
+        s = s + k_bias.astype(jnp.float32)[:, None, None, :]
     s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1)                                  # (b,h,q)
     # rows with no visible keys: exp(-inf - -inf) guards via max clamp
@@ -54,10 +58,12 @@ def _merge(acc_num, acc_lse, num, lse):
     return acc_num * a[..., None] + num * b[..., None], new_lse
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   scale: float | None = None):
+def ring_attention(q, k, v, k_bias=None, *, axis_name: str,
+                   causal: bool = True, scale: float | None = None):
     """q/k/v: (batch, heads, local_seq, head_dim), sequence-sharded over
-    ``axis_name``. Returns the local output chunk."""
+    ``axis_name``; ``k_bias``: optional (batch, local_seq) per-key additive
+    bias, sharded like k's sequence axis — it rotates around the ring with
+    its k/v chunk. Returns the local output chunk."""
     n_dev = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -66,25 +72,31 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     q_pos = my_idx * local_s + jnp.arange(local_s)            # absolute rows
 
+    use_bias = k_bias is not None
+
     @functools.partial(jax.checkpoint, prevent_cse=False)
-    def step_compute(q, k_chunk, src_idx, acc_num, acc_lse):
+    def step_compute(q, k_chunk, bias_chunk, src_idx, acc_num, acc_lse):
         k_pos = src_idx * local_s + jnp.arange(local_s)
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((local_s, local_s), bool)
         num, lse = _block_attend(q, k_chunk[0], k_chunk[1], scale,
-                                 mask[None, None])
+                                 mask[None, None], bias_chunk)
         return _merge(acc_num, acc_lse, num, lse)
 
     def body(carry, _):
-        kv, src_idx, acc_num, acc_lse = carry
-        acc_num, acc_lse = step_compute(q, kv, src_idx, acc_num, acc_lse)
-        # rotate: receive the previous device's chunk (ring over ICI)
+        kv, bias, src_idx, acc_num, acc_lse = carry
+        acc_num, acc_lse = step_compute(q, kv, bias, src_idx, acc_num,
+                                        acc_lse)
+        # rotate: receive the previous device's chunk (ring over ICI);
+        # the bias column travels with its k/v chunk
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         kv_next = jax.lax.ppermute(kv, axis_name, perm)
+        bias_next = (jax.lax.ppermute(bias, axis_name, perm) if use_bias
+                     else bias)
         src_next = jax.lax.ppermute(src_idx, axis_name, perm)
-        return (kv_next, src_next, acc_num, acc_lse), None
+        return (kv_next, bias_next, src_next, acc_num, acc_lse), None
 
     # derive the accumulators from q so they carry the same device-varying
     # manual axes as the per-step outputs (scan requires matching carry types
@@ -92,9 +104,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     acc_num = jnp.zeros_like(q, jnp.float32) + 0.0 * q.astype(jnp.float32)
     acc_lse = jnp.sum(0.0 * q.astype(jnp.float32), axis=-1) + _NEG_INF
     kv0 = jnp.stack([k.astype(jnp.float32), v.astype(jnp.float32)])
+    bias0 = k_bias.astype(jnp.float32) if use_bias else None
     src0 = jnp.asarray(my_idx, jnp.int32)
-    (_, _, acc_num, acc_lse), _ = jax.lax.scan(
-        body, (kv0, src0, acc_num, acc_lse), None, length=n_dev)
+    (_, _, _, acc_num, acc_lse), _ = jax.lax.scan(
+        body, (kv0, bias0, src0, acc_num, acc_lse), None, length=n_dev)
 
     # rows with zero visible keys (none under causal with self-block) -> 0
     safe = acc_lse > _NEG_INF / 2
