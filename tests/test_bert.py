@@ -159,6 +159,33 @@ def test_dp_tp_sharded_step_matches_single_device():
                                    np.asarray(ref_params[k]), atol=1e-5)
 
 
+def test_dp_sp_masked_step_matches_single_device():
+    """Sequence-parallel BERT: on a dp2 x sp2 x tp2 mesh 'auto' resolves to
+    RING attention, and a PADDED batch rides the ring as a rotating per-key
+    bias — the sharded masked step must equal the unsharded oracle."""
+    from hetu_tpu.models import transformer as tfm
+
+    mesh = auto_mesh(8, sp=2, tp=2)
+    assert tfm._resolve_attn_impl(TINY.trunk(), mesh, 16,
+                                  jnp.zeros((1, 1, 1, 16))) == "ring"
+    params = bert.init_params(jax.random.PRNGKey(0), TINY)
+    opt = bert.init_opt_state(params)
+    rng = np.random.RandomState(7)
+    T = 16
+    b = _rand_batch(rng, TINY, B=8, T=T, pad_from=12)  # padded tail
+
+    ref_step = bert.make_pretrain_step(TINY, lr=1e-3)
+    ref_loss, _, ref_params, _ = ref_step(
+        jax.tree.map(jnp.copy, params), jax.tree.map(jnp.copy, opt), b)
+
+    step = bert.make_pretrain_step(TINY, mesh=mesh, lr=1e-3)
+    loss, _, new_params, _ = step(params, opt, b)
+    assert float(loss) == pytest.approx(float(ref_loss), rel=1e-4)
+    for k in ("embed", "mlm_dense", "nsp_w"):
+        np.testing.assert_allclose(np.asarray(new_params[k]),
+                                   np.asarray(ref_params[k]), atol=1e-4)
+
+
 def test_finetune_classifier_from_pretrained_trunk():
     """Pretrain briefly, transplant the trunk into a classifier, fine-tune
     on a separable task (label = does the sequence contain token 5): the
