@@ -110,8 +110,13 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                      sample: bool = False, top_k: int = 0,
                      mesh=None):
     """Returns a jitted ``(params, prompt (B, P) int32, rng_key,
-    temperature=1.0) -> (tokens (B, max_len), logits (B, max_len, V))``
-    where tokens[:, :P] echoes the prompt and the rest is generated.
+    temperature=1.0, prompt_lens=None) -> (tokens (B, max_len),
+    logits (B, max_len, V))`` where tokens[:, :P] echoes the prompt and the
+    rest is generated. ``prompt_lens`` (B,) int32 (clamped to [1, P])
+    decodes a RAGGED batch in one call: row b teacher-forces its first
+    prompt_lens[b] tokens and generates from its own boundary — under
+    GREEDY decoding, token-exact vs decoding each row alone (sampling
+    draws from a batch-shaped rng stream, so batched != solo draws).
     ``sample=False``: greedy argmax (rng/temperature unused);
     ``sample=True``: temperature sampling — temperature is a DYNAMIC
     operand, so sweeping it never recompiles. ``top_k > 0`` restricts
@@ -130,9 +135,16 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         cache_sharding = NamedSharding(
             mesh, jax.sharding.PartitionSpec(None, "dp", "tp", None, None))
 
-    def gen(params, prompt, key, temperature=1.0):
+    def gen(params, prompt, key, temperature=1.0, prompt_lens=None):
         B, P = prompt.shape
         assert P <= max_len, f"prompt length {P} > max_len {max_len}"
+        # ragged batches: per-row prompt lengths — row b teacher-forces its
+        # first prompt_lens[b] tokens and starts generating at its OWN
+        # boundary, overwriting the rectangle's padding before any read (the
+        # write for position t happens at step t-1, the read at step t), so
+        # no pad token ever reaches the model or the KV cache
+        plens = (jnp.full((B,), P, jnp.int32) if prompt_lens is None
+                 else jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, P))
         L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
         kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype,
                            device=cache_sharding)
@@ -147,13 +159,13 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                 params, cfg, tok, kcache, vcache, t)
             key, sub = jax.random.split(key)
             nxt = _next_token(logits, sub, sample, top_k, temperature)
-            # teacher-force while the NEXT position is still in the prompt,
-            # and never write past the end (the final step's sample has no
-            # slot — its logits are still returned)
+            # teacher-force while the NEXT position is still in the row's
+            # prompt, and never write past the end (the final step's sample
+            # has no slot — its logits are still returned)
             idx = jnp.minimum(t + 1, max_len - 1)
             cur_next = jax.lax.dynamic_index_in_dim(tok_seq, idx, 1,
                                                     keepdims=False)
-            nxt = jnp.where((t + 1) < P, cur_next, nxt)
+            nxt = jnp.where((t + 1) < plens, cur_next, nxt)
             nxt = jnp.where((t + 1) < max_len, nxt, cur_next)
             tok_seq = jax.lax.dynamic_update_slice(
                 tok_seq, nxt[:, None], (0, idx))
@@ -192,14 +204,21 @@ def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         f"eos_id {eos_id} outside vocab [0, {cfg.vocab_size}) — the model "
         "could never emit it and the loop would never exit early")
 
-    def gen(params, prompt, key, temperature=1.0):
+    def gen(params, prompt, key, temperature=1.0, prompt_lens=None):
         B, P = prompt.shape
         assert P <= max_len
+        plens = (jnp.full((B,), P, jnp.int32) if prompt_lens is None
+                 else jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, P))
         L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
         kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
         vcache = jnp.zeros_like(kcache)
         padded = jnp.full((B, max_len), eos_id, jnp.int32)
         padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
+        # ragged batches: the rectangle's pad beyond a row's OWN length must
+        # not survive an early exit — the documented contract is an
+        # eos-filled tail (generation overwrites from plens[b] as it runs)
+        pos = jnp.arange(max_len)[None, :]
+        padded = jnp.where(pos < plens[:, None], padded, eos_id)
         finished = jnp.zeros((B,), bool)
 
         def cond(state):
@@ -217,7 +236,7 @@ def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                 params, cfg, tok, kcache, vcache, t)
             key, sub = jax.random.split(key)
             nxt = _next_token(logits, sub, sample, top_k, temperature)
-            in_prompt = (t + 1) < P
+            in_prompt = (t + 1) < plens    # per-row (ragged batches)
             cur_next = jax.lax.dynamic_index_in_dim(tok_seq, t + 1, 1,
                                                     keepdims=False)
             nxt = jnp.where(in_prompt, cur_next, nxt)

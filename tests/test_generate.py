@@ -71,6 +71,44 @@ def test_top_k_sampling_restricts_support():
     np.testing.assert_array_equal(np.asarray(toks_k1), greedy)
 
 
+def test_ragged_prompts_match_per_row_decode():
+    """prompt_lens decodes a ragged batch in ONE call: each row must be
+    token-exact vs decoding that row alone with its true length (greedy),
+    for both the scan and the EOS while_loop paths."""
+    params = tfm.init_params(jax.random.PRNGKey(2), CFG)
+    rng = np.random.RandomState(4)
+    lens = [3, 7, 5]
+    Pmax, M = max(lens), 12
+    prompt = np.zeros((len(lens), Pmax), np.int32)
+    for b, ln in enumerate(lens):
+        prompt[b, :ln] = rng.randint(1, CFG.vocab_size, ln)
+    prompt = jnp.asarray(prompt)
+
+    fn = gen.make_generate_fn(CFG, max_len=M)
+    toks, _ = fn(params, prompt, jax.random.PRNGKey(0),
+                 prompt_lens=jnp.asarray(lens, jnp.int32))
+    for b, ln in enumerate(lens):
+        solo, _ = fn(params, prompt[b:b + 1, :ln], jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(toks[b]),
+                                      np.asarray(solo[0]),
+                                      err_msg=f"row {b} (len {ln})")
+
+    # EOS path: same ragged semantics (greedy rows match the scan path up
+    # to each row's first eos; after it the tail is eos-filled)
+    eos = int(np.asarray(toks[0, lens[0]]))  # a token row 0 actually emits
+    efn = gen.make_eos_generate_fn(CFG, max_len=M, eos_id=eos)
+    etoks, _ = efn(params, prompt, jax.random.PRNGKey(0),
+                   prompt_lens=jnp.asarray(lens, jnp.int32))
+    for b, ln in enumerate(lens):
+        row, erow = np.asarray(toks[b]), np.asarray(etoks[b])
+        gen_slice = slice(ln, M)
+        first_eos = np.where(row[gen_slice] == eos)[0]
+        stop = (ln + int(first_eos[0]) + 1) if len(first_eos) else M
+        np.testing.assert_array_equal(erow[:stop], row[:stop],
+                                      err_msg=f"row {b}")
+        assert np.all(erow[stop:] == eos), erow
+
+
 def test_tp_sharded_decode_matches_single_device():
     """Greedy decode on a dp2 x tp2 mesh: params stay Megatron-sharded, the
     KV cache is dp/tp-sharded, tokens match the unsharded decode exactly."""
