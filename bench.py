@@ -178,6 +178,45 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None):
     return out
 
 
+def bench_flash_attention(b=4, h=8, s=4096, d=64, iters=10):
+    """Pallas flash kernels vs the unfused reference form at seq 4096
+    (fwd and full grad, bf16, hard-synced) — the long-context headline."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.kernels.flash_attention import flash_attention, mha_reference
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+               for _ in range(3))
+
+    out = {}
+    for name, fn in (("flash", flash_attention), ("unfused", mha_reference)):
+        fwd = jax.jit(lambda q, k, v, f=fn: f(q, k, v, True))
+        grad = jax.jit(jax.grad(
+            lambda q, k, v, f=fn: jnp.sum(f(q, k, v, True)
+                                          .astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        float(np.asarray(fwd(q, k, v)[0, 0, 0, 0]))   # compile + sync
+        t0 = time.time()
+        for _ in range(iters):
+            o = fwd(q, k, v)
+        float(np.asarray(o[0, 0, 0, 0]))
+        fwd_ms = (time.time() - t0) / iters * 1000
+        g = grad(q, k, v)
+        float(np.asarray(g[0][0, 0, 0, 0]))           # compile + sync
+        t0 = time.time()
+        for _ in range(iters):
+            g = grad(q, k, v)
+        float(np.asarray(g[0][0, 0, 0, 0]))
+        out[name] = {"fwd_ms": round(fwd_ms, 2),
+                     "grad_ms": round((time.time() - t0) / iters * 1000, 2)}
+    out["fwd_speedup"] = round(
+        out["unfused"]["fwd_ms"] / out["flash"]["fwd_ms"], 2)
+    out["grad_speedup"] = round(
+        out["unfused"]["grad_ms"] / out["flash"]["grad_ms"], 2)
+    return out
+
+
 def bench_decode(batch=8, prompt_len=16, max_len=256):
     """KV-cache greedy decode throughput on the 38M flagship (inference
     side of the north star; one compiled scan, hard-synced)."""
@@ -322,6 +361,8 @@ def _run_section(name):
         dtoks, dms = bench_decode()
         out = {"tokens_per_sec": round(dtoks, 0),
                "ms_per_token": round(dms, 3)}
+    elif name == "flash4k":
+        out = bench_flash_attention()
     elif name == "bert":
         out = bench_bert()
     elif name == "probe":
@@ -398,6 +439,7 @@ def main():
                      ("transformer_38M_seq512", "transformer", 420),
                      ("transformer_350M_seq512", "transformer350", 600),
                      ("decode_38M_greedy", "decode", 420),
+                     ("flash_attention_seq4096", "flash4k", 420),
                      ("bert_base_pretrain_seq512", "bert", 600),
                      ("wdl_criteo_hybrid_ps", "wdl", 600)]
 

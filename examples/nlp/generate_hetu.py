@@ -33,7 +33,7 @@ def make_corpus(vocab, n=4096, seed=0):
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--steps", type=int, default=200)  # >= 1 (trains)
     ap.add_argument("--beam", type=int, default=4)
     ap.add_argument("--max-len", type=int, default=16)
     ap.add_argument("--cpu", action="store_true")
@@ -58,7 +58,7 @@ def main(argv=None):
     data = make_corpus(cfg.vocab_size)
     t0 = time.time()
     loss = None
-    for i in range(args.steps):
+    for i in range(max(1, args.steps)):
         batch = data[(i * 64) % len(data):(i * 64) % len(data) + 64]
         tok = jnp.asarray(batch)
         loss, params, opt = step_fn(params, opt, tok,
