@@ -33,7 +33,13 @@ def make_corpus(vocab, n=4096, seed=0):
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=200)  # >= 1 (trains)
+    def positive_int(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("--steps must be >= 1")
+        return v
+
+    ap.add_argument("--steps", type=positive_int, default=200)
     ap.add_argument("--beam", type=int, default=4)
     ap.add_argument("--max-len", type=int, default=16)
     ap.add_argument("--cpu", action="store_true")
@@ -58,7 +64,7 @@ def main(argv=None):
     data = make_corpus(cfg.vocab_size)
     t0 = time.time()
     loss = None
-    for i in range(max(1, args.steps)):
+    for i in range(args.steps):
         batch = data[(i * 64) % len(data):(i * 64) % len(data) + 64]
         tok = jnp.asarray(batch)
         loss, params, opt = step_fn(params, opt, tok,

@@ -1,0 +1,122 @@
+"""Distribution-level initializer checks + scheduler trajectory parity
+(reference ``tests/test_gpu_initializers.py`` and ``test_lr_scheduler.py``:
+the reference validates initializer statistics and per-step lr values; here
+additionally ``get()`` (host, step_count-driven) must agree with
+``get_traced(step)`` (in-jit) at every step)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hetu_tpu import initializers as init
+from hetu_tpu import lr_scheduler as lr
+
+
+SHAPE = (400, 300)
+
+
+def _sample(cls_or_obj):
+    return np.asarray(cls_or_obj.init(jax.random.PRNGKey(0)))
+
+
+def test_constant_zeros_ones():
+    assert np.all(_sample(init.ConstantInit(2.5, SHAPE)) == 2.5)
+    assert np.all(_sample(init.ZerosInit(SHAPE)) == 0.0)
+    assert np.all(_sample(init.OnesInit(SHAPE)) == 1.0)
+
+
+def test_uniform_bounds_and_moments():
+    v = _sample(init.UniformInit(-0.3, 0.7, SHAPE))
+    assert v.min() >= -0.3 and v.max() <= 0.7
+    assert v.mean() == pytest.approx(0.2, abs=0.01)
+    assert v.std() == pytest.approx(1.0 / np.sqrt(12), abs=0.01)
+
+
+def test_normal_moments():
+    v = _sample(init.NormalInit(0.5, 0.2, SHAPE))
+    assert v.mean() == pytest.approx(0.5, abs=0.01)
+    assert v.std() == pytest.approx(0.2, abs=0.01)
+
+
+def test_truncated_normal_bounds_and_std():
+    v = _sample(init.TruncatedNormalInit(0.0, 0.1, SHAPE))
+    assert np.abs(v).max() <= 0.2 + 1e-6      # +/- 2 stddev, like the ref
+    assert v.std() == pytest.approx(0.1, rel=0.2)  # truncation shrinks it
+
+
+@pytest.mark.parametrize("cls,gain,mode", [
+    (init.XavierUniformInit, 3.0, "avg"),
+    (init.HeUniformInit, 6.0, "fan_in"),
+    (init.LecunUniformInit, 3.0, "fan_in"),
+])
+def test_fanaware_uniform_limits(cls, gain, mode):
+    fan_in, fan_out = SHAPE
+    fan = {"fan_in": fan_in, "avg": (fan_in + fan_out) / 2.0}[mode]
+    limit = np.sqrt(gain / fan)
+    v = _sample(cls(SHAPE))
+    assert np.abs(v).max() <= limit + 1e-6
+    assert v.std() == pytest.approx(2 * limit / np.sqrt(12), rel=0.05)
+
+
+@pytest.mark.parametrize("cls,gain,mode", [
+    (init.XavierNormalInit, 1.0, "avg"),
+    (init.HeNormalInit, 2.0, "fan_in"),
+    (init.LecunNormalInit, 1.0, "fan_in"),
+])
+def test_fanaware_normal_std(cls, gain, mode):
+    fan_in, fan_out = SHAPE
+    fan = {"fan_in": fan_in, "avg": (fan_in + fan_out) / 2.0}[mode]
+    v = _sample(cls(SHAPE))
+    assert v.std() == pytest.approx(np.sqrt(gain / fan), rel=0.05)
+    assert v.mean() == pytest.approx(0.0, abs=0.005)
+
+
+@pytest.mark.parametrize("make,expected", [
+    (lambda: lr.FixedScheduler(0.5), [0.5] * 8),
+    (lambda: lr.StepScheduler(0.8, step_size=3, gamma=0.5),
+     [0.8, 0.8, 0.8, 0.4, 0.4, 0.4, 0.2, 0.2]),
+    (lambda: lr.MultiStepScheduler(1.0, milestones=[2, 5], gamma=0.1),
+     [1.0, 1.0, 0.1, 0.1, 0.1, 0.01, 0.01, 0.01]),
+])
+def test_scheduler_trajectories(make, expected):
+    """get() after k step()s and get_traced(k) must both equal the closed
+    form — the device path (traced) and PS path (host) share one schedule."""
+    sched = make()
+    host = []
+    for _ in range(len(expected)):
+        host.append(float(sched.get()))
+        sched.step()
+    traced = [float(make().get_traced(jnp.int32(t)))
+              for t in range(len(expected))]
+    np.testing.assert_allclose(host, expected, rtol=1e-6)
+    np.testing.assert_allclose(traced, expected, rtol=1e-6)
+
+
+def test_exponential_host_traced_parity():
+    sched = lr.ExponentialScheduler(0.5, gamma=0.7)
+    for t in range(12):
+        host = float(sched.get())
+        traced = float(lr.ExponentialScheduler(0.5, gamma=0.7)
+                       .get_traced(jnp.int32(t)))
+        assert host == pytest.approx(0.5 * 0.7 ** t, rel=1e-5), (t, host)
+        assert traced == pytest.approx(host, rel=1e-5), (t, host, traced)
+        sched.step()
+
+
+def test_cosine_trajectory_closed_form():
+    """Against the closed form directly (get() delegates to get_traced, so
+    host/traced parity alone would be tautological here)."""
+    base, steps, ending = 0.5, 10, 0.05
+    sched = lr.CosineScheduler(base, steps, ending)
+    for t in range(14):
+        frac = min(t / steps, 1.0)
+        expected = ending + (base - ending) * 0.5 * (1 + np.cos(np.pi * frac))
+        assert float(sched.get()) == pytest.approx(expected, rel=1e-5), t
+        sched.step()
+    # warmup ramps linearly on top of the cosine value
+    warm = lr.CosineScheduler(base, steps, ending, warmup_steps=4)
+    for t in (0, 1, 2, 3):
+        frac = t / steps
+        cos_lr = ending + (base - ending) * 0.5 * (1 + np.cos(np.pi * frac))
+        assert float(warm.get_traced(jnp.int32(t))) == pytest.approx(
+            cos_lr * t / 4, rel=1e-5), t
