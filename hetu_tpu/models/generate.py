@@ -262,7 +262,11 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
     (tokens (B, K, max_len), scores (B, K))``, beams sorted best-first by
     total log-probability of the generated suffix. Same one-scan KV-cache
     machinery as sampling; beam reordering gathers the cache along the
-    flattened (B*K) batch dim each step."""
+    flattened (B*K) batch dim each step.
+
+    Prompts are RECTANGULAR (every row length P): beam expansion starts at
+    one shared boundary. For ragged batches use the greedy/sampling paths
+    (``prompt_lens``) or call beam per row group of equal lengths."""
     _check_decode_args(cfg, max_len, 0)
     assert beam_size >= 1
     K = beam_size
