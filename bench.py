@@ -155,11 +155,15 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None):
                              causal=False)
     from hetu_tpu.models import transformer as tfm
     impl = tfm._resolve_attn_impl(cfg.trunk(), None, seq_len)
+    fused_ce = (cfg.fused_mlm_ce is True
+                or (cfg.fused_mlm_ce == "auto"
+                    and jax.default_backend() == "tpu"))
     out = {"tokens_per_sec": round(tokens / dt, 0),
            "step_ms": round(dt * 1000, 2),
            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
            "attn_impl": impl,
+           "mlm_ce": "fused" if fused_ce else "einsum",
            "n_params": n_params}
 
     # masked A/B: padded batches keep the fused kernel via the key-padding

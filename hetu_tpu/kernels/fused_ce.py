@@ -1,0 +1,270 @@
+"""Fused linear + softmax cross-entropy ("cut cross-entropy") for TPU.
+
+The standard path materializes the full (N, V) logits tensor in HBM twice
+(forward + backward) — for BERT-base's MLM head that is N=B·P rows against
+V≈30k vocab, ~300 MB of f32 per direction per step, pure bandwidth. This
+kernel never materializes logits: vocab TILES stream through VMEM with an
+online (max, sum) logsumexp — exactly the flash-attention recurrence with
+the vocabulary playing the key axis — and the backward recomputes each
+probability tile from the saved per-row lse (no residual bigger than (N,)).
+
+    nll = fused_linear_nll(h, W, b, targets)   # (N,) per-row -log p[target]
+
+with ``logits = h @ W^T + b`` implied, differentiable wrt h, W, b via
+custom_vjp (targets are integers; their cotangent is None). Reference
+accounting: SURVEY §7 names softmax-CE a Pallas fusion candidate; the
+technique is the public "cut your losses" formulation re-derived for the
+Pallas TPU programming model.
+
+Interpret mode off-TPU (same code runs in the CPU-mesh tests); an XLA
+einsum fallback (`linear_nll_reference`) is the numerical oracle.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_N = 128
+DEFAULT_BLOCK_V = 512
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward: per-row (lse, target_logit)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(h_ref, w_ref, b_ref, tgt_ref, lse_ref, tl_ref, *,
+                block_v, vocab, n_vb):
+    h = h_ref[0].astype(jnp.float32)                  # (Bn, D)
+    tgt = tgt_ref[0, :, 0]                            # (Bn,)
+    Bn = h.shape[0]
+
+    def body(vj, carry):
+        m_prev, l_prev, tl = carry
+        w_blk = w_ref[0, pl.ds(vj * block_v, block_v)].astype(jnp.float32)
+        b_blk = b_ref[0, pl.ds(vj * block_v, block_v), 0].astype(jnp.float32)
+        s = jax.lax.dot_general(h, w_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) + b_blk
+        # vocab tail: positions past V never participate
+        vpos = vj * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, (Bn, block_v), 1)
+        s = jnp.where(vpos < vocab, s, _NEG_INF)
+        # the target logit lives in exactly one tile per row
+        hit = vpos == tgt[:, None]
+        tl = tl + jnp.sum(jnp.where(hit, s, 0.0), axis=1)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        l_new = (l_prev * jnp.exp(m_prev - m_new)
+                 + jnp.sum(jnp.exp(s - m_new[:, None]), axis=1))
+        return m_new, l_new, tl
+
+    m0 = jnp.full((Bn,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Bn,), jnp.float32)
+    tl0 = jnp.zeros((Bn,), jnp.float32)
+    m, l, tl = jax.lax.fori_loop(0, n_vb, body, (m0, l0, tl0))
+    lse_ref[0, :, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    tl_ref[0, :, 0] = tl
+
+
+# ---------------------------------------------------------------------------
+# backward: dh over row blocks; dW/db over vocab blocks — both recompute
+# their probability tile from (h, W, lse), flash-style
+# ---------------------------------------------------------------------------
+
+def _bwd_dh_kernel(h_ref, w_ref, b_ref, tgt_ref, lse_ref, ct_ref, dh_ref, *,
+                   block_v, vocab, n_vb):
+    h = h_ref[0].astype(jnp.float32)
+    tgt = tgt_ref[0, :, 0]
+    lse = lse_ref[0, :, 0]
+    ct = ct_ref[0, :, 0]                              # dloss per row
+    Bn = h.shape[0]
+
+    def body(vj, dh):
+        w_blk = w_ref[0, pl.ds(vj * block_v, block_v)].astype(jnp.float32)
+        b_blk = b_ref[0, pl.ds(vj * block_v, block_v), 0].astype(jnp.float32)
+        s = jax.lax.dot_general(h, w_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) + b_blk
+        vpos = vj * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, (Bn, block_v), 1)
+        p = jnp.where(vpos < vocab, jnp.exp(s - lse[:, None]), 0.0)
+        g = (p - (vpos == tgt[:, None]).astype(jnp.float32)) * ct[:, None]
+        return dh + jax.lax.dot(g, w_blk,
+                                preferred_element_type=jnp.float32)
+
+    dh = jax.lax.fori_loop(0, n_vb, body,
+                           jnp.zeros(h.shape, jnp.float32))
+    dh_ref[0] = dh.astype(dh_ref.dtype)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, b_ref, tgt_ref, lse_ref, ct_ref,
+                   dw_ref, db_ref, *, block_n, vocab, n_nb):
+    w_blk = w_ref[0].astype(jnp.float32)              # (Bv, D)
+    b_blk = b_ref[0, :, 0].astype(jnp.float32)
+    Bv = w_blk.shape[0]
+    vj = pl.program_id(1)
+    vpos = vj * Bv + jax.lax.broadcasted_iota(jnp.int32, (1, Bv), 1)
+
+    def body(nj, carry):
+        dw, db = carry
+        h = h_ref[0, pl.ds(nj * block_n, block_n)].astype(jnp.float32)
+        tgt = tgt_ref[0, pl.ds(nj * block_n, block_n), 0]
+        lse = lse_ref[0, pl.ds(nj * block_n, block_n), 0]
+        ct = ct_ref[0, pl.ds(nj * block_n, block_n), 0]
+        s = jax.lax.dot_general(h, w_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) + b_blk
+        p = jnp.where(vpos < vocab, jnp.exp(s - lse[:, None]), 0.0)
+        g = (p - (vpos == tgt[:, None]).astype(jnp.float32)) * ct[:, None]
+        dw = dw + jax.lax.dot_general(g, h, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        db = db + jnp.sum(g, axis=0)
+        return dw, db
+
+    dw, db = jax.lax.fori_loop(
+        0, n_nb, body,
+        (jnp.zeros(w_blk.shape, jnp.float32), jnp.zeros((Bv,), jnp.float32)))
+    dw_ref[0] = dw.astype(dw_ref.dtype)
+    db_ref[0, :, 0] = db.astype(db_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side plumbing
+# ---------------------------------------------------------------------------
+
+def _pad_to(x, mult, axis, value=0):
+    n = x.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad, constant_values=value)
+
+
+def _resolve_blocks(n, v, block_n, block_v):
+    return min(block_n, max(n, 1)), min(block_v, max(v, 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused(h, w, b, targets, block_n, block_v):
+    out, _ = _fused_fwd(h, w, b, targets, block_n, block_v)
+    return out
+
+
+def fused_linear_nll(h, w, b, targets, block_n=DEFAULT_BLOCK_N,
+                     block_v=DEFAULT_BLOCK_V):
+    """Per-row ``-log softmax(h @ w^T + b)[target]`` without materializing
+    the (N, V) logits. h: (N, D); w: (V, D); b: (V,); targets: (N,) int32.
+    Returns (N,) f32. Differentiable wrt h, w, b."""
+    return _fused(h, w, b, targets, block_n, block_v)
+
+
+def _stage(h, w, b, targets, block_n, block_v):
+    """Pad to block multiples and reshape for the kernels' (1, ·, ·) refs."""
+    N, V = h.shape[0], w.shape[0]
+    block_n, block_v = _resolve_blocks(N, V, block_n, block_v)
+    hp = _pad_to(h, block_n, 0)
+    tp = _pad_to(targets.astype(jnp.int32), block_n, 0)
+    wp = _pad_to(w, block_v, 0)
+    bp = _pad_to(b, block_v, 0)
+    return hp, wp, bp, tp, N, V, block_n, block_v
+
+
+def _fused_fwd(h, w, b, targets, block_n, block_v):
+    hp, wp, bp, tp, N, V, block_n, block_v = _stage(
+        h, w, b, targets, block_n, block_v)
+    Np, Vp, D = hp.shape[0], wp.shape[0], hp.shape[1]
+    n_vb = Vp // block_v
+    lse, tl = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=block_v, vocab=V, n_vb=n_vb),
+        grid=(Np // block_n,),
+        in_specs=[
+            pl.BlockSpec((1, block_n, D), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, Vp, D), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, Vp, 1), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Np, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, Np, 1), jnp.float32),
+        ],
+        interpret=not _on_tpu(),
+    )(hp[None], wp[None], bp[None, :, None], tp[None, :, None])
+    nll = (lse[0, :N, 0] - tl[0, :N, 0])
+    return nll, (h, w, b, targets, lse[0, :, 0])
+
+
+def _fused_bwd(block_n, block_v, res, ct):
+    h, w, b, targets, lse_p = res
+    hp, wp, bp, tp, N, V, block_n, block_v = _stage(
+        h, w, b, targets, block_n, block_v)
+    Np, Vp, D = hp.shape[0], wp.shape[0], hp.shape[1]
+    ctp = _pad_to(ct.astype(jnp.float32), block_n, 0)  # padded rows: ct = 0
+    lsep = lse_p[None, :, None]
+
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, block_v=block_v, vocab=V,
+                          n_vb=Vp // block_v),
+        grid=(Np // block_n,),
+        in_specs=[
+            pl.BlockSpec((1, block_n, D), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, Vp, D), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, Vp, 1), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, block_n, 1), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_n, D), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, Np, D), h.dtype),
+        interpret=not _on_tpu(),
+    )(hp[None], wp[None], bp[None, :, None], tp[None, :, None], lsep,
+      ctp[None, :, None])
+
+    dw, db = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, block_n=block_n, vocab=V,
+                          n_nb=Np // block_n),
+        grid=(1, Vp // block_v),
+        in_specs=[
+            pl.BlockSpec((1, Np, D), lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((1, block_v, D), lambda i, j: (0, j, 0)),
+            pl.BlockSpec((1, block_v, 1), lambda i, j: (0, j, 0)),
+            pl.BlockSpec((1, Np, 1), lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((1, Np, 1), lambda i, j: (0, 0, 0)),
+            pl.BlockSpec((1, Np, 1), lambda i, j: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_v, D), lambda i, j: (0, j, 0)),
+            pl.BlockSpec((1, block_v, 1), lambda i, j: (0, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Vp, D), w.dtype),
+            jax.ShapeDtypeStruct((1, Vp, 1), jnp.float32),
+        ],
+        interpret=not _on_tpu(),
+    )(hp[None], wp[None], bp[None, :, None], tp[None, :, None], lsep,
+      ctp[None, :, None])
+
+    return (dh[0, :N].astype(h.dtype), dw[0, :V].astype(w.dtype),
+            db[0, :V, 0].astype(b.dtype), None)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def linear_nll_reference(h, w, b, targets):
+    """Unfused oracle: materializes the full logits."""
+    logits = (h.astype(jnp.float32) @ w.astype(jnp.float32).T
+              + b.astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, targets[:, None].astype(jnp.int32),
+                                -1)[:, 0]

@@ -46,6 +46,13 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attn_impl: str = "auto"
+    # MLM loss through the fused Pallas linear+softmax-CE kernel
+    # (kernels/fused_ce.py) — never materializes the (B*P, V) logits in
+    # HBM. "auto": engaged on the single-program TPU path (under a mesh
+    # the vocab-sharded decode rides the einsum form — GSPMD cannot
+    # partition the custom kernel; off-TPU interpret mode would be slower
+    # than the einsum). True forces it (tests), False disables.
+    fused_mlm_ce: Any = "auto"
 
     def trunk(self) -> tfm.TransformerConfig:
         return tfm.TransformerConfig(
@@ -108,14 +115,20 @@ def encode(params, input_ids, segment_ids, cfg: BertConfig,
     return tfm._layer_norm(h, params["lnf_scale"], params["lnf_bias"])
 
 
-def mlm_logits(params, h, positions):
-    """Gather (B, P) masked positions from h (B, T, D), run the MLM
-    transform, decode tied to the token embedding. -> (B, P, V) f32."""
+def mlm_transform(params, h, positions):
+    """Gather (B, P) masked positions from h (B, T, D) and run the MLM
+    transform (dense + gelu + LN) -> (B, P, D)."""
     g = jnp.take_along_axis(h, positions[..., None], axis=1)      # (B, P, D)
     g = jnp.einsum("bpd,de->bpe", g, params["mlm_dense"].astype(g.dtype),
                    preferred_element_type=jnp.float32).astype(g.dtype)
     g = jax.nn.gelu(g)
-    g = tfm._layer_norm(g, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    return tfm._layer_norm(g, params["mlm_ln_scale"], params["mlm_ln_bias"])
+
+
+def mlm_logits(params, h, positions):
+    """MLM transform + decode tied to the token embedding -> (B, P, V) f32
+    (the materializing form; the fused path skips this tensor entirely)."""
+    g = mlm_transform(params, h, positions)
     logits = jnp.einsum("bpd,vd->bpv", g, params["embed"].astype(g.dtype),
                         preferred_element_type=jnp.float32)
     return logits + params["mlm_bias"]
@@ -137,10 +150,22 @@ def pretrain_loss(params, batch, cfg: BertConfig, mesh=None):
     where mlm is averaged over real (weighted) prediction slots."""
     h = encode(params, batch["input_ids"], batch["segment_ids"], cfg, mesh,
                batch.get("input_mask"))
-    logits = mlm_logits(params, h, batch["mlm_positions"])
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-    per_slot = -jnp.take_along_axis(
-        logp, batch["mlm_ids"][..., None], -1)[..., 0]            # (B, P)
+    use_fused = (cfg.fused_mlm_ce is True
+                 or (cfg.fused_mlm_ce == "auto"
+                     and jax.default_backend() == "tpu"))
+    if use_fused and mesh is None:
+        from ..kernels.fused_ce import fused_linear_nll
+        g = mlm_transform(params, h, batch["mlm_positions"])
+        B, Pm, D = g.shape
+        per_slot = fused_linear_nll(
+            g.reshape(B * Pm, D),
+            params["embed"].astype(g.dtype), params["mlm_bias"],
+            batch["mlm_ids"].reshape(-1)).reshape(B, Pm)
+    else:
+        logits = mlm_logits(params, h, batch["mlm_positions"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        per_slot = -jnp.take_along_axis(
+            logp, batch["mlm_ids"][..., None], -1)[..., 0]        # (B, P)
     w = batch["mlm_weights"].astype(jnp.float32)
     mlm = jnp.sum(per_slot * w) / jnp.maximum(jnp.sum(w), 1.0)
     nl = jax.nn.log_softmax(nsp_logits(params, h), -1)

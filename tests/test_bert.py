@@ -159,6 +159,28 @@ def test_dp_tp_sharded_step_matches_single_device():
                                    np.asarray(ref_params[k]), atol=1e-5)
 
 
+def test_fused_mlm_ce_matches_materializing_form():
+    """The fused Pallas linear+CE MLM loss (default on the single-program
+    path) must equal the logits-materializing einsum form — loss AND
+    gradients."""
+    import dataclasses
+    params = bert.init_params(jax.random.PRNGKey(0), TINY)
+    b = _rand_batch(np.random.RandomState(11), TINY, B=4)
+    on = dataclasses.replace(TINY, fused_mlm_ce=True)   # force off-TPU
+    off = dataclasses.replace(TINY, fused_mlm_ce=False)
+
+    lf, (mf, _) = bert.pretrain_loss(params, b, on)
+    lo, (mo, _) = bert.pretrain_loss(params, b, off)
+    assert float(lf) == pytest.approx(float(lo), rel=1e-5)
+    assert float(mf) == pytest.approx(float(mo), rel=1e-5)
+
+    gf = jax.grad(lambda p: bert.pretrain_loss(p, b, on)[0])(params)
+    go = jax.grad(lambda p: bert.pretrain_loss(p, b, off)[0])(params)
+    for k in ("embed", "mlm_dense", "mlm_bias", "mlm_ln_scale"):
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(go[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
 def test_dp_sp_masked_step_matches_single_device():
     """Sequence-parallel BERT: on a dp2 x sp2 x tp2 mesh 'auto' resolves to
     RING attention, and a PADDED batch rides the ring as a rotating per-key
