@@ -155,9 +155,8 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None):
                              causal=False)
     from hetu_tpu.models import transformer as tfm
     impl = tfm._resolve_attn_impl(cfg.trunk(), None, seq_len)
-    fused_ce = (cfg.fused_mlm_ce is True
-                or (cfg.fused_mlm_ce == "auto"
-                    and jax.default_backend() == "tpu"))
+    from hetu_tpu.kernels.fused_ce import should_fuse
+    fused_ce = should_fuse(cfg.fused_mlm_ce, None)
     out = {"tokens_per_sec": round(tokens / dt, 0),
            "step_ms": round(dt * 1000, 2),
            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),

@@ -150,10 +150,8 @@ def pretrain_loss(params, batch, cfg: BertConfig, mesh=None):
     where mlm is averaged over real (weighted) prediction slots."""
     h = encode(params, batch["input_ids"], batch["segment_ids"], cfg, mesh,
                batch.get("input_mask"))
-    use_fused = (cfg.fused_mlm_ce is True
-                 or (cfg.fused_mlm_ce == "auto"
-                     and jax.default_backend() == "tpu"))
-    if use_fused and mesh is None:
+    from ..kernels.fused_ce import should_fuse
+    if should_fuse(cfg.fused_mlm_ce, mesh):
         from ..kernels.fused_ce import fused_linear_nll
         g = mlm_transform(params, h, batch["mlm_positions"])
         B, Pm, D = g.shape

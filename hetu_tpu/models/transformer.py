@@ -51,6 +51,12 @@ class TransformerConfig:
     # sequence (sp>1), the fused Pallas kernel on TPU for block-divisible
     # sequences, and the unfused dot-product form otherwise
     attn_impl: str = "auto"     # auto | dot | flash | ring
+    # LM loss through the fused Pallas linear+softmax-CE kernel
+    # (kernels/fused_ce.py): skips the (B*T, V) logits tensor on the
+    # single-program TPU path. "auto" = TPU only; True forces (tests);
+    # False = always materialize. Meshes keep the einsum form (GSPMD
+    # cannot partition the custom kernel).
+    fused_lm_ce: Any = "auto"
 
     @property
     def head_dim(self):
@@ -392,17 +398,38 @@ def encode(params, h, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     return h, aux_sum
 
 
+def forward_hidden(params, tokens, cfg: TransformerConfig,
+                   mesh: Optional[Mesh] = None, dropout_rng=None):
+    """tokens (B, T) int32 -> (hidden (B, T, D), aux) before the LM head."""
+    h = embed_tokens(params, tokens, cfg)
+    h = _constrain(h, mesh, "dp", "sp", None)
+    return encode(params, h, cfg, mesh, dropout_rng=dropout_rng)
+
+
 def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
             dropout_rng=None):
     """tokens (B, T) int32 -> logits (B, T, V)."""
-    h = embed_tokens(params, tokens, cfg)
-    h = _constrain(h, mesh, "dp", "sp", None)
-    h, aux_sum = encode(params, h, cfg, mesh, dropout_rng=dropout_rng)
+    h, aux_sum = forward_hidden(params, tokens, cfg, mesh,
+                                dropout_rng=dropout_rng)
     return lm_head(params, h), aux_sum
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
             aux_weight=0.01, dropout_rng=None):
+    from ..kernels.fused_ce import should_fuse
+    if should_fuse(cfg.fused_lm_ce, mesh):
+        # fused linear+CE: the (B*T, V) logits never exist in HBM; the
+        # head keeps its native (D, V) orientation (no transpose copy)
+        from ..kernels.fused_ce import fused_linear_nll
+        h, aux = forward_hidden(params, tokens, cfg, mesh,
+                                dropout_rng=dropout_rng)
+        h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"])
+        B, T, D = h.shape
+        w = params["head"].astype(h.dtype)            # (D, V), native
+        per = fused_linear_nll(h.reshape(B * T, D), w,
+                               jnp.zeros((w.shape[1],), jnp.float32),
+                               targets.reshape(-1), w_layout="dv")
+        return jnp.mean(per) + aux_weight * aux
     logits, aux = forward(params, tokens, cfg, mesh, dropout_rng=dropout_rng)
     return nll_loss(logits, targets) + aux_weight * aux
 

@@ -109,6 +109,33 @@ def test_pipeline_matches_dense():
     assert losses[-1] < losses[0], losses
 
 
+def test_fused_lm_ce_matches_materializing_form():
+    """The fused linear+CE flagship loss (forced on) must equal the
+    logits-materializing form — loss and grads — and make_train_step must
+    train with it."""
+    import dataclasses
+    cfg_on = tiny_cfg(fused_lm_ce=True)
+    cfg_off = dataclasses.replace(cfg_on, fused_lm_ce=False)
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg_on)
+    tok, tgt = make_data(cfg_on, batch=4, seed=6)
+
+    lf = tfm.loss_fn(params, tok, tgt, cfg_on, None)
+    lo = tfm.loss_fn(params, tok, tgt, cfg_off, None)
+    np.testing.assert_allclose(float(lf), float(lo), rtol=1e-5)
+
+    gf = jax.grad(lambda p: tfm.loss_fn(p, tok, tgt, cfg_on, None))(params)
+    go = jax.grad(lambda p: tfm.loss_fn(p, tok, tgt, cfg_off, None))(params)
+    for k in ("head", "embed", "lnf_scale"):
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(go[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+    step = tfm.make_train_step(cfg_on, lr=1e-2)
+    opt = tfm.init_opt_state(params)
+    l0, params, opt = step(params, opt, tok, tgt)
+    l1, params, opt = step(params, opt, tok, tgt)
+    assert float(l1) < float(l0)
+
+
 def test_pipeline_dropout_matches_trunk():
     """pp2 training WITH dropout must match the single-device trunk running
     grad accumulation with the same key: the pipeline folds key(mb, global
