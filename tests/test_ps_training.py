@@ -472,7 +472,7 @@ def test_bsp_prefetch_exact(tmp_path):
 
 
 def test_hybrid_training(tmp_path):
-    run_cluster(_hybrid_training, tmp_path, n_workers=2, timeout=300)
+    run_cluster(_hybrid_training, tmp_path, n_workers=2, timeout=480)
 
 
 def test_ps_mode_dense_training(tmp_path):
@@ -483,7 +483,7 @@ def test_ps_mode_dense_training(tmp_path):
 
 
 def test_hybrid_training_with_cache(tmp_path):
-    run_cluster(_hybrid_with_cache, tmp_path, n_workers=2, timeout=300)
+    run_cluster(_hybrid_with_cache, tmp_path, n_workers=2, timeout=480)
 
 
 def test_ps_checkpoint_save_load(tmp_path):
