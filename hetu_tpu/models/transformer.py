@@ -458,10 +458,54 @@ def adamw_update(params, grads, opt_state, lr=1e-3, b1=0.9, b2=0.999,
     return new_params, {"m": new_m, "v": new_v, "t": t}
 
 
+def zero1_opt_specs(cfg: TransformerConfig, mesh: Mesh):
+    """ZeRO-1 (optimizer-state sharding over dp): each AdamW m/v slot is
+    additionally sharded over the ``dp`` axis on its first free, divisible
+    dimension. GSPMD then materializes the classic dataflow on its own —
+    gradients reduce-scatter into the shard, the update computes sharded,
+    and the fresh params all-gather back to their training layout
+    (the 'Automatic Cross-Replica Sharding of Weight Update' recipe,
+    arXiv:2004.13336, expressed as sharding annotations). Memory:
+    optimizer state shrinks by ~dp x; step math is bit-identical."""
+    dp = mesh.shape["dp"]
+    specs = param_specs(cfg)
+    shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+    def shard_first_free(spec, shape):
+        parts = tuple(spec) + (None,) * (len(shape.shape) - len(tuple(spec)))
+        for ax, part in enumerate(parts):
+            if part is None and shape.shape[ax] % dp == 0:
+                return P(*parts[:ax], "dp", *parts[ax + 1:])
+        return P(*parts)
+
+    return jax.tree.map(shard_first_free, specs, shapes,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_opt_state(opt_state, cfg: TransformerConfig, mesh: Mesh,
+                    zero1: bool = False):
+    """Place an optimizer state on the mesh — the ZeRO-1 layout when
+    ``zero1`` (jit pins committed input shardings, so the state must be
+    placed before the first step)."""
+    specs = zero1_opt_specs(cfg, mesh) if zero1 else param_specs(cfg)
+    shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                         is_leaf=lambda x: isinstance(x, P))
+    put = functools.partial(jax.tree.map, jax.device_put)
+    return {"m": put(opt_state["m"], shard), "v": put(opt_state["v"], shard),
+            "t": jax.device_put(opt_state["t"], NamedSharding(mesh, P()))}
+
+
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
-                    lr=1e-3, accum_steps: int = 1):
+                    lr=1e-3, accum_steps: int = 1, zero1: bool = False):
     """Returns jitted (params, opt_state, tokens, targets) ->
     (loss, params, opt_state) with GSPMD dp/tp/sp/ep sharding.
+
+    ``zero1=True`` (mesh only): AdamW m/v shard over dp — see
+    ``zero1_opt_specs``; place the state with
+    ``shard_opt_state(opt, cfg, mesh, zero1=True)`` before the first
+    step. Optimizer state memory drops ~dp x; numerics are unchanged
+    (the same update, computed shard-wise).
 
     ``accum_steps > 1``: gradient accumulation — tokens/targets gain a
     leading accumulation axis (A, B, T); microbatch grads are averaged by a
@@ -522,7 +566,13 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     specs = param_specs(cfg)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                           is_leaf=lambda x: isinstance(x, P))
-    opt_shard = {"m": pshard, "v": pshard,
+    if zero1:
+        oshard = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                              zero1_opt_specs(cfg, mesh),
+                              is_leaf=lambda x: isinstance(x, P))
+    else:
+        oshard = pshard
+    opt_shard = {"m": oshard, "v": oshard,
                  "t": NamedSharding(mesh, P())}
     data_shard = NamedSharding(mesh, P(("dp",), None) if accum_steps == 1
                                else P(None, ("dp",), None))
