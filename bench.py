@@ -429,7 +429,11 @@ def _section_subprocess(name, timeout):
         except ProcessLookupError:
             pass
         proc.communicate()
-        return {"error": f"timed out after {timeout}s (hung compile?)"}
+        # "hang" is the structured marker every triage path keys on — an
+        # rc!=0 crash whose stderr merely CONTAINS "timed out" must not be
+        # classified as a backend hang
+        return {"error": f"timed out after {timeout}s (hung compile?)",
+                "hang": True}
     finally:
         if proc.poll() is None:
             try:
@@ -449,12 +453,46 @@ def _section_subprocess(name, timeout):
     return {"error": "no JSON line from section"}
 
 
+def _wait_for_backend(budget, detail):
+    """Probe-wait loop for a tunnel outage the caller JUST observed (so it
+    sleeps before the first probe instead of re-confirming the hang).
+    Spends up to ``budget[0]`` seconds (a single-element list so the spend
+    is SHARED across every outage in the run) probing every 240s. Returns
+    True when a probe succeeds; False when the budget is gone. Observed
+    behavior of the axon tunnel (rounds 3-4): outages are intermittent — it
+    can die 20 minutes into a green run and return minutes later, so
+    mid-run recovery matters as much as the at-start wait."""
+    while True:
+        if budget[0] < 240 + 180:
+            return False
+        print(f"# backend down; retrying probe in 240s "
+              f"({int(budget[0])}s shared wait budget left)",
+              file=sys.stderr, flush=True)
+        time.sleep(240)
+        budget[0] -= 240
+        t0 = time.time()
+        out = _section_subprocess("probe", 180)
+        budget[0] -= time.time() - t0
+        if "error" not in out:
+            detail["outage_recoveries"] = detail.get("outage_recoveries", 0) + 1
+            return True
+        if not out.get("hang"):
+            # the probe CRASHED (backend alive enough to run python):
+            # treat as recovered so sections get their chance
+            detail.setdefault("_probe_crashes", []).append(out["error"])
+            return True
+
+
 def main():
     # the parent NEVER touches jax: a hung backend must not stall the
     # driver's one-JSON-line contract
     detail = {"assumed_peak_tflops": PEAK_TFLOPS}
     headline = 0.0
-    consecutive_timeouts = 0
+    backend_dead = False
+    alive_hangs = 0   # consecutive section hangs while probes still answer
+    # one shared wait budget for every outage in the run (at-start AND
+    # mid-run), so an intermittent tunnel can't stretch the bench unboundedly
+    wait_budget = [float(os.environ.get("HETU_BENCH_PROBE_WAIT_S", "2700"))]
 
     # cheap canary first: a dead tunnel is detected in one 180s probe
     # instead of burning two full section timeouts
@@ -475,55 +513,64 @@ def main():
 
     for key, name, timeout in sections:
         if name == "probe":
-            # Wait-and-retry: a tunnel outage at driver-run time should not
-            # null the whole round if the backend comes back within the
-            # budget (HETU_BENCH_PROBE_WAIT_S, default 45 min). Only probe
-            # TIMEOUTS mean "backend dead" — an rc!=0 probe crash proves the
-            # child ran, so the sections still get their chance.
-            wait_budget = float(os.environ.get("HETU_BENCH_PROBE_WAIT_S",
-                                               "2700"))
-            t0 = time.time()
-            attempt = 0
-            while True:
-                attempt += 1
-                out = _section_subprocess(name, timeout)
-                if "error" not in out:
-                    dev = out.pop("_device", None)
-                    if dev:
-                        detail["device"] = dev
-                    if attempt > 1:
-                        # the backend JUST recovered from an outage: flag it
-                        # so degraded timings aren't blamed on the framework
-                        detail["probe_attempts"] = attempt
-                    break
-                if "timed out" not in out["error"]:
-                    detail["_probe"] = out   # crash, not a hang: run sections
-                    break
-                elapsed = time.time() - t0
-                if elapsed + 240 + timeout > wait_budget:
-                    consecutive_timeouts = 2   # backend dead: skip everything
-                    out["probe_attempts"] = attempt
+            # At-start wait-and-retry: a tunnel outage at driver-run time
+            # should not null the round if the backend comes back within the
+            # shared budget (HETU_BENCH_PROBE_WAIT_S, default 45 min). Only
+            # probe TIMEOUTS mean "backend dead" — an rc!=0 probe crash
+            # proves the child ran, so the sections still get their chance.
+            out = _section_subprocess(name, timeout)
+            if "error" not in out:
+                dev = out.pop("_device", None)
+                if dev:
+                    detail["device"] = dev
+            elif out.get("hang"):
+                wait_budget[0] -= timeout   # the observed hang IS attempt 1
+                if not _wait_for_backend(wait_budget, detail):
+                    backend_dead = True
                     detail["_probe"] = out
-                    break
-                print(f"# probe timed out (attempt {attempt}); retrying in "
-                      f"240s ({int(wait_budget - elapsed)}s budget left)",
-                      file=sys.stderr, flush=True)
-                time.sleep(240)
+                # on recovery: nothing stale recorded — outage_recoveries
+                # carries the "started down, came back" signal
+            else:
+                detail["_probe"] = out   # crash, not a hang: run sections
             continue
-        if consecutive_timeouts >= 2:
-            # the tunnel is dead; do not burn the remaining budget
+        if backend_dead:
+            # wait budget exhausted with the tunnel still down
             detail[key] = {"error": "skipped: backend unresponsive"}
             continue
+        if alive_hangs >= 2:
+            # backstop: probes answer but sections keep hanging (a systemic
+            # compile-path hang, not an outage) — don't burn timeout+probe
+            # on every remaining section
+            detail[key] = {"error": "skipped: sections hanging with live "
+                                    "backend"}
+            continue
         out = _section_subprocess(name, timeout)
-        if "error" in out:
-            # only hangs count toward "unresponsive" — an rc!=0 child DID
-            # run, so the backend is alive
-            if "timed out" in out["error"]:
-                consecutive_timeouts += 1
-            else:
-                consecutive_timeouts = 0
-        else:
-            consecutive_timeouts = 0
+        if out.get("hang"):
+            # a hung section is EITHER a dead tunnel or a genuinely hung
+            # compile — a 180s probe tells them apart. Backend alive →
+            # record the section failure and move on; backend down → wait
+            # it out and retry this section ONCE (rounds 3-4 showed the
+            # tunnel can drop mid-run and return minutes later).
+            t0 = time.time()
+            probe = _section_subprocess("probe", 180)
+            wait_budget[0] -= time.time() - t0
+            if probe.get("hang"):
+                # outage: the section's burned timeout counts against the
+                # shared budget — an intermittent tunnel must not stretch
+                # the run unboundedly via un-charged section hangs
+                wait_budget[0] -= timeout
+                detail.setdefault("mid_run_outages", []).append(key)
+                if _wait_for_backend(wait_budget, detail):
+                    out = _section_subprocess(name, timeout)
+                else:
+                    backend_dead = True
+                    detail[key] = {"error": "backend lost mid-run; wait "
+                                            "budget exhausted"}
+                    continue
+        # consecutive-hang bookkeeping on the FINAL outcome (a post-outage
+        # retry that hangs counts; any completed section resets)
+        alive_hangs = alive_hangs + 1 if out.get("hang") else 0
+        if "error" not in out:
             dev = out.pop("_device", None)
             if dev and "device" not in detail:
                 detail["device"] = dev
