@@ -1,0 +1,209 @@
+"""Bench-driver orchestration: outage triage, recovery, and backstops.
+
+The driver captures BENCH_r{N}.json by running ``bench.py`` once per round
+against a tunneled TPU whose observed failure mode (rounds 2-4) is
+INTERMITTENT outage — green probe, a few sections captured, then hangs.
+These tests pin the orchestration loop's behavior with a scripted
+``_section_subprocess`` (no backend, no subprocesses, no sleeps), covering:
+
+- at-start outage -> wait-and-retry -> recovery runs every section
+- mid-run outage -> section retried once after recovery
+- genuine alive-backend hangs -> recorded, run continues; 2 consecutive
+  trip the skip-remaining backstop; non-consecutive do not
+- hang classification is structural (the "hang" marker), not a substring
+  match on error text, so a crash mentioning "timed out" runs the sections
+- exhausted wait budget -> fail-closed: rc=1, null headline
+
+Reference analogue: the reference has no bench driver (BASELINE.md — it
+prints timings ad hoc); this hardening exists because OUR scoreboard is a
+single unattended run.
+"""
+import io
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+TO = {"error": "timed out after 420s (hung compile?)", "hang": True}
+OK = {"samples_per_sec": 100.0, "_device": "TPU v5 lite"}
+PROBE_OK = {"ok": True, "_device": "TPU v5 lite"}
+PROBE_TO = {"error": "timed out after 180s (hung compile?)", "hang": True}
+DEFAULT = {"samples_per_sec": 50.0, "_device": "TPU v5 lite"}
+
+
+def run_sim(monkeypatch, behavior, budget=None):
+    """Run bench.main() --fast with a scripted section runner.
+
+    ``behavior``: section name -> list of results returned per successive
+    call (the last entry repeats). Unlisted sections return DEFAULT.
+    Returns (rc, parsed JSON line).
+    """
+    state = {}
+
+    def fake(name, timeout):
+        lst = behavior.get(name, [DEFAULT])
+        i = state.get(name, 0)
+        state[name] = i + 1
+        return dict(lst[min(i, len(lst) - 1)])
+
+    monkeypatch.setattr(bench, "_section_subprocess", fake)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    if budget is not None:
+        monkeypatch.setenv("HETU_BENCH_PROBE_WAIT_S", str(budget))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--fast"])
+    buf = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", buf)
+    rc = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        rc = e.code or 0
+    line = buf.getvalue().strip().splitlines()[-1]
+    return rc, json.loads(line)
+
+
+def test_green_run_headline_is_max_resnet(monkeypatch):
+    rc, out = run_sim(monkeypatch, {"resnet:512:bf16": [OK]})
+    assert rc == 0
+    assert out["value"] == 100.0          # max over resnet cells
+    assert out["detail"]["device"] == "TPU v5 lite"
+    # _device never leaks into the recorded cells
+    assert all("_device" not in v for v in out["detail"].values()
+               if isinstance(v, dict))
+
+
+def test_at_start_outage_then_recovery_runs_all_sections(monkeypatch):
+    rc, out = run_sim(monkeypatch, {"probe": [PROBE_TO, PROBE_OK]})
+    d = out["detail"]
+    assert rc == 0 and out["value"] == 50.0
+    assert d.get("outage_recoveries") == 1
+    assert "_probe" not in d              # no stale dead-tunnel evidence
+
+
+def test_midrun_outage_retries_section_after_recovery(monkeypatch):
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_TO, PROBE_OK],
+        "resnet:512:bf16": [TO, OK],
+    })
+    d = out["detail"]
+    assert rc == 0
+    assert d["resnet18_bf16_bs512"] == {"samples_per_sec": 100.0}
+    assert d["mid_run_outages"] == ["resnet18_bf16_bs512"]
+    assert d["outage_recoveries"] == 1
+
+
+def test_two_consecutive_alive_hangs_trip_backstop(monkeypatch):
+    rc, out = run_sim(monkeypatch, {
+        "resnet:128:bf16": [TO], "resnet:512:bf16": [TO],
+    })
+    d = out["detail"]
+    assert "timed out" in d["resnet18_bf16_bs128"]["error"]
+    assert "timed out" in d["resnet18_bf16_bs512"]["error"]
+    for k in ("resnet18_f32_bs128", "resnet18_bf16_bs256",
+              "resnet18_f32_bs256"):
+        assert "hanging with live backend" in d[k]["error"]
+
+
+def test_non_consecutive_alive_hangs_do_not_trip_backstop(monkeypatch):
+    rc, out = run_sim(monkeypatch, {
+        "resnet:128:bf16": [TO], "resnet:128:f32": [TO],
+    })
+    d = out["detail"]
+    assert rc == 0 and out["value"] == 50.0
+    assert d["resnet18_f32_bs256"] == {"samples_per_sec": 50.0}
+
+
+def test_successful_postoutage_retry_resets_hang_counter(monkeypatch):
+    # three sections each hang-into-outage then succeed on retry: the
+    # backstop must NOT trip (counter resets on every completed section)
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK] + [PROBE_TO, PROBE_OK] * 3,
+        "resnet:128:bf16": [TO, OK],
+        "resnet:512:bf16": [TO, OK],
+        "resnet:128:f32": [TO, OK],
+    }, budget=100000)
+    d = out["detail"]
+    assert rc == 0
+    for k in ("resnet18_bf16_bs128", "resnet18_bf16_bs512",
+              "resnet18_f32_bs128"):
+        assert d[k] == {"samples_per_sec": 100.0}
+    assert d["outage_recoveries"] == 3
+
+
+def test_flapping_tunnel_retry_hangs_do_not_trip_backstop(monkeypatch):
+    # two sections each: hang -> outage -> recover -> retry hangs -> probe
+    # hangs AGAIN (flap). Neither counts as an alive-hang, so later
+    # sections still run; the cells carry the flap attribution.
+    # probe call order: at-start OK; section A triage TO, wait-loop OK,
+    # retry-triage TO (flap); section B triage TO, wait-loop OK,
+    # retry-triage TO (flap)
+    flap = [PROBE_OK,
+            PROBE_TO, PROBE_OK, PROBE_TO,
+            PROBE_TO, PROBE_OK, PROBE_TO]
+    rc, out = run_sim(monkeypatch, {
+        "probe": flap,
+        "resnet:128:bf16": [TO, TO],
+        "resnet:512:bf16": [TO, TO],
+    }, budget=100000)
+    d = out["detail"]
+    assert "tunnel flapping" in d["resnet18_bf16_bs128"]["error"]
+    assert "tunnel flapping" in d["resnet18_bf16_bs512"]["error"]
+    # backstop NOT tripped: remaining sections completed normally
+    assert d["resnet18_f32_bs128"] == {"samples_per_sec": 50.0}
+    assert d["resnet18_f32_bs256"] == {"samples_per_sec": 50.0}
+
+
+def test_device_recorded_from_recovery_probe_when_sections_fail(monkeypatch):
+    crash = {"error": "rc=1: Traceback ..."}
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_TO, PROBE_OK],
+        "resnet:128:bf16": [crash], "resnet:512:bf16": [crash],
+        "resnet:128:f32": [crash], "resnet:256:bf16": [crash],
+        "resnet:256:f32": [crash],
+    })
+    assert rc == 1 and out["value"] is None
+    assert out["detail"]["device"] == "TPU v5 lite"
+
+
+def test_exhausted_budget_fails_closed(monkeypatch):
+    rc, out = run_sim(monkeypatch, {"probe": [PROBE_TO]}, budget=1)
+    d = out["detail"]
+    assert rc == 1 and out["value"] is None and out["vs_baseline"] is None
+    assert d["_probe"]["hang"] is True
+    assert all("unresponsive" in d[k]["error"] for k in d
+               if k.startswith("resnet"))
+
+
+def test_probe_crash_with_timeout_text_is_not_a_hang(monkeypatch):
+    crash = {"error": "rc=1: TimeoutError: connection timed out"}
+    rc, out = run_sim(monkeypatch, {"probe": [crash]})
+    d = out["detail"]
+    assert rc == 0 and out["value"] == 50.0     # sections ran
+    assert d["_probe"] == crash
+
+
+def test_midrun_budget_exhaustion_skips_remaining(monkeypatch):
+    # outage mid-run with a budget too small to wait out: the hung section
+    # and everything after it are skipped, earlier results survive
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_TO],
+        "resnet:512:bf16": [TO],
+    }, budget=700)
+    d = out["detail"]
+    assert rc == 0 and out["value"] == 50.0     # bs128 captured first
+    assert d["resnet18_bf16_bs128"] == {"samples_per_sec": 50.0}
+    assert "budget exhausted" in d["resnet18_bf16_bs512"]["error"]
+    assert "unresponsive" in d["resnet18_f32_bs128"]["error"]
+
+
+def test_subprocess_timeout_result_carries_hang_marker():
+    # the structured marker is load-bearing for every triage path; pin the
+    # REAL timeout return shape: a 1s deadline kills the child during
+    # interpreter startup regardless of backend state
+    out = bench._section_subprocess("probe", 1)
+    assert out.get("hang") is True
+    assert "timed out after 1s" in out["error"]
