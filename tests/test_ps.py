@@ -9,6 +9,7 @@ threads deadlocks).
 """
 import multiprocessing as mp
 import os
+import queue as pyqueue
 import shutil
 import tempfile
 import time
@@ -98,10 +99,43 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
     for p in procs:
         p.start()
     results = {}
+    deadline = time.time() + timeout
     try:
-        for _ in range(n_workers):
-            rank, status, err = result_q.get(timeout=timeout)
-            results[rank] = (status, err)
+        # Poll instead of one blocking get so failures surface the moment
+        # they happen rather than after the full timeout, and so queue.Empty
+        # is reserved for the one retryable meaning: "host too slow".
+        while len(results) < n_workers:
+            try:
+                rank, status, err = result_q.get(timeout=2)
+                results[rank] = (status, err)
+                if status != "ok":
+                    # fail fast with the real traceback — a failed worker's
+                    # peer may hang on a barrier forever, and that hang must
+                    # not reclassify this failure as a timeout
+                    raise AssertionError(f"worker {rank} failed:\n{err}")
+                continue
+            except pyqueue.Empty:
+                pass
+            # a worker that died without reporting (e.g. a native crash
+            # _worker_body's except clause cannot catch, ANY exit code)
+            worker_procs = procs[1 + n_servers:]
+            dead = {i: p.exitcode for i, p in enumerate(worker_procs)
+                    if i not in results and not p.is_alive()}
+            if dead:
+                raise RuntimeError(
+                    f"worker(s) died without reporting: "
+                    f"{{rank: exitcode}} = {dead}")
+            # scheduler/server crash (abnormal exit only — they run until
+            # the stopfile during a healthy run)
+            infra = procs[:1 + n_servers]
+            dead_infra = {i: p.exitcode for i, p in enumerate(infra)
+                          if not p.is_alive() and p.exitcode not in (0, None)}
+            if dead_infra:
+                raise RuntimeError(
+                    f"scheduler/server died: {{idx: exitcode}} = "
+                    f"{dead_infra}")
+            if time.time() > deadline:
+                raise pyqueue.Empty
     finally:
         with open(stopfile, "w") as f:
             f.write("stop")
@@ -296,10 +330,24 @@ def _oob_row_ids(client, rank, tmpdir):
     np.testing.assert_allclose(out, 0.0)
 
 
+def _exits_without_reporting(client, rank, tmpdir):
+    os._exit(3)   # simulates a native crash: no result ever enqueued
+
+
 # ---------------------------------------------------------------------------
 
 def test_ps_dense_ops(tmp_path):
     run_cluster(_dense_ops, tmp_path)
+
+
+def test_dead_worker_is_not_a_timeout(tmp_path):
+    # a worker that dies without reporting must surface as the distinct
+    # dead-worker RuntimeError (never retried by callers), not as the
+    # retryable slow-host queue.Empty
+    import pytest
+    with pytest.raises(RuntimeError, match="died without reporting"):
+        run_cluster(_exits_without_reporting, tmp_path, n_workers=1,
+                    timeout=20)
 
 
 def test_ps_oob_row_ids(tmp_path):

