@@ -1,0 +1,55 @@
+"""Standalone PS scheduler/server process body — run by FILE PATH, never
+imported.
+
+A PS scheduler or server is a pure C++ TCP loop (csrc/ps/{scheduler,
+server}.h); it needs ctypes and nothing else. Spawning it as a
+``multiprocessing`` child of a module inside the package made every
+cluster bootstrap pay the full ``hetu_tpu`` import (jax + flax, seconds
+per process) just to reach a ``CDLL(...).Init()``. Executing THIS file
+directly (``python .../_light_main.py``) sidesteps the package import
+entirely: cluster startup is interpreter start + dlopen.
+
+Contract (mirrors hetu_tpu/ps/server.py's role entry points exactly):
+- env ``HETU_PS_LIB``: path to the built libhetu_ps.so (the parent has
+  already run csrc/build.py's build()).
+- env ``DMLC_ROLE``: "scheduler" (Init → SchedulerWait → Finalize) or
+  "server" (Init → StartServer implicit via role → poll
+  ``HETU_PS_STOPFILE`` → Finalize). Topology comes from the same DMLC_*
+  env vars the reference uses (runner.py:186-190).
+
+Reference parity: the reference launches these roles as separate python
+processes through its own binding too (gpu_ops/executor.py:80-100); this
+file is that launcher minus the framework import.
+"""
+import ctypes
+import os
+import sys
+import time
+
+
+def main():
+    lib = ctypes.CDLL(os.environ["HETU_PS_LIB"])
+    lib.LastError.restype = ctypes.c_char_p
+    lib.Init()
+    err = lib.LastError()
+    if err:
+        raise RuntimeError(err.decode())
+    role = os.environ["DMLC_ROLE"]
+    if role == "scheduler":
+        lib.SchedulerWait()
+        lib.Finalize()
+    elif role == "server":
+        lib.StartServer()
+        err = lib.LastError()
+        if err:
+            raise RuntimeError(err.decode())
+        stopfile = os.environ["HETU_PS_STOPFILE"]
+        while not os.path.exists(stopfile):
+            time.sleep(0.05)
+        lib.Finalize()
+    else:
+        raise SystemExit(f"unsupported role for light main: {role!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,12 +11,15 @@ deliberately does not (the caller IS the worker).
 from __future__ import annotations
 
 import contextlib
-import multiprocessing
 import os
 import socket
 import shutil
+import subprocess
+import sys
 import tempfile
-import time
+
+_LIGHT_MAIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "_light_main.py")
 
 
 def _ps_env(port: int, n_workers: int, n_servers: int) -> dict:
@@ -26,27 +29,40 @@ def _ps_env(port: int, n_workers: int, n_servers: int) -> dict:
             "DMLC_NUM_SERVER": str(n_servers)}
 
 
-def _sched_proc(port, n_workers, n_servers):
-    os.environ.update(_ps_env(port, n_workers, n_servers))
-    os.environ["DMLC_ROLE"] = "scheduler"
-    from . import server as srv
-    srv.start_scheduler_from_env()
-    srv.scheduler_wait()
-    srv.stop_scheduler()
+def spawn_light_role(role: str, env_extra: dict) -> subprocess.Popen:
+    """Launch a scheduler/server as a LIGHT process: ``_light_main.py``
+    executed by file path needs only ctypes + the prebuilt lib — no
+    hetu_tpu/jax import (seconds per process saved at every cluster
+    bootstrap). Shared by this module and tests/test_ps.run_cluster."""
+    from ..csrc.build import build
+    env = os.environ.copy()
+    env.update(env_extra)
+    env["DMLC_ROLE"] = role
+    env["HETU_PS_LIB"] = build("libhetu_ps.so")
+    return subprocess.Popen([sys.executable, _LIGHT_MAIN], env=env)
 
 
-def _server_proc(port, n_workers, n_servers, idx, stopfile):
-    os.environ.update(_ps_env(port, n_workers, n_servers))
-    os.environ.update({"DMLC_ROLE": "server", "SERVER_ID": str(idx),
-                       "DMLC_PS_SERVER_URI": "127.0.0.1",
-                       # port 0: bind an OS-assigned port, registered with
-                       # the scheduler (race-free, commit 5eca2ab)
-                       "DMLC_PS_SERVER_PORT": "0"})
-    from . import server as srv
-    srv.start_server_from_env()
-    while not os.path.exists(stopfile):
-        time.sleep(0.05)
-    srv.stop_server()
+def spawn_light_server(idx: int, base_env: dict, stopfile: str,
+                       port: str = "0") -> subprocess.Popen:
+    """Server-role wrapper over ``spawn_light_role`` carrying the full
+    bootstrap contract in ONE place (``_light_main.py`` hard-fails on a
+    missing key). ``port="0"``: bind an OS-assigned port, registered with
+    the scheduler (race-free)."""
+    return spawn_light_role("server", {**base_env, "SERVER_ID": str(idx),
+                                       "DMLC_PS_SERVER_URI": "127.0.0.1",
+                                       "DMLC_PS_SERVER_PORT": port,
+                                       "HETU_PS_STOPFILE": stopfile})
+
+
+def reap_light_procs(procs, timeout: float = 15.0):
+    """Wait for light children; SIGKILL stragglers AND reap them (a kill
+    without a wait leaves a zombie for the rest of the session)."""
+    for p in procs:
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
 
 
 @contextlib.contextmanager
@@ -61,24 +77,19 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
     # race-prone: the generated name can be claimed by another process)
     stopdir = tempfile.mkdtemp(prefix="hetu_ps_stop_")
     stopfile = os.path.join(stopdir, "stop")
-    ctx = multiprocessing.get_context("spawn")
-    procs = [ctx.Process(target=_sched_proc,
-                         args=(port, n_workers, n_servers))]
-    procs += [ctx.Process(target=_server_proc,
-                          args=(port, n_workers, n_servers, i, stopfile))
-              for i in range(n_servers)]
-    for p in procs:
-        p.start()
-    os.environ.update(_ps_env(port, n_workers, n_servers))
-    os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
+    base = _ps_env(port, n_workers, n_servers)
+    procs = []
     try:
+        # spawn INSIDE the try: if a later spawn fails, the finally still
+        # signals and reaps the children already running
+        procs.append(spawn_light_role("scheduler", base))
+        procs += [spawn_light_server(i, base, stopfile)
+                  for i in range(n_servers)]
+        os.environ.update(base)
+        os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
         yield port
     finally:
         with open(stopfile, "w") as f:
             f.write("stop")
-        for p in procs:
-            p.join(timeout=15)
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
+        reap_light_procs(procs)
         shutil.rmtree(stopdir, ignore_errors=True)

@@ -8,7 +8,6 @@ processes: PS scheduler + server (OS-assigned port, registered via the
 scheduler) + 2 dual-role workers, launched through the shared
 ``test_multihost._run_world`` harness.
 """
-import multiprocessing as mp
 import os
 
 import pytest
@@ -18,16 +17,15 @@ from test_multihost import _run_world
 
 def test_two_host_hybrid_dense_gloo_sparse_ps(tmp_path):
     from hetu_tpu.runner import _get_available_port
-    from hetu_tpu.ps.local_cluster import _sched_proc, _server_proc
+    from hetu_tpu.ps.local_cluster import (_ps_env, reap_light_procs,
+                                           spawn_light_role,
+                                           spawn_light_server)
 
     ps_port = _get_available_port("127.0.0.1")
-    ctx = mp.get_context("spawn")
     stopfile = str(tmp_path / "stop")
-    procs = [ctx.Process(target=_sched_proc, args=(ps_port, 2, 1)),
-             ctx.Process(target=_server_proc,
-                         args=(ps_port, 2, 1, 0, stopfile))]
-    for p in procs:
-        p.start()
+    base = _ps_env(ps_port, 2, 1)
+    procs = [spawn_light_role("scheduler", base),
+             spawn_light_server(0, base, stopfile)]
     try:
         results = _run_world(
             nproc=2, timeout=240, script="mh_hybrid_worker.py",
@@ -39,11 +37,7 @@ def test_two_host_hybrid_dense_gloo_sparse_ps(tmp_path):
     finally:
         with open(stopfile, "w") as f:
             f.write("stop")
-        for p in procs:
-            p.join(timeout=15)
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
+        reap_light_procs(procs)
 
     r0 = next(r for r in results if r["pid"] == 0)
     r1 = next(r for r in results if r["pid"] == 1)

@@ -52,15 +52,6 @@ def _run_scheduler(port, n_workers, n_servers):
     srv.stop_scheduler()
 
 
-def _run_server(idx, port, n_workers, n_servers, stopfile):
-    os.environ.update(_env("server", idx, port, n_workers, n_servers))
-    from hetu_tpu.ps import server as srv
-    srv.start_server_from_env()
-    while not os.path.exists(stopfile):
-        time.sleep(0.05)
-    srv.stop_server()
-
-
 def _worker_body(rank, port, n_workers, n_servers, fn, tmpdir, result_q):
     os.environ.update(_env("worker", rank, port, n_workers, n_servers))
     import jax
@@ -79,28 +70,36 @@ def _worker_body(rank, port, n_workers, n_servers, fn, tmpdir, result_q):
 
 def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
                 timeout=120):
-    """Spawn scheduler/servers/workers as local processes (spawn method);
+    """Spawn scheduler/servers (LIGHT subprocesses — ctypes-only, no
+    hetu_tpu/jax import) and workers (spawn method, full framework);
     assert every worker body passed."""
+    from hetu_tpu.ps.local_cluster import (reap_light_procs,
+                                           spawn_light_role,
+                                           spawn_light_server)
     ctx = mp.get_context("spawn")
     port = next(_port_iter)
     stopdir = tempfile.mkdtemp(prefix="hetups_stop_")
     stopfile = os.path.join(stopdir, "stop")
     result_q = ctx.Queue()
-    procs = [ctx.Process(target=_run_scheduler,
-                         args=(port, n_workers, n_servers))]
-    for s in range(n_servers):
-        procs.append(ctx.Process(target=_run_server,
-                                 args=(s, port, n_workers, n_servers, stopfile)))
-    for w in range(n_workers):
-        procs.append(ctx.Process(
-            target=_worker_body,
-            args=(w, port, n_workers, n_servers, worker_fn, str(tmpdir),
-                  result_q)))
-    for p in procs:
-        p.start()
+    infra = []
+    procs = []
     results = {}
     deadline = time.time() + timeout
     try:
+        # spawn INSIDE the try so a partial bootstrap still gets reaped
+        infra.append(spawn_light_role(
+            "scheduler", _env("scheduler", 0, port, n_workers, n_servers)))
+        for s in range(n_servers):
+            infra.append(spawn_light_server(
+                s, _env("server", s, port, n_workers, n_servers), stopfile,
+                port=str(port + 1 + s)))
+        for w in range(n_workers):
+            procs.append(ctx.Process(
+                target=_worker_body,
+                args=(w, port, n_workers, n_servers, worker_fn, str(tmpdir),
+                      result_q)))
+        for p in procs:
+            p.start()
         # Poll instead of one blocking get so failures surface the moment
         # they happen rather than after the full timeout, and so queue.Empty
         # is reserved for the one retryable meaning: "host too slow".
@@ -118,8 +117,7 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
                 pass
             # a worker that died without reporting (e.g. a native crash
             # _worker_body's except clause cannot catch, ANY exit code)
-            worker_procs = procs[1 + n_servers:]
-            dead = {i: p.exitcode for i, p in enumerate(worker_procs)
+            dead = {i: p.exitcode for i, p in enumerate(procs)
                     if i not in results and not p.is_alive()}
             if dead:
                 raise RuntimeError(
@@ -127,9 +125,8 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
                     f"{{rank: exitcode}} = {dead}")
             # scheduler/server crash (abnormal exit only — they run until
             # the stopfile during a healthy run)
-            infra = procs[:1 + n_servers]
-            dead_infra = {i: p.exitcode for i, p in enumerate(infra)
-                          if not p.is_alive() and p.exitcode not in (0, None)}
+            dead_infra = {i: p.returncode for i, p in enumerate(infra)
+                          if p.poll() is not None and p.returncode != 0}
             if dead_infra:
                 raise RuntimeError(
                     f"scheduler/server died: {{idx: exitcode}} = "
@@ -144,6 +141,7 @@ def run_cluster(worker_fn, tmpdir="/tmp", n_workers=2, n_servers=2,
         for p in procs:
             if p.is_alive():
                 p.terminate()
+        reap_light_procs(infra, timeout=20)
         shutil.rmtree(stopdir, ignore_errors=True)
     for rank, (status, err) in sorted(results.items()):
         assert status == "ok", f"worker {rank} failed:\n{err}"
