@@ -87,34 +87,34 @@ def test_at_start_outage_then_recovery_runs_all_sections(monkeypatch):
 def test_midrun_outage_retries_section_after_recovery(monkeypatch):
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK, PROBE_TO, PROBE_OK],
-        "resnet:512:bf16": [TO, OK],
+        "resnet:128:f32": [TO, OK],
     })
     d = out["detail"]
     assert rc == 0
-    assert d["resnet18_bf16_bs512"] == {"samples_per_sec": 100.0}
-    assert d["mid_run_outages"] == ["resnet18_bf16_bs512"]
+    assert d["resnet18_f32_bs128"] == {"samples_per_sec": 100.0}
+    assert d["mid_run_outages"] == ["resnet18_f32_bs128"]
     assert d["outage_recoveries"] == 1
 
 
 def test_two_consecutive_alive_hangs_trip_backstop(monkeypatch):
     rc, out = run_sim(monkeypatch, {
-        "resnet:128:bf16": [TO], "resnet:512:bf16": [TO],
+        "resnet:128:bf16": [TO], "resnet:128:f32": [TO],
     })
     d = out["detail"]
     assert "timed out" in d["resnet18_bf16_bs128"]["error"]
-    assert "timed out" in d["resnet18_bf16_bs512"]["error"]
-    for k in ("resnet18_f32_bs128", "resnet18_bf16_bs256",
-              "resnet18_f32_bs256"):
+    assert "timed out" in d["resnet18_f32_bs128"]["error"]
+    for k in ("resnet18_f32_bs256", "resnet18_bf16_bs256",
+              "resnet18_bf16_bs512"):
         assert "hanging with live backend" in d[k]["error"]
 
 
 def test_non_consecutive_alive_hangs_do_not_trip_backstop(monkeypatch):
     rc, out = run_sim(monkeypatch, {
-        "resnet:128:bf16": [TO], "resnet:128:f32": [TO],
+        "resnet:128:bf16": [TO], "resnet:256:f32": [TO],
     })
     d = out["detail"]
     assert rc == 0 and out["value"] == 50.0
-    assert d["resnet18_f32_bs256"] == {"samples_per_sec": 50.0}
+    assert d["resnet18_f32_bs128"] == {"samples_per_sec": 50.0}
 
 
 def test_successful_postoutage_retry_resets_hang_counter(monkeypatch):
@@ -123,13 +123,13 @@ def test_successful_postoutage_retry_resets_hang_counter(monkeypatch):
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK] + [PROBE_TO, PROBE_OK] * 3,
         "resnet:128:bf16": [TO, OK],
-        "resnet:512:bf16": [TO, OK],
         "resnet:128:f32": [TO, OK],
+        "resnet:256:f32": [TO, OK],
     }, budget=100000)
     d = out["detail"]
     assert rc == 0
-    for k in ("resnet18_bf16_bs128", "resnet18_bf16_bs512",
-              "resnet18_f32_bs128"):
+    for k in ("resnet18_bf16_bs128", "resnet18_f32_bs128",
+              "resnet18_f32_bs256"):
         assert d[k] == {"samples_per_sec": 100.0}
     assert d["outage_recoveries"] == 3
 
@@ -147,14 +147,50 @@ def test_flapping_tunnel_retry_hangs_do_not_trip_backstop(monkeypatch):
     rc, out = run_sim(monkeypatch, {
         "probe": flap,
         "resnet:128:bf16": [TO, TO],
-        "resnet:512:bf16": [TO, TO],
+        "resnet:128:f32": [TO, TO],
     }, budget=100000)
     d = out["detail"]
     assert "tunnel flapping" in d["resnet18_bf16_bs128"]["error"]
-    assert "tunnel flapping" in d["resnet18_bf16_bs512"]["error"]
+    assert "tunnel flapping" in d["resnet18_f32_bs128"]["error"]
     # backstop NOT tripped: remaining sections completed normally
-    assert d["resnet18_f32_bs128"] == {"samples_per_sec": 50.0}
     assert d["resnet18_f32_bs256"] == {"samples_per_sec": 50.0}
+    assert d["resnet18_bf16_bs512"] == {"samples_per_sec": 50.0}
+
+
+def test_risky_cells_run_last_in_green_run(monkeypatch):
+    # the known backend-wedging cells must come after every other section
+    # so a wedge costs only the least-important cells
+    rc, out = run_sim(monkeypatch, {})
+    keys = [k for k in out["detail"] if k.startswith("resnet")]
+    assert keys[-2:] == ["resnet18_bf16_bs256", "resnet18_bf16_bs512"]
+
+
+def test_risky_cell_hang_with_dead_probe_stops_run(monkeypatch):
+    # bs256 hangs AND the triage probe hangs: the run records the wedge,
+    # spends nothing from the wait budget (no outage_recoveries), and
+    # skips bs512 instead of burning its timeout on a wedged backend
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_TO],
+        "resnet:256:bf16": [TO],
+    })
+    d = out["detail"]
+    assert rc == 0 and out["value"] == 50.0   # earlier cells survive
+    assert "wedged the backend" in d["resnet18_bf16_bs256"]["error"]
+    assert "unresponsive" in d["resnet18_bf16_bs512"]["error"]
+    assert "outage_recoveries" not in d and "mid_run_outages" not in d
+
+
+def test_risky_cell_hang_with_alive_probe_is_not_retried(monkeypatch):
+    # backend still answers after the risky hang: record, do NOT retry
+    # (a second attempt risks the wedge), continue to the next section
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_OK],
+        "resnet:256:bf16": [TO, OK],
+    })
+    d = out["detail"]
+    assert rc == 0
+    assert "not retried" in d["resnet18_bf16_bs256"]["error"]
+    assert d["resnet18_bf16_bs512"] == {"samples_per_sec": 50.0}
 
 
 def test_device_recorded_from_recovery_probe_when_sections_fail(monkeypatch):
@@ -191,13 +227,13 @@ def test_midrun_budget_exhaustion_skips_remaining(monkeypatch):
     # and everything after it are skipped, earlier results survive
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK, PROBE_TO],
-        "resnet:512:bf16": [TO],
+        "resnet:128:f32": [TO],
     }, budget=700)
     d = out["detail"]
     assert rc == 0 and out["value"] == 50.0     # bs128 captured first
     assert d["resnet18_bf16_bs128"] == {"samples_per_sec": 50.0}
-    assert "budget exhausted" in d["resnet18_bf16_bs512"]["error"]
-    assert "unresponsive" in d["resnet18_f32_bs128"]["error"]
+    assert "budget exhausted" in d["resnet18_f32_bs128"]["error"]
+    assert "unresponsive" in d["resnet18_f32_bs256"]["error"]
 
 
 def test_subprocess_timeout_result_carries_hang_marker():
