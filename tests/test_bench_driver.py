@@ -165,19 +165,34 @@ def test_risky_cells_run_last_in_green_run(monkeypatch):
     assert keys[-2:] == ["resnet18_bf16_bs256", "resnet18_bf16_bs512"]
 
 
-def test_risky_cell_hang_with_dead_probe_stops_run(monkeypatch):
-    # bs256 hangs AND the triage probe hangs: the run records the wedge,
-    # spends nothing from the wait budget (no outage_recoveries), and
-    # skips bs512 instead of burning its timeout on a wedged backend
+def test_risky_cell_hang_with_backend_never_returning_skips_rest(monkeypatch):
+    # bs256 hangs AND every subsequent probe hangs: the wedge is recorded,
+    # the remaining wait budget is spent (it has no other claimant after
+    # the last safe section), and bs512 is skipped once it runs out
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK, PROBE_TO],
         "resnet:256:bf16": [TO],
-    })
+    }, budget=2000)
     d = out["detail"]
     assert rc == 0 and out["value"] == 50.0   # earlier cells survive
-    assert "wedged the backend" in d["resnet18_bf16_bs256"]["error"]
+    assert "not retried" in d["resnet18_bf16_bs256"]["error"]
     assert "unresponsive" in d["resnet18_bf16_bs512"]["error"]
     assert "outage_recoveries" not in d and "mid_run_outages" not in d
+
+
+def test_risky_cell_wedge_recovery_lets_next_risky_cell_run(monkeypatch):
+    # bs256 wedges the backend but it answers again during the wait (the
+    # orphaned server-side compile finished): bs256 stays failed and is
+    # NOT retried, bs512 still gets its window
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_TO, PROBE_OK],
+        "resnet:256:bf16": [TO, OK],
+    }, budget=100000)
+    d = out["detail"]
+    assert rc == 0
+    assert "not retried" in d["resnet18_bf16_bs256"]["error"]
+    assert d["resnet18_bf16_bs512"] == {"samples_per_sec": 50.0}
+    assert d["outage_recoveries"] == 1
 
 
 def test_risky_cell_hang_with_alive_probe_is_not_retried(monkeypatch):
