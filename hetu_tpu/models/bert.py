@@ -53,13 +53,35 @@ class BertConfig:
     # partition the custom kernel; off-TPU interpret mode would be slower
     # than the einsum). True forces it (tests), False disables.
     fused_mlm_ce: Any = "auto"
+    # Architecture dialect. The default is the modern pre-LN trunk (the
+    # training-throughput configuration every bench/test uses). ``hf()``
+    # flips all four knobs to the canonical Devlin/HuggingFace BERT
+    # architecture — post-LN blocks, embedding LayerNorm (the trunk's lnf
+    # params, applied after the embedding sum instead of after the last
+    # block), erf gelu, eps 1e-12, qkv/out projection biases — so
+    # ``models/hf_bert.py`` can load HF checkpoints weight-for-weight.
+    post_ln: bool = False
+    ln_eps: float = 1e-5
+    gelu_exact: bool = False
+    attn_proj_bias: bool = False
+
+    @classmethod
+    def hf(cls, **overrides) -> "BertConfig":
+        """The canonical (HuggingFace-compatible) BERT architecture."""
+        overrides.setdefault("post_ln", True)
+        overrides.setdefault("ln_eps", 1e-12)
+        overrides.setdefault("gelu_exact", True)
+        overrides.setdefault("attn_proj_bias", True)
+        return cls(**overrides)
 
     def trunk(self) -> tfm.TransformerConfig:
         return tfm.TransformerConfig(
             vocab_size=self.vocab_size, d_model=self.d_model,
             n_heads=self.n_heads, n_layers=self.n_layers, d_ff=self.d_ff,
             max_seq_len=self.max_seq_len, dtype=self.dtype, remat=self.remat,
-            attn_impl=self.attn_impl, causal=False)
+            attn_impl=self.attn_impl, causal=False,
+            post_ln=self.post_ln, ln_eps=self.ln_eps,
+            gelu_exact=self.gelu_exact, attn_proj_bias=self.attn_proj_bias)
 
 
 BERT_BASE = BertConfig()
@@ -73,6 +95,8 @@ def init_params(rng, cfg: BertConfig):
     params["type_emb"] = jax.random.normal(
         ks[1], (cfg.type_vocab_size, D), jnp.float32) * 0.02
     params["mlm_dense"] = jax.random.normal(ks[2], (D, D), jnp.float32) * 0.02
+    if cfg.attn_proj_bias:   # the "biases everywhere" (canonical) dialect
+        params["mlm_dense_b"] = jnp.zeros((D,), jnp.float32)
     params["mlm_ln_scale"] = jnp.ones((D,), jnp.float32)
     params["mlm_ln_bias"] = jnp.zeros((D,), jnp.float32)
     params["mlm_bias"] = jnp.zeros((V,), jnp.float32)
@@ -86,6 +110,8 @@ def init_params(rng, cfg: BertConfig):
 def param_specs(cfg: BertConfig):
     specs = tfm.param_specs(cfg.trunk())
     del specs["head"]
+    if cfg.attn_proj_bias:
+        specs["mlm_dense_b"] = P("tp")
     specs.update({
         "type_emb": P(None, None),
         "mlm_dense": P(None, "tp"),
@@ -102,33 +128,48 @@ def param_specs(cfg: BertConfig):
 
 def encode(params, input_ids, segment_ids, cfg: BertConfig,
            mesh: Optional[Mesh] = None, input_mask=None):
-    """-> final hidden states (B, T, D) after the trunk's final LN."""
+    """-> final hidden states (B, T, D). Pre-LN (default): trunk then the
+    final LN (lnf). Post-LN (canonical BERT): lnf is the EMBEDDING
+    LayerNorm — applied after the word+pos+type sum, as HF's
+    ``BertEmbeddings.LayerNorm`` — and the trunk output is final as-is
+    (each block already ends in a LayerNorm)."""
     trunk = cfg.trunk()
     h = tfm.embed_tokens(params, input_ids, trunk)
     h = h + params["type_emb"][segment_ids].astype(h.dtype)
+    if cfg.post_ln:
+        h = tfm._layer_norm(h, params["lnf_scale"], params["lnf_bias"],
+                            cfg.ln_eps)
     attn_bias = None
     if input_mask is not None:
         # (B, T) 1/0 -> additive (B, 1, 1, T): padded keys get -1e30
         attn_bias = (1.0 - input_mask.astype(jnp.float32)
                      )[:, None, None, :] * -1e30
     h, _aux = tfm.encode(params, h, trunk, mesh, attn_bias)
-    return tfm._layer_norm(h, params["lnf_scale"], params["lnf_bias"])
+    if cfg.post_ln:
+        return h
+    return tfm._layer_norm(h, params["lnf_scale"], params["lnf_bias"],
+                           cfg.ln_eps)
 
 
-def mlm_transform(params, h, positions):
+def mlm_transform(params, h, positions, cfg: BertConfig):
     """Gather (B, P) masked positions from h (B, T, D) and run the MLM
-    transform (dense + gelu + LN) -> (B, P, D)."""
+    transform (dense + bias + gelu + LN) -> (B, P, D). ``cfg`` is required:
+    the gelu flavor and LN eps are dialect-dependent, and HF-imported
+    params silently lose checkpoint parity under the wrong dialect."""
     g = jnp.take_along_axis(h, positions[..., None], axis=1)      # (B, P, D)
     g = jnp.einsum("bpd,de->bpe", g, params["mlm_dense"].astype(g.dtype),
                    preferred_element_type=jnp.float32).astype(g.dtype)
-    g = jax.nn.gelu(g)
-    return tfm._layer_norm(g, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    if "mlm_dense_b" in params:
+        g = g + params["mlm_dense_b"].astype(g.dtype)
+    g = tfm._gelu(g, cfg)
+    return tfm._layer_norm(g, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                           cfg.ln_eps)
 
 
-def mlm_logits(params, h, positions):
+def mlm_logits(params, h, positions, cfg: BertConfig):
     """MLM transform + decode tied to the token embedding -> (B, P, V) f32
     (the materializing form; the fused path skips this tensor entirely)."""
-    g = mlm_transform(params, h, positions)
+    g = mlm_transform(params, h, positions, cfg)
     logits = jnp.einsum("bpd,vd->bpv", g, params["embed"].astype(g.dtype),
                         preferred_element_type=jnp.float32)
     return logits + params["mlm_bias"]
@@ -153,14 +194,14 @@ def pretrain_loss(params, batch, cfg: BertConfig, mesh=None):
     from ..kernels.fused_ce import should_fuse
     if should_fuse(cfg.fused_mlm_ce, mesh):
         from ..kernels.fused_ce import fused_linear_nll
-        g = mlm_transform(params, h, batch["mlm_positions"])
+        g = mlm_transform(params, h, batch["mlm_positions"], cfg)
         B, Pm, D = g.shape
         per_slot = fused_linear_nll(
             g.reshape(B * Pm, D),
             params["embed"].astype(g.dtype), params["mlm_bias"],
             batch["mlm_ids"].reshape(-1)).reshape(B, Pm)
     else:
-        logits = mlm_logits(params, h, batch["mlm_positions"])
+        logits = mlm_logits(params, h, batch["mlm_positions"], cfg)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         per_slot = -jnp.take_along_axis(
             logp, batch["mlm_ids"][..., None], -1)[..., 0]        # (B, P)
@@ -218,8 +259,8 @@ def init_classifier_params(rng, cfg: BertConfig, n_classes: int,
     # deep-copy reused leaves: the fine-tune step donates its params, and a
     # donated alias would invalidate the caller's pretrained tree
     params = {k: jax.tree.map(jnp.array, v) for k, v in base.items()
-              if k not in ("mlm_dense", "mlm_ln_scale", "mlm_ln_bias",
-                           "mlm_bias", "nsp_w", "nsp_b")}
+              if k not in ("mlm_dense", "mlm_dense_b", "mlm_ln_scale",
+                           "mlm_ln_bias", "mlm_bias", "nsp_w", "nsp_b")}
     D = cfg.d_model
     params["cls_w"] = jax.random.normal(k_head, (D, n_classes),
                                         jnp.float32) * 0.02

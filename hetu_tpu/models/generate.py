@@ -45,9 +45,13 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     nh, hd = cfg.n_heads, cfg.head_dim
     M = kc.shape[2]
 
-    attn_in = tfm._layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+    post = cfg.post_ln
+    attn_in = h if post else tfm._layer_norm(h, p["ln1_scale"],
+                                             p["ln1_bias"], cfg.ln_eps)
     qkv = jnp.einsum("bod,de->boe", attn_in, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
+    if cfg.attn_proj_bias:
+        qkv = qkv + p["bqkv"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, 1, hd)
     k = k.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
@@ -63,11 +67,19 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vc,
                      preferred_element_type=jnp.float32).astype(h.dtype)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
-    h = h + jnp.einsum("bod,de->boe", ctx, p["wo"].astype(h.dtype),
-                       preferred_element_type=jnp.float32).astype(h.dtype)
+    attn_out = jnp.einsum("bod,de->boe", ctx, p["wo"].astype(h.dtype),
+                          preferred_element_type=jnp.float32).astype(h.dtype)
+    if cfg.attn_proj_bias:
+        attn_out = attn_out + p["bo"].astype(h.dtype)
+    h = h + attn_out
+    if post:
+        h = tfm._layer_norm(h, p["ln1_scale"], p["ln1_bias"], cfg.ln_eps)
 
-    mlp_in = tfm._layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+    mlp_in = h if post else tfm._layer_norm(h, p["ln2_scale"],
+                                            p["ln2_bias"], cfg.ln_eps)
     h = h + tfm._dense_mlp(mlp_in, p, cfg, None)
+    if post:
+        h = tfm._layer_norm(h, p["ln2_scale"], p["ln2_bias"], cfg.ln_eps)
     return h, (kc, vc)
 
 
@@ -80,7 +92,7 @@ def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
     h, (kcache, vcache) = jax.lax.scan(
         functools.partial(_decode_layer, cfg=cfg, pos=pos), h,
         (params["blocks"], kcache, vcache))
-    logits = tfm.lm_head(params, h)[:, 0]
+    logits = tfm.lm_head(params, h, cfg)[:, 0]
     return logits, kcache, vcache
 
 

@@ -1,0 +1,171 @@
+"""HuggingFace BERT checkpoint import — weight-for-weight, logit-for-logit.
+
+Beyond reference parity: the reference's NLP suite trains BERT-family
+models from scratch only (``examples/nlp/processBertData.py`` + its
+transformer example); there is no pretrained-checkpoint interop anywhere
+in it. This module loads any ``transformers`` BERT checkpoint
+(``BertModel`` / ``BertForPreTraining`` / ``BertForSequenceClassification``)
+into ``models/bert.py`` params such that forward outputs MATCH the torch
+model numerically (tests/test_hf_bert.py pins logits to ~1e-4 in f32) —
+so a user can pretrain/finetune a real ``bert-base-uncased`` through the
+TPU-native stack (dp/tp meshes, flash attention, fused MLM CE and all).
+
+Architecture note: HF BERT is the canonical post-LN dialect
+(``BertConfig.hf()``): LN after each residual add, an embedding LayerNorm
+(mapped onto the trunk's ``lnf`` params, which the post-LN path applies
+after the embedding sum), erf gelu, eps 1e-12, and bias terms on every
+projection. The import refuses configs that disagree (loading post-LN
+weights into the pre-LN trunk would run but be numerically meaningless).
+
+No torch tensors leak out: everything is converted to numpy, then jnp.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from .bert import BertConfig
+
+
+def config_from_hf(hf_config) -> BertConfig:
+    """transformers.BertConfig -> BertConfig.hf() with matching shapes."""
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(f"hidden_act={act!r}: only gelu variants")
+    return BertConfig.hf(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        ln_eps=hf_config.layer_norm_eps,
+        gelu_exact=(act == "gelu"),
+        dtype=jnp.float32,
+    )
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy().astype(np.float32)
+
+
+def _strip_prefix(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Normalize a state dict: drop the leading ``bert.`` scope if present
+    (BertForPreTraining nests the encoder under it; BertModel does not)."""
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("bert."):
+            k = k[len("bert."):]
+        out[k] = _np(v)
+    return out
+
+
+def params_from_hf(model, cfg: BertConfig = None):
+    """(transformers BERT model, cfg?) -> (params, cfg).
+
+    ``model``: BertModel, BertForPreTraining, or
+    BertForSequenceClassification (anything whose state dict carries the
+    ``embeddings./encoder.`` keys). Heads present in the checkpoint are
+    mapped (MLM transform + bias, NSP, pooler, classifier); absent heads
+    are simply missing from the returned params — calling a head that
+    needs them raises a KeyError, and callers wanting fresh heads graft
+    them from ``init_params`` / ``init_classifier_params``.
+
+    A caller-supplied ``cfg`` is validated against the checkpoint: dialect
+    (post-LN, biases, gelu flavor, LN eps) AND shapes — a truncated or
+    reshaped import must refuse, not silently produce a different model.
+    """
+    if cfg is None:
+        cfg = config_from_hf(model.config)
+    if not (cfg.post_ln and cfg.attn_proj_bias):
+        raise ValueError(
+            "HF BERT weights are post-LN with projection biases; build the "
+            "config with BertConfig.hf() (got post_ln=%s attn_proj_bias=%s)"
+            % (cfg.post_ln, cfg.attn_proj_bias))
+    want = config_from_hf(model.config)
+    mismatched = [f
+                  for f in ("vocab_size", "d_model", "n_heads", "n_layers",
+                            "d_ff", "max_seq_len", "type_vocab_size",
+                            "ln_eps", "gelu_exact")
+                  if getattr(cfg, f) != getattr(want, f)]
+    if mismatched:
+        raise ValueError(
+            "cfg disagrees with the checkpoint's architecture on "
+            + ", ".join(f"{f} ({getattr(cfg, f)} != {getattr(want, f)})"
+                        for f in mismatched))
+    sd = _strip_prefix(model.state_dict())
+    L, D = cfg.n_layers, cfg.d_model
+
+    def layer(i, name):
+        return sd[f"encoder.layer.{i}.{name}"]
+
+    # per-layer stacks, leading L axis (the trunk scans over it)
+    wqkv = np.stack([
+        np.concatenate([layer(i, "attention.self.query.weight").T,
+                        layer(i, "attention.self.key.weight").T,
+                        layer(i, "attention.self.value.weight").T], axis=1)
+        for i in range(L)])                                   # (L, D, 3D)
+    bqkv = np.stack([
+        np.concatenate([layer(i, "attention.self.query.bias"),
+                        layer(i, "attention.self.key.bias"),
+                        layer(i, "attention.self.value.bias")])
+        for i in range(L)])                                   # (L, 3D)
+    blocks = {
+        "wqkv": wqkv,
+        "bqkv": bqkv,
+        "wo": np.stack([layer(i, "attention.output.dense.weight").T
+                        for i in range(L)]),
+        "bo": np.stack([layer(i, "attention.output.dense.bias")
+                        for i in range(L)]),
+        # post-LN: ln1 runs after the attention residual, ln2 after the MLP
+        "ln1_scale": np.stack([layer(i, "attention.output.LayerNorm.weight")
+                               for i in range(L)]),
+        "ln1_bias": np.stack([layer(i, "attention.output.LayerNorm.bias")
+                              for i in range(L)]),
+        "w1": np.stack([layer(i, "intermediate.dense.weight").T
+                        for i in range(L)]),
+        "b1": np.stack([layer(i, "intermediate.dense.bias")
+                        for i in range(L)]),
+        "w2": np.stack([layer(i, "output.dense.weight").T
+                        for i in range(L)]),
+        "b2": np.stack([layer(i, "output.dense.bias") for i in range(L)]),
+        "ln2_scale": np.stack([layer(i, "output.LayerNorm.weight")
+                               for i in range(L)]),
+        "ln2_bias": np.stack([layer(i, "output.LayerNorm.bias")
+                              for i in range(L)]),
+    }
+    params = {
+        "embed": sd["embeddings.word_embeddings.weight"],
+        "pos": sd["embeddings.position_embeddings.weight"],
+        "type_emb": sd["embeddings.token_type_embeddings.weight"],
+        # post-LN repurposes lnf as the embedding LayerNorm (bert.encode)
+        "lnf_scale": sd["embeddings.LayerNorm.weight"],
+        "lnf_bias": sd["embeddings.LayerNorm.bias"],
+        "blocks": blocks,
+    }
+    if "pooler.dense.weight" in sd:
+        params["pool_w"] = sd["pooler.dense.weight"].T
+        params["pool_b"] = sd["pooler.dense.bias"]
+    # BertForPreTraining heads (cls.* keys never carry the bert. prefix)
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["mlm_dense"] = sd["cls.predictions.transform.dense.weight"].T
+        params["mlm_dense_b"] = sd["cls.predictions.transform.dense.bias"]
+        params["mlm_ln_scale"] = sd[
+            "cls.predictions.transform.LayerNorm.weight"]
+        params["mlm_ln_bias"] = sd["cls.predictions.transform.LayerNorm.bias"]
+        params["mlm_bias"] = sd["cls.predictions.bias"]
+        # the decode matmul is tied to params["embed"], as in HF
+    if "cls.seq_relationship.weight" in sd:
+        params["nsp_w"] = sd["cls.seq_relationship.weight"].T
+        params["nsp_b"] = sd["cls.seq_relationship.bias"]
+    # BertForSequenceClassification head -> the fine-tune params
+    if "classifier.weight" in sd:
+        params["cls_w"] = sd["classifier.weight"].T
+        params["cls_b"] = sd["classifier.bias"]
+    params = {k: (jnp.asarray(v) if not isinstance(v, dict)
+                  else {kk: jnp.asarray(vv) for kk, vv in v.items()})
+              for k, v in params.items()}
+    return params, cfg

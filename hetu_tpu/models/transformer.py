@@ -57,6 +57,20 @@ class TransformerConfig:
     # False = always materialize. Meshes keep the einsum form (GSPMD
     # cannot partition the custom kernel).
     fused_lm_ce: Any = "auto"
+    # Canonical-BERT architecture knobs (default = the flagship pre-LN
+    # trunk; models/hf_bert.py flips all four to load HuggingFace BERT
+    # checkpoints weight-for-weight):
+    post_ln: bool = False       # LN after each residual add (original
+                                # Transformer/BERT) instead of before the
+                                # sublayer; the final lnf is NOT applied by
+                                # the trunk in this mode (BERT has no final
+                                # LN — callers repurpose lnf as the
+                                # embedding LN)
+    ln_eps: float = 1e-5        # HF BERT uses 1e-12
+    gelu_exact: bool = False    # erf gelu (HF "gelu") vs tanh approximation
+    attn_proj_bias: bool = False  # bias terms on the qkv and output
+                                  # projections (BERT has them; GPT-style
+                                  # flagship configs do not)
 
     @property
     def head_dim(self):
@@ -84,6 +98,9 @@ def init_params(rng, cfg: TransformerConfig):
         "ln2_scale": jnp.ones((L, D), jnp.float32),
         "ln2_bias": jnp.zeros((L, D), jnp.float32),
     }
+    if cfg.attn_proj_bias:
+        blocks["bqkv"] = jnp.zeros((L, 3 * D), jnp.float32)
+        blocks["bo"] = jnp.zeros((L, D), jnp.float32)
     if E > 0:
         blocks.update({
             "router": norm(ks[2], (L, D, E), 0.02),
@@ -121,6 +138,9 @@ def param_specs(cfg: TransformerConfig):
         "ln2_scale": P(None, None),
         "ln2_bias": P(None, None),
     }
+    if cfg.attn_proj_bias:
+        blocks["bqkv"] = P(None, "tp")
+        blocks["bo"] = P(None, None)
     if moe:
         blocks.update({
             "router": P(None, None, None),
@@ -169,6 +189,13 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     mu = jnp.mean(x32, -1, keepdims=True)
     var = jnp.var(x32, -1, keepdims=True)
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _gelu(x, cfg: TransformerConfig):
+    # HF BERT's "gelu" is the exact erf form; jax.nn.gelu defaults to the
+    # tanh approximation (fine for training-from-scratch, wrong for
+    # checkpoint-exact parity)
+    return jax.nn.gelu(x, approximate=not cfg.gelu_exact)
 
 
 def _is_key_padding_bias(attn_bias):
@@ -268,6 +295,8 @@ def _attention(h, p, cfg: TransformerConfig, mesh, attn_bias=None):
     impl = _resolve_attn_impl(cfg, mesh, T, attn_bias)
     qkv = jnp.einsum("btd,de->bte", h, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
+    if cfg.attn_proj_bias:
+        qkv = qkv + p["bqkv"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
     if impl == "ring":
@@ -282,14 +311,17 @@ def _attention(h, p, cfg: TransformerConfig, mesh, attn_bias=None):
             B, T, nh, hd).transpose(0, 2, 1, 3)
     out = _attention_core(q, k, v, cfg, mesh, impl, attn_bias)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
-    return jnp.einsum("btd,de->bte", out, p["wo"].astype(h.dtype),
-                      preferred_element_type=jnp.float32).astype(h.dtype)
+    out = jnp.einsum("btd,de->bte", out, p["wo"].astype(h.dtype),
+                     preferred_element_type=jnp.float32).astype(h.dtype)
+    if cfg.attn_proj_bias:
+        out = out + p["bo"].astype(h.dtype)
+    return out
 
 
 def _dense_mlp(h, p, cfg, mesh):
     u = jnp.einsum("btd,df->btf", h, p["w1"].astype(h.dtype),
                    preferred_element_type=jnp.float32).astype(h.dtype)
-    u = jax.nn.gelu(u + p["b1"].astype(h.dtype))
+    u = _gelu(u + p["b1"].astype(h.dtype), cfg)
     out = jnp.einsum("btf,fd->btd", u, p["w2"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
     return out + p["b2"].astype(h.dtype)
@@ -319,7 +351,7 @@ def _moe_mlp(h, p, cfg: TransformerConfig, mesh):
     expert_in = _constrain(expert_in, mesh, "ep", None, None)
     u = jnp.einsum("ecd,edf->ecf", expert_in, p["w1"].astype(x.dtype),
                    preferred_element_type=jnp.float32).astype(x.dtype)
-    u = jax.nn.gelu(u + p["b1"][:, None, :].astype(x.dtype))
+    u = _gelu(u + p["b1"][:, None, :].astype(x.dtype), cfg)
     y = jnp.einsum("ecf,efd->ecd", u, p["w2"].astype(x.dtype),
                    preferred_element_type=jnp.float32).astype(x.dtype)
     y = y + p["b2"][:, None, :].astype(x.dtype)
@@ -334,22 +366,36 @@ def _moe_mlp(h, p, cfg: TransformerConfig, mesh):
 
 def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None,
            dropout_rng=None):
+    """One transformer block. Pre-LN (flagship default): LN -> sublayer ->
+    residual. Post-LN (``cfg.post_ln``, canonical BERT / original
+    Transformer): sublayer -> residual -> LN, with ln1 after attention and
+    ln2 after the MLP."""
+    post = cfg.post_ln
     h = _constrain(h, mesh, "dp", "sp", None)
-    attn_in = _layer_norm(h, layer_params["ln1_scale"], layer_params["ln1_bias"])
+    attn_in = h if post else _layer_norm(
+        h, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.ln_eps)
     attn_out = _attention(attn_in, layer_params, cfg, mesh, attn_bias)
     if dropout_rng is not None:
         k1, k2 = jax.random.split(dropout_rng)
         attn_out = _dropout(attn_out, cfg.dropout_rate, k1)
     h = h + attn_out
+    if post:
+        h = _layer_norm(h, layer_params["ln1_scale"],
+                        layer_params["ln1_bias"], cfg.ln_eps)
     h = _constrain(h, mesh, "dp", "sp", None)
-    mlp_in = _layer_norm(h, layer_params["ln2_scale"], layer_params["ln2_bias"])
+    mlp_in = h if post else _layer_norm(
+        h, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.ln_eps)
     if cfg.n_experts > 0:
         out, aux = _moe_mlp(mlp_in, layer_params, cfg, mesh)
     else:
         out, aux = _dense_mlp(mlp_in, layer_params, cfg, mesh), jnp.zeros((), jnp.float32)
     if dropout_rng is not None:
         out = _dropout(out, cfg.dropout_rate, k2)
-    return h + out, aux
+    h = h + out
+    if post:
+        h = _layer_norm(h, layer_params["ln2_scale"],
+                        layer_params["ln2_bias"], cfg.ln_eps)
+    return h, aux
 
 
 def embed_tokens(params, tokens, cfg: TransformerConfig):
@@ -359,9 +405,13 @@ def embed_tokens(params, tokens, cfg: TransformerConfig):
     return h + params["pos"][:T].astype(cfg.dtype)
 
 
-def lm_head(params, h):
-    """Final norm + vocab projection -> f32 logits."""
-    h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"])
+def lm_head(params, h, cfg: TransformerConfig):
+    """Final norm + vocab projection -> f32 logits. In post-LN mode the
+    blocks already end LayerNormed and canonical post-LN has no final LN,
+    so only the projection applies."""
+    if not cfg.post_ln:
+        h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
+                        cfg.ln_eps)
     return jnp.einsum("btd,dv->btv", h, params["head"].astype(h.dtype),
                       preferred_element_type=jnp.float32)
 
@@ -411,7 +461,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     """tokens (B, T) int32 -> logits (B, T, V)."""
     h, aux_sum = forward_hidden(params, tokens, cfg, mesh,
                                 dropout_rng=dropout_rng)
-    return lm_head(params, h), aux_sum
+    return lm_head(params, h, cfg), aux_sum
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
@@ -423,7 +473,9 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
         from ..kernels.fused_ce import fused_linear_nll
         h, aux = forward_hidden(params, tokens, cfg, mesh,
                                 dropout_rng=dropout_rng)
-        h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"])
+        if not cfg.post_ln:
+            h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
+                            cfg.ln_eps)
         B, T, D = h.shape
         w = params["head"].astype(h.dtype)            # (D, V), native
         per = fused_linear_nll(h.reshape(B * T, D), w,

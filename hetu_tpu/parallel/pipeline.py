@@ -133,7 +133,8 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                 done_idx = jnp.clip(t - (pp - 1), 0, M - 1)
                 mb_targets = jax.lax.dynamic_index_in_dim(
                     targets, done_idx, 0, keepdims=False)
-                mb_loss = tfm.nll_loss(tfm.lm_head(other, out), mb_targets)
+                mb_loss = tfm.nll_loss(tfm.lm_head(other, out, cfg),
+                                       mb_targets)
                 take = (stage == pp - 1) & (t >= pp - 1)
                 loss_sum = loss_sum + jnp.where(take, mb_loss, 0.0)
                 # advance activations to the next stage

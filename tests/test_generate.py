@@ -30,6 +30,31 @@ def test_incremental_logits_match_full_forward():
                                np.asarray(full_logits), atol=2e-4)
 
 
+def test_incremental_logits_match_forward_postln_bias_dialect():
+    """The decode path must honor the canonical-architecture knobs
+    (post-LN blocks, projection biases, non-default LN eps, erf gelu) —
+    a config trained with them must decode through the SAME network."""
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=3, d_ff=64, max_seq_len=16,
+                                dtype=jnp.float32, remat=False,
+                                post_ln=True, attn_proj_bias=True,
+                                ln_eps=1e-12, gelu_exact=True)
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg)
+    # non-zero biases so a dropped bias add would be caught
+    params["blocks"]["bqkv"] = jax.random.normal(
+        jax.random.PRNGKey(6), params["blocks"]["bqkv"].shape) * 0.1
+    params["blocks"]["bo"] = jax.random.normal(
+        jax.random.PRNGKey(7), params["blocks"]["bo"].shape) * 0.1
+    rng = np.random.RandomState(2)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    full_logits, _ = tfm.forward(params, prompt, cfg)
+    fn = gen.make_generate_fn(cfg, max_len=16)
+    toks, inc_logits = fn(params, prompt, jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(prompt))
+    np.testing.assert_allclose(np.asarray(inc_logits),
+                               np.asarray(full_logits), atol=2e-4)
+
+
 def test_greedy_continuation_is_self_consistent():
     """Greedy tokens re-fed through the full forward must be argmax-stable:
     feeding the generated sequence reproduces its own continuations."""
