@@ -27,6 +27,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .bert import BertConfig
+from .hf_common import np_f32, tree_to_jnp
 
 
 def config_from_hf(hf_config) -> BertConfig:
@@ -34,6 +35,16 @@ def config_from_hf(hf_config) -> BertConfig:
     act = getattr(hf_config, "hidden_act", "gelu")
     if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
         raise NotImplementedError(f"hidden_act={act!r}: only gelu variants")
+    pe = getattr(hf_config, "position_embedding_type", "absolute")
+    if pe != "absolute":
+        # relative_key(_query) adds distance-embedding terms inside the
+        # attention scores; importing would silently drop them
+        raise NotImplementedError(
+            f"position_embedding_type={pe!r}: only 'absolute'")
+    if getattr(hf_config, "is_decoder", False) or getattr(
+            hf_config, "add_cross_attention", False):
+        raise NotImplementedError(
+            "decoder/cross-attention BERT variants are not supported")
     return BertConfig.hf(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -48,10 +59,6 @@ def config_from_hf(hf_config) -> BertConfig:
     )
 
 
-def _np(t) -> np.ndarray:
-    return t.detach().cpu().numpy().astype(np.float32)
-
-
 def _strip_prefix(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
     """Normalize a state dict: drop the leading ``bert.`` scope if present
     (BertForPreTraining nests the encoder under it; BertModel does not)."""
@@ -59,7 +66,7 @@ def _strip_prefix(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
     for k, v in sd.items():
         if k.startswith("bert."):
             k = k[len("bert."):]
-        out[k] = _np(v)
+        out[k] = np_f32(v)
     return out
 
 
@@ -165,7 +172,4 @@ def params_from_hf(model, cfg: BertConfig = None):
     if "classifier.weight" in sd:
         params["cls_w"] = sd["classifier.weight"].T
         params["cls_b"] = sd["classifier.bias"]
-    params = {k: (jnp.asarray(v) if not isinstance(v, dict)
-                  else {kk: jnp.asarray(vv) for kk, vv in v.items()})
-              for k, v in params.items()}
-    return params, cfg
+    return tree_to_jnp(params), cfg

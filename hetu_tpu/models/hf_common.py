@@ -1,0 +1,19 @@
+"""Shared helpers for the HuggingFace checkpoint importers
+(``hf_bert.py``, ``hf_gpt2.py``) — one place for the torch->numpy->jnp
+conversion so dtype handling cannot drift between model families."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def np_f32(t) -> np.ndarray:
+    """torch tensor -> float32 numpy (covers f16/bf16 checkpoints)."""
+    return t.detach().to("cpu").float().numpy()
+
+
+def tree_to_jnp(params: dict) -> dict:
+    """One-level params dict (leaves or one nested dict) -> jnp arrays."""
+    return {k: (jnp.asarray(v) if not isinstance(v, dict)
+                else {kk: jnp.asarray(vv) for kk, vv in v.items()})
+            for k, v in params.items()}

@@ -1,0 +1,127 @@
+"""HuggingFace GPT-2 checkpoint import — the flagship trunk IS GPT-2.
+
+The flagship decoder (``models/transformer.py``) is architecturally GPT-2
+once ``attn_proj_bias=True``: pre-LN blocks (ln_1 -> attention -> residual,
+ln_2 -> MLP -> residual), learned positions, tanh-approximate gelu
+(HF ``gelu_new``), LN eps 1e-5, a final ``ln_f``, and the LM head tied to
+the token embedding (``cfg.tied_head``). So loading a
+GPT-2 checkpoint is a pure weight relayout — no dialect switch — and the
+imported model rides every flagship path: dp/tp/sp meshes, flash
+attention, the fused LM-CE kernel, and the one-scan KV-cache decode
+(``models/generate.py``), which is token-exact against the training
+forward by test. The LM head is TIED to the token embedding
+(``cfg.tied_head``) exactly as HF ties lm_head to wte — no transposed
+copy, shared gradients under fine-tuning.
+
+Beyond reference parity: the reference's NLP example trains its
+transformer from scratch only; it has no checkpoint interop.
+
+HF layout notes (tests/test_hf_gpt2.py pins all of this numerically):
+- ``Conv1D`` stores weight as (in, out) — our einsum orientation exactly,
+  no transposes anywhere in the blocks;
+- ``c_attn`` is the fused (D, 3D) qkv projection = our ``wqkv``;
+- ``lm_head.weight`` is tied to ``wte`` (V, D) = our tied head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from .hf_common import np_f32, tree_to_jnp
+from .transformer import TransformerConfig
+
+
+def config_from_hf(hf_config, **overrides) -> TransformerConfig:
+    """transformers.GPT2Config -> a flagship TransformerConfig. Refuses
+    attention variants the flagship does not implement — importing them
+    would run but be numerically wrong."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh", "gelu"):
+        raise NotImplementedError(f"activation {act!r}: only gelu variants")
+    unsupported = [flag for flag, bad in (
+        ("scale_attn_by_inverse_layer_idx", True),  # scores / (layer+1)
+        ("reorder_and_upcast_attn", True),
+        ("scale_attn_weights", False),              # skip the 1/sqrt(hd)
+        ("add_cross_attention", True),
+    ) if getattr(hf_config, flag, not bad) == bad]
+    if unsupported:
+        raise NotImplementedError(
+            "GPT-2 attention variant(s) not supported: "
+            + ", ".join(unsupported))
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_heads=hf_config.n_head,
+        n_layers=hf_config.n_layer,
+        d_ff=(hf_config.n_inner if hf_config.n_inner
+              else 4 * hf_config.n_embd),
+        max_seq_len=hf_config.n_positions,
+        ln_eps=hf_config.layer_norm_epsilon,
+        gelu_exact=(act == "gelu"),
+        attn_proj_bias=True,
+        tied_head=True,      # lm_head shares wte, as in HF
+        causal=True,
+        dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def params_from_hf(model, cfg: TransformerConfig = None):
+    """(transformers GPT2Model/GPT2LMHeadModel, cfg?) -> (params, cfg).
+
+    A caller-supplied ``cfg`` is validated against the checkpoint (shape
+    AND dialect fields) — a silent truncated/reshaped import must refuse.
+    """
+    if cfg is None:
+        cfg = config_from_hf(model.config)
+    want = config_from_hf(model.config)
+    mismatched = [f
+                  for f in ("vocab_size", "d_model", "n_heads", "n_layers",
+                            "d_ff", "max_seq_len", "ln_eps", "gelu_exact",
+                            "attn_proj_bias", "causal", "post_ln",
+                            "tied_head")
+                  if getattr(cfg, f) != getattr(want, f)]
+    if mismatched:
+        raise ValueError(
+            "cfg disagrees with the checkpoint's architecture on "
+            + ", ".join(f"{f} ({getattr(cfg, f)} != {getattr(want, f)})"
+                        for f in mismatched))
+    sd: Dict[str, Any] = {}
+    for k, v in model.state_dict().items():
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        sd[k] = np_f32(v)
+    L = cfg.n_layers
+
+    def layer(i, name):
+        return sd[f"h.{i}.{name}"]
+
+    blocks = {
+        "ln1_scale": np.stack([layer(i, "ln_1.weight") for i in range(L)]),
+        "ln1_bias": np.stack([layer(i, "ln_1.bias") for i in range(L)]),
+        "wqkv": np.stack([layer(i, "attn.c_attn.weight")
+                          for i in range(L)]),             # (L, D, 3D)
+        "bqkv": np.stack([layer(i, "attn.c_attn.bias") for i in range(L)]),
+        "wo": np.stack([layer(i, "attn.c_proj.weight") for i in range(L)]),
+        "bo": np.stack([layer(i, "attn.c_proj.bias") for i in range(L)]),
+        "ln2_scale": np.stack([layer(i, "ln_2.weight") for i in range(L)]),
+        "ln2_bias": np.stack([layer(i, "ln_2.bias") for i in range(L)]),
+        "w1": np.stack([layer(i, "mlp.c_fc.weight") for i in range(L)]),
+        "b1": np.stack([layer(i, "mlp.c_fc.bias") for i in range(L)]),
+        "w2": np.stack([layer(i, "mlp.c_proj.weight") for i in range(L)]),
+        "b2": np.stack([layer(i, "mlp.c_proj.bias") for i in range(L)]),
+    }
+    params = {
+        # cfg.tied_head: the LM head IS this embedding (no copy), so
+        # fine-tuning keeps HF's tied-weight training dynamics and the
+        # weights stay exportable as a tied checkpoint
+        "embed": sd["wte.weight"],
+        "pos": sd["wpe.weight"],
+        "blocks": blocks,
+        "lnf_scale": sd["ln_f.weight"],
+        "lnf_bias": sd["ln_f.bias"],
+    }
+    return tree_to_jnp(params), cfg

@@ -71,6 +71,11 @@ class TransformerConfig:
     attn_proj_bias: bool = False  # bias terms on the qkv and output
                                   # projections (BERT has them; GPT-style
                                   # flagship configs do not)
+    tied_head: bool = False     # LM head shares the token embedding (GPT-2
+                                # semantics): no separate "head" param, the
+                                # vocab projection is embed itself — halves
+                                # embedding memory and keeps fine-tuned
+                                # weights exportable as a tied checkpoint
 
     @property
     def head_dim(self):
@@ -116,14 +121,16 @@ def init_params(rng, cfg: TransformerConfig):
             "w2": norm(ks[4], (L, F, D), 0.02 / np.sqrt(2 * L)),
             "b2": jnp.zeros((L, D), jnp.float32),
         })
-    return {
+    params = {
         "embed": norm(ks[5], (V, D), 0.02),
         "pos": norm(ks[6], (cfg.max_seq_len, D), 0.02),
         "blocks": blocks,
         "lnf_scale": jnp.ones((D,), jnp.float32),
         "lnf_bias": jnp.zeros((D,), jnp.float32),
-        "head": norm(ks[7], (D, V), 0.02),
     }
+    if not cfg.tied_head:
+        params["head"] = norm(ks[7], (D, V), 0.02)
+    return params
 
 
 def param_specs(cfg: TransformerConfig):
@@ -156,14 +163,16 @@ def param_specs(cfg: TransformerConfig):
             "w2": P(None, "tp", None),
             "b2": P(None, None),
         })
-    return {
+    specs = {
         "embed": P(None, "tp"),
         "pos": P(None, "tp"),
         "blocks": blocks,
         "lnf_scale": P(None),
         "lnf_bias": P(None),
-        "head": P(None, "tp"),
     }
+    if not cfg.tied_head:
+        specs["head"] = P(None, "tp")
+    return specs
 
 
 def _constrain(x, mesh, *spec):
@@ -408,10 +417,14 @@ def embed_tokens(params, tokens, cfg: TransformerConfig):
 def lm_head(params, h, cfg: TransformerConfig):
     """Final norm + vocab projection -> f32 logits. In post-LN mode the
     blocks already end LayerNormed and canonical post-LN has no final LN,
-    so only the projection applies."""
+    so only the projection applies. Tied configs project against the token
+    embedding itself (no transposed copy is materialized)."""
     if not cfg.post_ln:
         h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
                         cfg.ln_eps)
+    if cfg.tied_head:
+        return jnp.einsum("btd,vd->btv", h, params["embed"].astype(h.dtype),
+                          preferred_element_type=jnp.float32)
     return jnp.einsum("btd,dv->btv", h, params["head"].astype(h.dtype),
                       preferred_element_type=jnp.float32)
 
@@ -477,10 +490,17 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
             h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
                             cfg.ln_eps)
         B, T, D = h.shape
-        w = params["head"].astype(h.dtype)            # (D, V), native
+        # both weight orientations are kernel-native (no vocab-sized
+        # transpose): tied configs stream the (V, D) embedding, untied the
+        # (D, V) head
+        if cfg.tied_head:
+            w, layout = params["embed"].astype(h.dtype), "vd"
+        else:
+            w, layout = params["head"].astype(h.dtype), "dv"
+        V = w.shape[0] if layout == "vd" else w.shape[1]
         per = fused_linear_nll(h.reshape(B * T, D), w,
-                               jnp.zeros((w.shape[1],), jnp.float32),
-                               targets.reshape(-1), w_layout="dv")
+                               jnp.zeros((V,), jnp.float32),
+                               targets.reshape(-1), w_layout=layout)
         return jnp.mean(per) + aux_weight * aux
     logits, aux = forward(params, tokens, cfg, mesh, dropout_rng=dropout_rng)
     return nll_loss(logits, targets) + aux_weight * aux

@@ -144,6 +144,16 @@ def test_import_refuses_preln_config():
         params_from_hf(model, bad)
 
 
+def test_import_refuses_relative_position_embeddings():
+    # relative_key adds distance terms inside attention; a silent import
+    # would drop them and diverge from the checkpoint
+    torch.manual_seed(8)
+    model = transformers.BertModel(small_hf_config(
+        position_embedding_type="relative_key")).eval()
+    with pytest.raises(NotImplementedError, match="position_embedding"):
+        params_from_hf(model)
+
+
 def test_import_refuses_truncated_config():
     # a cfg with fewer layers than the checkpoint must refuse, not
     # silently import a truncated model
