@@ -1,0 +1,93 @@
+"""Fine-tune a HuggingFace BERT checkpoint through the TPU-native stack.
+
+The migration story in one script: take any ``transformers`` BERT
+(here a locally instantiated one — the image has no network; pass
+``--from-pretrained`` a local directory to use real weights), import it
+weight-for-weight (``models/hf_bert.py``), graft a fresh classification
+head, and fine-tune with the flagship jitted step (donated buffers,
+AdamW fused in, dp/tp-shardable). The reference has no
+pretrained-checkpoint interop (its nlp suite trains from scratch —
+``/root/reference/examples/nlp``).
+
+Synthetic task: the label is whether low-id tokens outnumber high-id
+tokens in the sequence — linearly separable from mean-pooled embeddings,
+so fine-tuning must push accuracy well above chance within ~100 steps.
+"""
+import argparse
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def make_task(rng, n, seq_len, vocab_size):
+    ids = rng.integers(4, vocab_size, size=(n, seq_len))
+    labels = (ids < vocab_size // 2).sum(1) > (seq_len // 2)
+    return ids.astype(np.int32), labels.astype(np.int32)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--from-pretrained", default=None,
+                    help="local directory with a saved HF BERT; default: "
+                         "a small randomly initialized BertModel")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--n-classes", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    import torch
+    import transformers
+    from hetu_tpu.models import bert as hbert
+    from hetu_tpu.models.hf_bert import params_from_hf
+
+    torch.manual_seed(0)   # deterministic random init for the demo path
+    if args.from_pretrained:
+        model = transformers.BertModel.from_pretrained(args.from_pretrained)
+    else:
+        model = transformers.BertModel(transformers.BertConfig(
+            vocab_size=500, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64))
+    model = model.eval()
+    params, cfg = params_from_hf(model)
+    import dataclasses
+    cfg = dataclasses.replace(cfg, remat=False)
+    print(f"imported BERT: L={cfg.n_layers} D={cfg.d_model} "
+          f"V={cfg.vocab_size} ({hbert.count_params(params):,} params)")
+
+    # graft a fresh classification head on the imported trunk + pooler
+    params = hbert.init_classifier_params(
+        jax.random.PRNGKey(0), cfg, args.n_classes, pretrained=params)
+    step = hbert.make_finetune_step(cfg, lr=args.lr)
+    opt = hbert.init_opt_state(params)
+
+    rng = np.random.default_rng(0)
+    ids, labels = make_task(rng, 4096, args.seq_len, cfg.vocab_size)
+    seg = np.zeros_like(ids)
+
+    for it in range(args.steps):
+        sel = rng.integers(0, len(ids), size=args.batch_size)
+        batch = {"input_ids": jnp.asarray(ids[sel]),
+                 "segment_ids": jnp.asarray(seg[sel]),
+                 "label": jnp.asarray(labels[sel])}
+        loss, acc, params, opt = step(params, opt, batch)
+        if it % 20 == 0 or it == args.steps - 1:
+            print(f"step {it:4d}  loss {float(loss):.4f}  "
+                  f"batch acc {float(acc):.3f}")
+
+    # held-out accuracy (batch acc is a 32-sample estimate; judge on 1024)
+    hids, hlabels = make_task(rng, 1024, args.seq_len, cfg.vocab_size)
+    logits = hbert.classify_logits(
+        params, jnp.asarray(hids), jnp.zeros_like(jnp.asarray(hids)), cfg)
+    heldout = float(np.mean(np.argmax(np.asarray(logits), -1) == hlabels))
+    print(f"held-out acc over 1024: {heldout:.3f}")
+    return heldout
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() > 0.8 else 1)

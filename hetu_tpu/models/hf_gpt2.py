@@ -82,7 +82,7 @@ def params_from_hf(model, cfg: TransformerConfig = None):
                   for f in ("vocab_size", "d_model", "n_heads", "n_layers",
                             "d_ff", "max_seq_len", "ln_eps", "gelu_exact",
                             "attn_proj_bias", "causal", "post_ln",
-                            "tied_head")
+                            "tied_head", "n_experts")
                   if getattr(cfg, f) != getattr(want, f)]
     if mismatched:
         raise ValueError(
@@ -93,6 +93,10 @@ def params_from_hf(model, cfg: TransformerConfig = None):
     for k, v in model.state_dict().items():
         if k.startswith("transformer."):
             k = k[len("transformer."):]
+        if not (k.startswith(("h.", "wte.", "wpe.", "ln_f."))):
+            continue   # lm_head.weight (tied duplicate of wte), buffers
+        if ".attn.bias" in k or ".attn.masked_bias" in k:
+            continue   # causal-mask buffers on older transformers versions
         sd[k] = np_f32(v)
     L = cfg.n_layers
 
