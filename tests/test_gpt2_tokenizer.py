@@ -1,0 +1,139 @@
+"""GPT-2 byte-level BPE parity vs transformers.GPT2Tokenizer (the slow /
+reference implementation), over a locally constructed vocabulary — no
+network. Inputs stress the byte-level machinery: emoji (4-byte UTF-8),
+CJK, control characters, contractions, digit runs, whitespace runs."""
+import json
+import os
+
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from hetu_tpu.tokenizers.gpt2_tokenizer import GPT2Tokenizer, bytes_to_unicode
+
+
+@pytest.fixture(scope="module")
+def vocab_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bpe")
+    b2u = bytes_to_unicode()
+    vocab = {c: i for i, c in enumerate(sorted(b2u.values()))}
+    merges = ["t h", "th e", "h e", "i n", "a n", "e r", "Ġ t", "Ġt h",
+              "Ġth e", "Ġ a", "Ġa n", "an d", "Ġan d", "r e", "o u",
+              "1 2", "12 3", "' s", "e e"]
+    # an emoji merge: pair the first two UTF-8 byte proxies of 😀 so the
+    # multi-byte path gets a real merge to apply
+    emo = "".join(b2u[b] for b in "😀".encode("utf-8"))
+    merges.append(f"{emo[0]} {emo[1]}")
+    for m in merges:
+        tok = m.replace(" ", "")
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+    with open(d / "vocab.json", "w") as f:
+        json.dump(vocab, f)
+    with open(d / "merges.txt", "w") as f:
+        f.write("#version: 0.2\n" + "\n".join(merges) + "\n")
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def pair(vocab_dir):
+    ours = GPT2Tokenizer(os.path.join(vocab_dir, "vocab.json"),
+                         os.path.join(vocab_dir, "merges.txt"))
+    ref = transformers.GPT2Tokenizer(os.path.join(vocab_dir, "vocab.json"),
+                                     os.path.join(vocab_dir, "merges.txt"))
+    return ours, ref
+
+TEXTS = [
+    "the thin man and the thinner man ran there",
+    "The 123 quick 9 brown foxes' dens,  jumped!\n\nover\tthe lazy dog.",
+    "it's the engineer's 123rd theorem",
+    "emoji 😀 and 😀😀 stacked",
+    "中文字符 mixed with the latin and ß ü ø",
+    "   leading spaces and trailing   ",
+    "a\x00b control\x07chars",
+    "supercalifragilisticexpialidocious antidisestablishmentarianism",
+    "",
+    "'s't're've'm'll'd leading contractions",
+]
+
+
+@pytest.mark.parametrize("text", TEXTS)
+def test_tokenize_matches_hf(pair, text):
+    ours, ref = pair
+    assert ours.tokenize(text) == ref.tokenize(text)
+
+
+@pytest.mark.parametrize("text", TEXTS)
+def test_encode_roundtrip(pair, text):
+    ours, ref = pair
+    ids = ours.encode(text)
+    assert ids == ref.encode(text)   # GPT2Tokenizer.encode adds no specials
+    assert ours.decode(ids) == text  # byte-level BPE is lossless
+
+
+def test_special_token_parity(pair, vocab_dir):
+    # <|endoftext|> must survive as ONE token with the same appended id
+    # HF assigns (vocab_size), never split by BPE
+    ours, ref = pair
+    text = "the end<|endoftext|>the<|endoftext|>"
+    assert ours.tokenize(text) == ref.tokenize(text)
+    assert ours.encode(text) == ref.encode(text)
+    assert ours.decode(ours.encode(text)) == text
+    eot = ours.encode("<|endoftext|>")
+    assert eot == ref.encode("<|endoftext|>") and len(eot) == 1
+
+
+def test_custom_special_tokens_sorted_ids(vocab_dir):
+    # multiple distinct specials: HF appends them in SORTED order
+    ours = GPT2Tokenizer(os.path.join(vocab_dir, "vocab.json"),
+                         os.path.join(vocab_dir, "merges.txt"),
+                         special_tokens=("<u>", "<b>", "<e>"))
+    ref = transformers.GPT2Tokenizer(
+        os.path.join(vocab_dir, "vocab.json"),
+        os.path.join(vocab_dir, "merges.txt"),
+        unk_token="<u>", bos_token="<b>", eos_token="<e>")
+    text = "th<e>the<b>x<u>"
+    assert ours.tokenize(text) == ref.tokenize(text)
+    assert ours.encode(text) == ref.encode(text)
+
+
+@pytest.mark.parametrize("header,trailing", [(False, True), (True, False),
+                                             (False, False)])
+def test_merges_parsing_matches_hf(vocab_dir, header, trailing):
+    # HF drops the first and last merges-file lines unconditionally; files
+    # without a #version header or trailing newline must still match
+    with open(os.path.join(vocab_dir, "merges.txt")) as f:
+        lines = f.read().split("\n")   # header + merges + ""
+    body = [ln for ln in lines[1:] if ln]
+    content = ("#version: 0.2\n" if header else "") + "\n".join(body)
+    content += "\n" if trailing else ""
+    alt = os.path.join(vocab_dir, f"merges_{header}_{trailing}.txt")
+    with open(alt, "w") as f:
+        f.write(content)
+    vjson = os.path.join(vocab_dir, "vocab.json")
+    ours = GPT2Tokenizer(vjson, alt)
+    ref = transformers.GPT2Tokenizer(vjson, alt)
+    for text in TEXTS[:4]:
+        assert ours.tokenize(text) == ref.tokenize(text)
+
+
+def test_overlapping_specials_longest_match(vocab_dir):
+    # a special that prefixes another must not tear the longer one apart
+    ours = GPT2Tokenizer(os.path.join(vocab_dir, "vocab.json"),
+                         os.path.join(vocab_dir, "merges.txt"),
+                         special_tokens=("<|end|>", "<|endoftext|>"))
+    toks = ours.tokenize("x<|endoftext|>y<|end|>")
+    assert "<|endoftext|>" in toks and "<|end|>" in toks
+    ids = ours.encode("x<|endoftext|>y<|end|>")
+    assert ours.decode(ids) == "x<|endoftext|>y<|end|>"
+
+
+def test_random_bytes_parity(pair):
+    ours, ref = pair
+    import random
+    rng = random.Random(0)
+    for _ in range(50):
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40)))
+        text = raw.decode("utf-8", errors="ignore")
+        assert ours.encode(text) == ref.encode(text)
+        assert ours.decode(ours.encode(text)) == text
