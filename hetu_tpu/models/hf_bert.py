@@ -173,3 +173,82 @@ def params_from_hf(model, cfg: BertConfig = None):
         params["cls_w"] = sd["classifier.weight"].T
         params["cls_b"] = sd["classifier.bias"]
     return tree_to_jnp(params), cfg
+
+
+def state_dict_from_params(params, cfg: BertConfig):
+    """Inverse of ``params_from_hf``: params -> HF-named numpy state dict
+    (unscoped ``embeddings./encoder./pooler.`` names plus whatever heads
+    are present) — so TPU-trained/fine-tuned weights deploy back through
+    ``transformers``. ``export_to_hf`` loads it into a model instance."""
+    blocks = {k: np.asarray(v) for k, v in params["blocks"].items()}
+    D = cfg.d_model
+    sd = {
+        "embeddings.word_embeddings.weight": np.asarray(params["embed"]),
+        "embeddings.position_embeddings.weight": np.asarray(params["pos"]),
+        "embeddings.token_type_embeddings.weight":
+            np.asarray(params["type_emb"]),
+        "embeddings.LayerNorm.weight": np.asarray(params["lnf_scale"]),
+        "embeddings.LayerNorm.bias": np.asarray(params["lnf_bias"]),
+    }
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        wqkv, bqkv = blocks["wqkv"][i], blocks["bqkv"][i]
+        sd[p + "attention.self.query.weight"] = wqkv[:, :D].T
+        sd[p + "attention.self.key.weight"] = wqkv[:, D:2 * D].T
+        sd[p + "attention.self.value.weight"] = wqkv[:, 2 * D:].T
+        sd[p + "attention.self.query.bias"] = bqkv[:D]
+        sd[p + "attention.self.key.bias"] = bqkv[D:2 * D]
+        sd[p + "attention.self.value.bias"] = bqkv[2 * D:]
+        sd[p + "attention.output.dense.weight"] = blocks["wo"][i].T
+        sd[p + "attention.output.dense.bias"] = blocks["bo"][i]
+        sd[p + "attention.output.LayerNorm.weight"] = blocks["ln1_scale"][i]
+        sd[p + "attention.output.LayerNorm.bias"] = blocks["ln1_bias"][i]
+        sd[p + "intermediate.dense.weight"] = blocks["w1"][i].T
+        sd[p + "intermediate.dense.bias"] = blocks["b1"][i]
+        sd[p + "output.dense.weight"] = blocks["w2"][i].T
+        sd[p + "output.dense.bias"] = blocks["b2"][i]
+        sd[p + "output.LayerNorm.weight"] = blocks["ln2_scale"][i]
+        sd[p + "output.LayerNorm.bias"] = blocks["ln2_bias"][i]
+    if "pool_w" in params:
+        sd["pooler.dense.weight"] = np.asarray(params["pool_w"]).T
+        sd["pooler.dense.bias"] = np.asarray(params["pool_b"])
+    if "mlm_dense" in params:
+        sd["cls.predictions.transform.dense.weight"] = \
+            np.asarray(params["mlm_dense"]).T
+        sd["cls.predictions.transform.dense.bias"] = \
+            np.asarray(params["mlm_dense_b"])
+        sd["cls.predictions.transform.LayerNorm.weight"] = \
+            np.asarray(params["mlm_ln_scale"])
+        sd["cls.predictions.transform.LayerNorm.bias"] = \
+            np.asarray(params["mlm_ln_bias"])
+        sd["cls.predictions.bias"] = np.asarray(params["mlm_bias"])
+        # HF ties cls.predictions.decoder to word_embeddings; emit it
+        # explicitly so un-tied consumers load the right matrix too
+        sd["cls.predictions.decoder.weight"] = np.asarray(params["embed"])
+        sd["cls.predictions.decoder.bias"] = np.asarray(params["mlm_bias"])
+    if "nsp_w" in params:
+        sd["cls.seq_relationship.weight"] = np.asarray(params["nsp_w"]).T
+        sd["cls.seq_relationship.bias"] = np.asarray(params["nsp_b"])
+    if "cls_w" in params:
+        sd["classifier.weight"] = np.asarray(params["cls_w"]).T
+        sd["classifier.bias"] = np.asarray(params["cls_b"])
+    return sd
+
+
+def export_to_hf(params, cfg: BertConfig, model):
+    """Load params into a live transformers BERT ``model`` (any of the
+    supported classes), scoped under ``bert.`` for the ForXxx wrappers.
+    Validation is bidirectional (``hf_common.load_into_hf``): a trunk key
+    with no target slot (e.g. more layers than the model) raises, a target
+    key the export cannot fill raises — only HEADS the target class lacks
+    (cls.*/classifier./pooler.) may be dropped, because deploying an
+    encoder into a different-head wrapper is a legitimate export."""
+    from .hf_common import load_into_hf
+    sd = state_dict_from_params(params, cfg)
+    return load_into_hf(
+        sd, model, scope="bert.",
+        # registered buffers (position_ids/token_type_ids on some
+        # transformers versions) are positional constants, not weights
+        skip_target=lambda k: k.endswith(("position_ids",
+                                          "token_type_ids")),
+        droppable=("cls.", "classifier.", "pooler."))

@@ -17,3 +17,39 @@ def tree_to_jnp(params: dict) -> dict:
     return {k: (jnp.asarray(v) if not isinstance(v, dict)
                 else {kk: jnp.asarray(vv) for kk, vv in v.items()})
             for k, v in params.items()}
+
+
+def load_into_hf(sd: dict, model, scope: str, skip_target=lambda k: False,
+                 droppable=()):
+    """Load an unscoped HF-named numpy state dict into a live transformers
+    ``model``, shared by both exporters so the validation cannot drift.
+
+    Validates BOTH directions, so a silently partial deploy cannot happen:
+    - every exported key must land in the target (an unmatched trunk key —
+      e.g. ``encoder.layer.8.*`` against a 6-layer model — is an
+      architecture mismatch and raises; keys under a ``droppable`` prefix,
+      i.e. heads the target model class does not have, may be dropped);
+    - every target key must be filled (except ``skip_target`` buffers);
+    - shape mismatches raise inside ``load_state_dict`` itself.
+    """
+    import torch
+    target = model.state_dict()
+    scoped, unmatched = {}, []
+    for k, v in sd.items():
+        name = (k if k in target
+                else scope + k if scope + k in target else None)
+        if name is None:
+            if not k.startswith(tuple(droppable)):
+                unmatched.append(k)
+            continue
+        # owning copy: jax->numpy views are read-only, torch warns on them
+        scoped[name] = torch.tensor(np.asarray(v))
+    if unmatched:
+        raise ValueError(
+            f"export keys with no slot in the target model (architecture "
+            f"mismatch?): {unmatched[:6]}{'...' if len(unmatched) > 6 else ''}")
+    missing = [k for k in target if k not in scoped and not skip_target(k)]
+    if missing:
+        raise ValueError(f"export cannot fill target keys: {missing}")
+    model.load_state_dict(scoped, strict=False)
+    return model

@@ -129,3 +129,55 @@ def params_from_hf(model, cfg: TransformerConfig = None):
         "lnf_bias": sd["ln_f.bias"],
     }
     return tree_to_jnp(params), cfg
+
+
+def state_dict_from_params(params, cfg: TransformerConfig):
+    """Inverse of ``params_from_hf``: params -> HF-named numpy state dict
+    (unscoped ``wte/wpe/h.N/ln_f`` names) so TPU-trained weights deploy
+    back through ``transformers``. Conv1D keeps the (in, out) layout, so
+    this is transpose-free like the import."""
+    blocks = {k: np.asarray(v) for k, v in params["blocks"].items()}
+    sd = {
+        "wte.weight": np.asarray(params["embed"]),
+        "wpe.weight": np.asarray(params["pos"]),
+        "ln_f.weight": np.asarray(params["lnf_scale"]),
+        "ln_f.bias": np.asarray(params["lnf_bias"]),
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        sd[p + "ln_1.weight"] = blocks["ln1_scale"][i]
+        sd[p + "ln_1.bias"] = blocks["ln1_bias"][i]
+        sd[p + "attn.c_attn.weight"] = blocks["wqkv"][i]
+        sd[p + "attn.c_attn.bias"] = blocks["bqkv"][i]
+        sd[p + "attn.c_proj.weight"] = blocks["wo"][i]
+        sd[p + "attn.c_proj.bias"] = blocks["bo"][i]
+        sd[p + "ln_2.weight"] = blocks["ln2_scale"][i]
+        sd[p + "ln_2.bias"] = blocks["ln2_bias"][i]
+        sd[p + "mlp.c_fc.weight"] = blocks["w1"][i]
+        sd[p + "mlp.c_fc.bias"] = blocks["b1"][i]
+        sd[p + "mlp.c_proj.weight"] = blocks["w2"][i]
+        sd[p + "mlp.c_proj.bias"] = blocks["b2"][i]
+    return sd
+
+
+def export_to_hf(params, cfg: TransformerConfig, model):
+    """Load params into a live transformers GPT-2 ``model`` (GPT2Model or
+    GPT2LMHeadModel). Requires ``cfg.tied_head``: HF GPT-2 architecturally
+    ties lm_head to wte (one tensor), so an untied flagship head has no
+    faithful place in the target — loading it into lm_head would silently
+    overwrite wte through the tie. Returns the model."""
+    if not cfg.tied_head:
+        raise ValueError(
+            "export_to_hf needs cfg.tied_head=True: HF GPT-2 ties lm_head "
+            "to wte, so a separately trained (D, V) head cannot be "
+            "represented in a GPT-2 checkpoint")
+    import torch
+    from .hf_common import load_into_hf
+    sd = dict(state_dict_from_params(params, cfg))
+    if any(k.startswith("lm_head.") for k in model.state_dict()):
+        sd["lm_head.weight"] = sd["wte.weight"]   # the tie, explicitly
+    return load_into_hf(
+        sd, model, scope="transformer.",
+        # causal-mask buffers on older transformers versions
+        skip_target=lambda k: (".attn.bias" in k
+                               or ".attn.masked_bias" in k))

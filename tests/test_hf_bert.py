@@ -20,7 +20,8 @@ torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
 from hetu_tpu.models import bert as hbert
-from hetu_tpu.models.hf_bert import config_from_hf, params_from_hf
+from hetu_tpu.models.hf_bert import (config_from_hf, export_to_hf,
+                                     params_from_hf)
 
 
 def small_hf_config(**over):
@@ -164,6 +165,78 @@ def test_import_refuses_truncated_config():
         max_seq_len=48, ln_eps=1e-12)
     with pytest.raises(ValueError, match="n_layers"):
         params_from_hf(model, truncated)
+
+
+def test_train_then_export_roundtrip(pretraining_pair):
+    """The deploy direction: train a pretrain step on imported weights,
+    export the UPDATED params into a fresh torch BertForPreTraining, and
+    the HF forward must match ours — TPU-trained weights deploy through
+    transformers."""
+    model, params, cfg = pretraining_pair
+    rng = np.random.default_rng(9)
+    B, T, P = 2, 16, 4
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32),
+        "segment_ids": jnp.zeros((B, T), jnp.int32),
+        "input_mask": jnp.ones((B, T), jnp.int32),
+        "mlm_positions": jnp.asarray(rng.integers(1, T, (B, P)), jnp.int32),
+        "mlm_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, P)), jnp.int32),
+        "mlm_weights": jnp.ones((B, P), jnp.float32),
+        "nsp_label": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32),
+    }
+    import jax
+    step = hbert.make_pretrain_step(cfg, lr=1e-3)
+    trained = jax.tree.map(jnp.array, params)
+    _, _, trained, _ = step(trained, hbert.init_opt_state(trained), batch)
+
+    fresh = transformers.BertForPreTraining(small_hf_config()).eval()
+    export_to_hf(trained, cfg, fresh)
+    ids, seg, mask = make_batch(np.random.default_rng(10), model.config)
+    with torch.no_grad():
+        out = fresh(input_ids=torch.tensor(ids),
+                    token_type_ids=torch.tensor(seg),
+                    attention_mask=torch.tensor(mask))
+    h = hbert.encode(trained, jnp.asarray(ids, jnp.int32),
+                     jnp.asarray(seg, jnp.int32), cfg,
+                     input_mask=jnp.asarray(mask, jnp.int32))
+    T = ids.shape[1]
+    all_pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), ids.shape)
+    np.testing.assert_allclose(
+        np.asarray(hbert.mlm_logits(trained, h, all_pos, cfg)),
+        out.prediction_logits.numpy(), atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(hbert.nsp_logits(trained, h)),
+        out.seq_relationship_logits.numpy(), atol=2e-4, rtol=2e-4)
+
+
+def test_export_refuses_layer_mismatch(pretraining_pair):
+    # 3-layer params into a 2-layer target: raise, never truncate
+    model, params, cfg = pretraining_pair
+    small = transformers.BertForPreTraining(
+        small_hf_config(num_hidden_layers=2)).eval()
+    with pytest.raises(ValueError, match="no slot"):
+        export_to_hf(params, cfg, small)
+
+
+def test_export_drops_heads_into_plain_bertmodel(pretraining_pair):
+    # deploying pretrain params as a bare encoder (BertModel) is
+    # legitimate: heads are droppable, the trunk must still match
+    model, params, cfg = pretraining_pair
+    bare = transformers.BertModel(small_hf_config()).eval()
+    export_to_hf(params, cfg, bare)
+    rng = np.random.default_rng(11)
+    ids, seg, mask = make_batch(rng, model.config)
+    with torch.no_grad():
+        ref = bare(input_ids=torch.tensor(ids),
+                   token_type_ids=torch.tensor(seg),
+                   attention_mask=torch.tensor(mask)).last_hidden_state
+    h = hbert.encode(params, jnp.asarray(ids, jnp.int32),
+                     jnp.asarray(seg, jnp.int32), cfg,
+                     input_mask=jnp.asarray(mask, jnp.int32))
+    np.testing.assert_allclose(np.asarray(h), ref.numpy(),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_hf_arch_trains_a_step(pretraining_pair):

@@ -17,7 +17,8 @@ transformers = pytest.importorskip("transformers")
 
 from hetu_tpu.models import generate as gen
 from hetu_tpu.models import transformer as tfm
-from hetu_tpu.models.hf_gpt2 import config_from_hf, params_from_hf
+from hetu_tpu.models.hf_gpt2 import (config_from_hf, export_to_hf,
+                                     params_from_hf)
 
 
 @pytest.fixture(scope="module")
@@ -115,6 +116,44 @@ def test_imported_head_is_tied(gpt2_pair):
     HF's tied-weight dynamics, and the checkpoint stays exportable."""
     _, params, cfg = gpt2_pair
     assert cfg.tied_head and "head" not in params
+
+
+def test_train_then_export_roundtrip(gpt2_pair):
+    """Train a step on imported GPT-2 weights, export into a fresh torch
+    GPT2LMHeadModel (tied lm_head follows wte), logits must match ours."""
+    model, params, cfg = gpt2_pair
+    rng = np.random.default_rng(6)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 17)), jnp.int32)
+    step = tfm.make_train_step(cfg, lr=1e-3)
+    trained = jax.tree.map(jnp.array, params)
+    _, trained, _ = step(trained, tfm.init_opt_state(trained),
+                         toks[:, :-1], toks[:, 1:])
+
+    fresh = transformers.GPT2LMHeadModel(model.config).eval()
+    export_to_hf(trained, cfg, fresh)
+    ids = rng.integers(0, cfg.vocab_size, (3, 20))
+    ours, _ = tfm.forward(trained, jnp.asarray(ids, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits(fresh, ids),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_export_refuses_layer_mismatch(gpt2_pair):
+    # exporting 3-layer params into a 2-layer model must raise, not
+    # silently deploy a truncated network
+    model, params, cfg = gpt2_pair
+    small = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=48, n_layer=2,
+        n_head=4)).eval()
+    with pytest.raises(ValueError, match="no slot"):
+        export_to_hf(params, cfg, small)
+
+
+def test_export_refuses_untied_head(gpt2_pair):
+    import dataclasses
+    model, params, cfg = gpt2_pair
+    untied = dataclasses.replace(cfg, tied_head=False)
+    with pytest.raises(ValueError, match="tied_head"):
+        export_to_hf(params, untied, model)
 
 
 def test_imported_gpt2_trains_a_step(gpt2_pair):
