@@ -34,14 +34,18 @@ from . import transformer as tfm
 
 
 def _decode_layer(carry, layer_inputs, *, cfg, pos):
-    """One transformer block for ONE new token against the cache.
+    """One transformer block for a CHUNK of C new tokens against the cache
+    (C=1 is the classic decode step; C>1 is chunk verification for
+    speculative decoding — attention is causal WITHIN the chunk and full
+    over the cached prefix).
 
-    carry: h (B, 1, D); layer_inputs: (layer_params, k_cache, v_cache) with
-    caches (B, nh, M, hd). Returns updated caches alongside the new h.
+    carry: h (B, C, D); layer_inputs: (layer_params, k_cache, v_cache) with
+    caches (B, nh, M, hd); the chunk occupies positions [pos, pos+C).
+    Returns updated caches alongside the new h.
     """
     h = carry
     p, kc, vc = layer_inputs
-    B, _, D = h.shape
+    B, C, D = h.shape
     nh, hd = cfg.n_heads, cfg.head_dim
     M = kc.shape[2]
 
@@ -53,20 +57,22 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     if cfg.attn_proj_bias:
         qkv = qkv + p["bqkv"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, 1, hd)
-    k = k.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
+    q = q.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, C, hd)
+    k = k.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
 
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, M), 3)
-    scores = jnp.where(kpos <= pos, scores, -1e30)
+    # query i (global position pos+i) sees cache entries <= pos+i
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, C, M), 3)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, C, M), 2)
+    scores = jnp.where(kpos <= qpos, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
     ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vc,
                      preferred_element_type=jnp.float32).astype(h.dtype)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, C, D)
     attn_out = jnp.einsum("bod,de->boe", ctx, p["wo"].astype(h.dtype),
                           preferred_element_type=jnp.float32).astype(h.dtype)
     if cfg.attn_proj_bias:
@@ -83,17 +89,35 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     return h, (kc, vc)
 
 
-def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
-    """tok (B,) int32 at position pos -> (logits (B, V), new caches)."""
-    h = (params["embed"][tok] +
-         jax.lax.dynamic_index_in_dim(params["pos"], pos, 0,
-                                      keepdims=False)).astype(cfg.dtype)
-    h = h[:, None, :]
+def _chunk_hidden(params, cfg, toks, kcache, vcache, pos):
+    """toks (B, C) int32 occupying positions [pos, pos+C) -> (hidden
+    (B, C, D) pre-head, new caches). The cache-building core; callers that
+    need logits apply ``tfm.lm_head`` to as little of h as they actually
+    read (at V~50k the head dominates, so prefill must not pay it for
+    every prompt position)."""
+    B, C = toks.shape
+    D = cfg.d_model
+    pos_emb = jax.lax.dynamic_slice(params["pos"], (pos, 0), (C, D))
+    h = (params["embed"][toks] + pos_emb[None]).astype(cfg.dtype)
     h, (kcache, vcache) = jax.lax.scan(
         functools.partial(_decode_layer, cfg=cfg, pos=pos), h,
         (params["blocks"], kcache, vcache))
-    logits = tfm.lm_head(params, h, cfg)[:, 0]
-    return logits, kcache, vcache
+    return h, kcache, vcache
+
+
+def _chunk_logits(params, cfg, toks, kcache, vcache, pos):
+    """toks (B, C) int32 occupying positions [pos, pos+C) -> (logits
+    (B, C, V), new caches). C=1 is one decode step."""
+    h, kcache, vcache = _chunk_hidden(params, cfg, toks, kcache, vcache,
+                                      pos)
+    return tfm.lm_head(params, h, cfg), kcache, vcache
+
+
+def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
+    """tok (B,) int32 at position pos -> (logits (B, V), new caches)."""
+    logits, kcache, vcache = _chunk_logits(params, cfg, tok[:, None],
+                                           kcache, vcache, pos)
+    return logits[:, 0], kcache, vcache
 
 
 def _check_decode_args(cfg: tfm.TransformerConfig, max_len: int,
@@ -290,20 +314,15 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
         BK = B * K
         V = cfg.vocab_size
 
-        # -- prefill at batch B (NOT B*K: the K copies would be identical) --
+        # -- prefill at batch B (NOT B*K: the K copies would be identical):
+        # one MXU-shaped chunked forward over the whole prompt instead of
+        # P sequential single-token steps; the head runs on the LAST
+        # position only (full-prompt logits would be a (B, P, V) dead
+        # buffer) --
         kc = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
         vc = jnp.zeros_like(kc)
-
-        def pre(carry, t):
-            kc, vc = carry
-            tok = jax.lax.dynamic_index_in_dim(prompt, t, 1, keepdims=False)
-            logits, kc, vc = _one_token_logits(params, cfg, tok, kc, vc, t)
-            del logits  # only the LAST position's logits matter; stacking
-            return (kc, vc), None  # (P, B, V) would be a large dead buffer
-
-        (kc, vc), _ = jax.lax.scan(pre, (kc, vc), jnp.arange(P - 1))
-        last_logits, kc, vc = _one_token_logits(
-            params, cfg, prompt[:, P - 1], kc, vc, P - 1)
+        h, kc, vc = _chunk_hidden(params, cfg, prompt, kc, vc, 0)
+        last_logits = tfm.lm_head(params, h[:, P - 1:P], cfg)[:, 0]
 
         # first expansion: top-min(K, V) continuations of the prompt seed
         # the beams; with K > V the surplus beams start dead (-inf) and get
@@ -355,3 +374,119 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
         return toks, scores
 
     return jax.jit(beam)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (beyond reference, and beyond the plain decode above)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=16)
+def make_speculative_generate_fn(cfg: tfm.TransformerConfig,
+                                 draft_cfg: tfm.TransformerConfig,
+                                 max_len: int, k: int = 4):
+    """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
+    tokens per round, the TARGET verifies them in ONE chunked forward
+    (``_decode_layer`` with C=k+1 — an MXU-shaped matmul instead of k+1
+    bandwidth-bound single-token steps), and the longest agreeing prefix
+    is accepted plus the target's own next token. The greedy case of
+    arXiv:2211.17192: output is TOKEN-EXACT equal to plain greedy decoding
+    with the target (pinned by test), only faster — each round advances
+    between 1 and k+1 tokens at one target forward.
+
+    Returns jitted ``(params, draft_params, prompt (1, P) int32) ->
+    (tokens (1, max_len), rounds)`` — rounds is the number of verify
+    forwards after prefill, so the mean acceptance per round is
+    ``(max_len - P - 1) / rounds``. Batch is fixed at 1 (speculation is a
+    latency optimization; rows would accept different lengths).
+
+    Both configs must be causal, dense, same vocab; position tables must
+    cover ``max_len + k`` (the last round may write a partial chunk past
+    the returned window; the tail is sliced off).
+    """
+    _check_decode_args(cfg, max_len, 0)
+    _check_decode_args(draft_cfg, max_len, 0)
+    assert cfg.vocab_size == draft_cfg.vocab_size, "vocabularies differ"
+    assert k >= 1
+    assert max_len + k <= cfg.max_seq_len, (
+        f"need max_len + k <= target max_seq_len ({max_len}+{k} > "
+        f"{cfg.max_seq_len})")
+    assert max_len + k <= draft_cfg.max_seq_len
+
+    M = max_len + k          # cache/buffer room for the last partial chunk
+
+    def gen(params, draft_params, prompt):
+        B, P = prompt.shape
+        assert B == 1, "speculative decode is B=1 (latency-oriented)"
+        assert 1 <= P < max_len
+
+        def cache(c):
+            L, nh, hd = c.n_layers, c.n_heads, c.head_dim
+            return (jnp.zeros((L, B, nh, M, hd), c.dtype),
+                    jnp.zeros((L, B, nh, M, hd), c.dtype))
+
+        kc_t, vc_t = cache(cfg)
+        kc_d, vc_d = cache(draft_cfg)
+        toks = jnp.zeros((B, M + 1), jnp.int32)
+        toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
+
+        # -- chunked prefill: ONE forward each over the whole prompt; the
+        # head runs on the LAST position only (target) or not at all
+        # (draft — its prefill exists purely to build the cache) --
+        t_h, kc_t, vc_t = _chunk_hidden(params, cfg, prompt, kc_t, vc_t, 0)
+        t_last = tfm.lm_head(params, t_h[:, P - 1:P], cfg)[:, 0]
+        first = jnp.argmax(t_last, -1).astype(jnp.int32)
+        _, kc_d, vc_d = _chunk_hidden(draft_params, draft_cfg, prompt,
+                                      kc_d, vc_d, 0)
+        toks = jax.lax.dynamic_update_slice(toks, first[:, None], (0, P))
+        n0 = jnp.int32(P + 1)
+        # invariant at each round start: toks[:, :n] is the sequence, both
+        # caches hold positions [0, n-1), and toks[:, n-1] has not been
+        # fed to either model yet
+
+        def cond(c):
+            return c[1] < max_len
+
+        def body(c):
+            toks, n, kc_t, vc_t, kc_d, vc_d, rounds = c
+
+            # draft proposes k tokens, one bandwidth-cheap step each.
+            # k+1 steps, not k: the extra step writes the LAST proposal's
+            # k/v cache entry (input d_{k-1} at position n+k-1), which the
+            # next round needs whenever all k proposals are accepted (the
+            # bonus token advances past it) — without it the draft attends
+            # a zero entry and its acceptance rate silently degrades (the
+            # output stays exact either way; the target always corrects).
+            # The extra proposal itself is discarded.
+            def dstep(carry, _):
+                cur, pos, kc_d, vc_d = carry
+                logits, kc_d, vc_d = _one_token_logits(
+                    draft_params, draft_cfg, cur, kc_d, vc_d, pos)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, pos + 1, kc_d, vc_d), nxt
+
+            last = jax.lax.dynamic_index_in_dim(toks, n - 1, 1,
+                                                keepdims=False)
+            (_, _, kc_d, vc_d), drafts = jax.lax.scan(
+                dstep, (last, n - 1, kc_d, vc_d), None, length=k + 1)
+            drafts = drafts[:k, 0]                             # (k,)
+
+            # target verifies the whole chunk in one forward:
+            # [last, d_0..d_{k-1}] at positions [n-1, n+k)
+            chunk = jnp.concatenate([last[:, None], drafts[None]], 1)
+            v_logits, kc_t, vc_t = _chunk_logits(params, cfg, chunk,
+                                                 kc_t, vc_t, n - 1)
+            targets = jnp.argmax(v_logits[0], -1).astype(jnp.int32)  # (k+1,)
+
+            # longest agreeing prefix; emit the target's tokens (equal to
+            # the draft's on the accepted prefix, its own correction after)
+            agree = jnp.cumprod(
+                (drafts == targets[:k]).astype(jnp.int32))
+            a = jnp.sum(agree)                                 # in [0, k]
+            toks = jax.lax.dynamic_update_slice(toks, targets[None], (0, n))
+            return (toks, n + a + 1, kc_t, vc_t, kc_d, vc_d, rounds + 1)
+
+        toks, n, *_, rounds = jax.lax.while_loop(
+            cond, body, (toks, n0, kc_t, vc_t, kc_d, vc_d, jnp.int32(0)))
+        return toks[:, :max_len], rounds
+
+    return jax.jit(gen)

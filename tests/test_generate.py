@@ -30,6 +30,75 @@ def test_incremental_logits_match_full_forward():
                                np.asarray(full_logits), atol=2e-4)
 
 
+def _spec_cfgs():
+    target = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                   n_layers=3, d_ff=64, max_seq_len=40,
+                                   dtype=jnp.float32, remat=False)
+    draft = tfm.TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32, max_seq_len=40,
+                                  dtype=jnp.float32, remat=False)
+    return target, draft
+
+
+@pytest.mark.parametrize("k,P", [(1, 3), (3, 5), (4, 1), (6, 9)])
+def test_speculative_equals_plain_greedy(k, P):
+    """The exactness contract: speculative output == plain greedy decode
+    with the target, for any draft — here an unrelated random model, so
+    rejections happen constantly."""
+    target, draft = _spec_cfgs()
+    tp = tfm.init_params(jax.random.PRNGKey(0), target)
+    dp = tfm.init_params(jax.random.PRNGKey(99), draft)
+    rng = np.random.RandomState(P * 7 + k)
+    prompt = jnp.asarray(rng.randint(0, 64, (1, P)), jnp.int32)
+    max_len = 24
+    plain = gen.generate(tp, target, np.asarray(prompt), max_len=max_len)
+    fn = gen.make_speculative_generate_fn(target, draft, max_len, k=k)
+    spec, rounds = fn(tp, dp, prompt)
+    np.testing.assert_array_equal(np.asarray(spec), plain)
+    assert int(rounds) >= 1
+
+
+def test_speculative_self_draft_accepts_everything():
+    """draft == target: every proposal is accepted, so the loop advances
+    k+1 tokens per round — rounds == ceil(generated / (k+1))."""
+    target, _ = _spec_cfgs()
+    tp = tfm.init_params(jax.random.PRNGKey(1), target)
+    P, max_len, k = 4, 25, 4
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (1, P)), jnp.int32)
+    fn = gen.make_speculative_generate_fn(target, target, max_len, k=k)
+    spec, rounds = fn(tp, tp, prompt)
+    plain = gen.generate(tp, target, np.asarray(prompt), max_len=max_len)
+    np.testing.assert_array_equal(np.asarray(spec), plain)
+    generated_after_prefill = max_len - P - 1
+    assert int(rounds) == -(-generated_after_prefill // (k + 1))
+
+
+def test_chunked_prefill_matches_tokenwise():
+    """_chunk_logits over a whole prompt equals the token-by-token cache
+    build (the chunked path is new; the scan path is the oracle)."""
+    cfg, _ = _spec_cfgs()
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    rng = np.random.RandomState(3)
+    toks = jnp.asarray(rng.randint(0, 64, (2, 9)), jnp.int32)
+    L, B, nh, hd, M = cfg.n_layers, 2, cfg.n_heads, cfg.head_dim, 16
+    kc = jnp.zeros((L, B, nh, M, hd), cfg.dtype)
+    vc = jnp.zeros_like(kc)
+    chunk_logits, kc_c, vc_c = gen._chunk_logits(params, cfg, toks,
+                                                 kc, vc, 0)
+    kc2, vc2 = jnp.zeros_like(kc), jnp.zeros_like(vc)
+    steps = []
+    for t in range(9):
+        lg, kc2, vc2 = gen._one_token_logits(params, cfg, toks[:, t],
+                                             kc2, vc2, t)
+        steps.append(lg)
+    np.testing.assert_allclose(np.asarray(chunk_logits),
+                               np.stack([np.asarray(s) for s in steps], 1),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(kc_c), np.asarray(kc2),
+                               atol=2e-6, rtol=2e-6)
+
+
 def test_incremental_logits_match_forward_postln_bias_dialect():
     """The decode path must honor the canonical-architecture knobs
     (post-LN blocks, projection biases, non-default LN eps, erf gelu) —
