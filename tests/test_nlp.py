@@ -170,6 +170,18 @@ def test_generate_demo_example_runs():
     assert np.isfinite(loss) and loss < 3.0  # learned something
 
 
+def test_gpt2_pipeline_example_runs():
+    """examples/nlp/gpt2_pipeline.py: tokenizer -> HF import -> fine-tune
+    -> greedy/sampled/speculative decode -> export -> HF generates the
+    same tokens (the asserts live inside the script)."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    import gpt2_pipeline
+    loss = gpt2_pipeline.main(["--steps", "6", "--max-len", "20",
+                               "--spec-k", "2"])
+    assert np.isfinite(loss)
+
+
 def test_finetune_hf_bert_example_runs():
     """examples/nlp/finetune_hf_bert.py: HF checkpoint -> import -> fresh
     classification head -> flagship fine-tune step, accuracy above chance
