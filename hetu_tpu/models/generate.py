@@ -10,9 +10,12 @@ scanning the layer stack exactly like training does
 (``models/transformer.py`` keeps per-layer params stacked on a leading L
 axis).
 
-Prompt handling is teacher-forced inside the same scan: while t < len(p),
-the next input token comes from the prompt, afterwards from greedy argmax
-or temperature sampling — so prefill and decode share one compiled program.
+Prompt handling: rectangular prompts prefill positions [0, P-1) in ONE
+chunked forward (an MXU-shaped matmul; see ``_chunk_hidden``), then the
+scan/while loop decodes from the boundary; ragged batches (per-row
+``prompt_lens``) teacher-force inside the loop instead, since each row
+crosses its own prompt boundary at a different step. Either way the whole
+thing is one compiled program.
 
 Dense MLP blocks only (the switch MoE flagship path is a training
 configuration; decode asserts ``n_experts == 0``). Decode runs
@@ -120,6 +123,23 @@ def _one_token_logits(params, cfg, tok, kcache, vcache, pos):
     return logits[:, 0], kcache, vcache
 
 
+def _prefill_prefix(params, cfg, prompt, kcache, vcache, enabled,
+                    prompt_lens, want_logits):
+    """Shared rectangular-prompt prefill: when ``enabled`` and the batch is
+    rectangular (``prompt_lens is None``), positions [0, P-1) run as ONE
+    chunked forward. Returns (start, prefix_logits, kcache, vcache) —
+    start is the loop's first step (P-1, or 0 when prefill did not apply);
+    prefix_logits is the (B, P-1, V) head output when ``want_logits``
+    (callers whose contract returns per-position logits), else None."""
+    P = prompt.shape[1]
+    if not (enabled and prompt_lens is None and P > 1):
+        return 0, None, kcache, vcache
+    h, kcache, vcache = _chunk_hidden(params, cfg, prompt[:, :P - 1],
+                                      kcache, vcache, 0)
+    prefix = tfm.lm_head(params, h, cfg) if want_logits else None
+    return P - 1, prefix, kcache, vcache
+
+
 def _check_decode_args(cfg: tfm.TransformerConfig, max_len: int,
                        top_k: int) -> None:
     assert cfg.n_experts == 0, "decode supports dense blocks (no MoE)"
@@ -144,19 +164,30 @@ def _next_token(logits, rng, sample: bool, top_k: int, temperature):
 @functools.lru_cache(maxsize=32)
 def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                      sample: bool = False, top_k: int = 0,
-                     mesh=None):
+                     mesh=None, chunked_prefill: bool = True):
     """Returns a jitted ``(params, prompt (B, P) int32, rng_key,
     temperature=1.0, prompt_lens=None) -> (tokens (B, max_len),
     logits (B, max_len, V))`` where tokens[:, :P] echoes the prompt and the
     rest is generated. ``prompt_lens`` (B,) int32 (clamped to [1, P])
     decodes a RAGGED batch in one call: row b teacher-forces its first
     prompt_lens[b] tokens and generates from its own boundary — under
-    GREEDY decoding, token-exact vs decoding each row alone (sampling
-    draws from a batch-shaped rng stream, so batched != solo draws).
+    GREEDY decoding, token-exact vs decoding each row alone with the SAME
+    prefill mechanism (sampling draws from a batch-shaped rng stream, so
+    batched != solo draws).
     ``sample=False``: greedy argmax (rng/temperature unused);
     ``sample=True``: temperature sampling — temperature is a DYNAMIC
-    operand, so sweeping it never recompiles. ``top_k > 0`` restricts
-    sampling to the k most likely tokens.
+    operand, so sweeping it never recompiles; each time step consumes
+    ``fold_in(key, t)``, so the draw at step t does not depend on how the
+    prefix was processed. ``top_k > 0`` restricts sampling to the k most
+    likely tokens.
+
+    ``chunked_prefill`` (rectangular prompts only — ragged rows have
+    per-row boundaries): positions [0, P-1) run as ONE chunked forward
+    instead of P-1 sequential single-token steps. The chunk computes the
+    same math but XLA may tile/accumulate it differently, so greedy
+    results can differ from the tokenwise path in exact-tie cases; pass
+    ``chunked_prefill=False`` when bit-parity with the ragged/tokenwise
+    path matters more than prefill speed.
 
     ``mesh``: distributed decode — params stay in their Megatron layout
     (``tfm.param_specs``: qkv/mlp column-parallel over ``tp``), the KV
@@ -188,13 +219,23 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         padded = jnp.zeros((B, max_len), jnp.int32)
         padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
 
+        # the per-position logits stay part of the returned contract, so
+        # the prefill head runs over the whole prefix as one matmul too
+        start, prefix_logits, kcache, vcache = _prefill_prefix(
+            params, cfg, prompt, kcache, vcache, chunked_prefill,
+            prompt_lens, want_logits=True)
+
         def step(carry, t):
-            tok_seq, kcache, vcache, key = carry
+            tok_seq, kcache, vcache = carry
             tok = jax.lax.dynamic_index_in_dim(tok_seq, t, 1, keepdims=False)
             logits, kcache, vcache = _one_token_logits(
                 params, cfg, tok, kcache, vcache, t)
-            key, sub = jax.random.split(key)
-            nxt = _next_token(logits, sub, sample, top_k, temperature)
+            # fold_in(key, t), NOT a split chain: the draw at step t is a
+            # function of (key, t) alone, so skipping prefill steps (or
+            # passing prompt_lens for a rectangular batch) never shifts
+            # the sampling stream
+            nxt = _next_token(logits, jax.random.fold_in(key, t), sample,
+                              top_k, temperature)
             # teacher-force while the NEXT position is still in the row's
             # prompt, and never write past the end (the final step's sample
             # has no slot — its logits are still returned)
@@ -205,11 +246,15 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
             nxt = jnp.where((t + 1) < max_len, nxt, cur_next)
             tok_seq = jax.lax.dynamic_update_slice(
                 tok_seq, nxt[:, None], (0, idx))
-            return (tok_seq, kcache, vcache, key), logits
+            return (tok_seq, kcache, vcache), logits
 
-        (tok_seq, _, _, _), logits_seq = jax.lax.scan(
-            step, (padded, kcache, vcache, key), jnp.arange(max_len))
-        return tok_seq, jnp.swapaxes(logits_seq, 0, 1)  # (B, M, V)
+        (tok_seq, _, _), logits_seq = jax.lax.scan(
+            step, (padded, kcache, vcache),
+            jnp.arange(start, max_len))
+        logits = jnp.swapaxes(logits_seq, 0, 1)         # (B, M-start, V)
+        if prefix_logits is not None:
+            logits = jnp.concatenate([prefix_logits, logits], axis=1)
+        return tok_seq, logits                          # (B, M, V)
 
     return jax.jit(gen, static_argnames=())
 
@@ -228,13 +273,17 @@ def generate(params, cfg: tfm.TransformerConfig, prompt, max_len: int,
 @functools.lru_cache(maxsize=32)
 def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                          eos_id: int, sample: bool = False,
-                         top_k: int = 0):
+                         top_k: int = 0, chunked_prefill: bool = True):
     """EOS-aware decode: a ``lax.while_loop`` that EXITS EARLY once every
     row has emitted ``eos_id`` — data-dependent control flow the
     compiler-friendly way (the fixed-length scan path pays for max_len
     steps regardless; this pays only for the longest row). Finished rows
     keep emitting eos. Returns (tokens (B, max_len) — tail filled with
-    eos — and n_steps actually executed)."""
+    eos — and t, the POSITION the loop stopped at: the number of sequence
+    positions processed, counting chunk-prefilled prompt positions; loop
+    ITERATIONS executed are t - (P-1) for a chunk-prefilled rectangular
+    prompt). ``chunked_prefill`` as in ``make_generate_fn`` (False = the
+    tokenwise path, bit-parity with ragged decodes)."""
     _check_decode_args(cfg, max_len, top_k)
     assert 0 <= eos_id < cfg.vocab_size, (
         f"eos_id {eos_id} outside vocab [0, {cfg.vocab_size}) — the model "
@@ -257,21 +306,31 @@ def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         padded = jnp.where(pos < plens[:, None], padded, eos_id)
         finished = jnp.zeros((B,), bool)
 
+        # rectangular prompts: chunk-prefill [0, P-1) exactly as the scan
+        # path does (the while body then only ever runs decode-shaped
+        # iterations — for the common serving case of a long prompt with
+        # early exit this removes P-1 sequential single-token steps)
+        start, _, kcache, vcache = _prefill_prefix(
+            params, cfg, prompt, kcache, vcache, chunked_prefill,
+            prompt_lens, want_logits=False)
+        t0 = jnp.int32(start)
+
         def cond(state):
-            t, _, _, _, _, finished = state
+            t, _, _, _, finished = state
             # finished can only be set past the prompt, so this single
             # clause also keeps the teacher-forced prefix running
             return jnp.logical_and(t < max_len - 1,
                                    jnp.logical_not(jnp.all(finished)))
 
         def body(state):
-            t, tok_seq, kcache, vcache, key = state[:5]
-            finished = state[5]
+            t, tok_seq, kcache, vcache, finished = state
             tok = jax.lax.dynamic_index_in_dim(tok_seq, t, 1, keepdims=False)
             logits, kcache, vcache = _one_token_logits(
                 params, cfg, tok, kcache, vcache, t)
-            key, sub = jax.random.split(key)
-            nxt = _next_token(logits, sub, sample, top_k, temperature)
+            # fold_in(key, t): draws depend on (key, t) alone — see
+            # make_generate_fn
+            nxt = _next_token(logits, jax.random.fold_in(key, t), sample,
+                              top_k, temperature)
             in_prompt = (t + 1) < plens    # per-row (ragged batches)
             cur_next = jax.lax.dynamic_index_in_dim(tok_seq, t + 1, 1,
                                                     keepdims=False)
@@ -282,10 +341,10 @@ def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
                 jnp.logical_and(jnp.logical_not(in_prompt), nxt == eos_id))
             tok_seq = jax.lax.dynamic_update_slice(tok_seq, nxt[:, None],
                                                    (0, t + 1))
-            return (t + 1, tok_seq, kcache, vcache, key, finished)
+            return (t + 1, tok_seq, kcache, vcache, finished)
 
-        t, tok_seq, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), padded, kcache, vcache, key, finished))
+        t, tok_seq, _, _, _ = jax.lax.while_loop(
+            cond, body, (t0, padded, kcache, vcache, finished))
         return tok_seq, t
 
     return jax.jit(gen)

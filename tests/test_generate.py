@@ -182,10 +182,20 @@ def test_ragged_prompts_match_per_row_decode():
     toks, _ = fn(params, prompt, jax.random.PRNGKey(0),
                  prompt_lens=jnp.asarray(lens, jnp.int32))
     for b, ln in enumerate(lens):
-        solo, _ = fn(params, prompt[b:b + 1, :ln], jax.random.PRNGKey(0))
+        # solo rows pass prompt_lens too: the exactness guarantee is
+        # scoped to the SAME prefill mechanism (the ragged batch
+        # teacher-forces in-loop; a bare rectangular call would use the
+        # chunked prefill, whose tilings may tie-break differently)
+        solo, _ = fn(params, prompt[b:b + 1, :ln], jax.random.PRNGKey(0),
+                     prompt_lens=jnp.asarray([ln], jnp.int32))
         np.testing.assert_array_equal(np.asarray(toks[b]),
                                       np.asarray(solo[0]),
                                       err_msg=f"row {b} (len {ln})")
+        # on the CPU test backend the chunked prefill is additionally
+        # bit-identical to the tokenwise path (TPU tilings may not be)
+        chunked, _ = fn(params, prompt[b:b + 1, :ln], jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(chunked[0]),
+                                      np.asarray(solo[0]))
 
     # EOS path: same ragged semantics (greedy rows match the scan path up
     # to each row's first eos; after it the tail is eos-filled)
