@@ -358,50 +358,81 @@ def _with_fused_fallback(fn, flag_name="fused_lm_ce"):
 def _run_section(name):
     """Child mode: compute ONE section, print one JSON object, exit.
     Runs in its own process so a hung compile (degraded tunnel) can be
-    killed from outside — SIGALRM cannot interrupt a stuck C call."""
+    killed from outside — SIGALRM cannot interrupt a stuck C call.
+
+    HETU_BENCH_SMOKE=1 shrinks every section to seconds-scale configs so
+    the whole section surface can execute on the CPU backend in tests —
+    the driver's one hardware run must never be the first time a
+    section's Python path executes (tests/test_bench_sections.py)."""
+    smoke = os.environ.get("HETU_BENCH_SMOKE") == "1"
+    # tiny-but-structurally-identical transformer dialect for smoke runs
+    tiny = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                d_ff=128, max_seq_len=64)
     out = {}
     if name.startswith("resnet:"):
         _, bs, tag = name.split(":")
         dtype = None if tag == "f32" else "bfloat16"
-        sps, ms, mfu = bench_resnet18(batch_size=int(bs), dtype=dtype)
+        kw = dict(batch_size=8, warmup=1, iters=2) if smoke else \
+            dict(batch_size=int(bs))
+        sps, ms, mfu = bench_resnet18(dtype=dtype, **kw)
         out = {"samples_per_sec": round(sps, 1), "step_ms": round(ms, 2),
                "mfu": round(mfu, 4) if mfu else None}
     elif name == "twin":
         _import_models("cnn")
         import jax_twin
-        tsps, tms = jax_twin.bench(batch_size=512, dtype="bf16")
+        kw = dict(batch_size=8, warmup=1, iters=2) if smoke else \
+            dict(batch_size=512)
+        tsps, tms = jax_twin.bench(dtype="bf16", **kw)
         out = {"samples_per_sec": round(tsps, 1), "step_ms": round(tms, 2)}
     elif name == "transformer":
-        out = _with_fused_fallback(bench_transformer)
+        if smoke:
+            out = _with_fused_fallback(
+                lambda **kw: bench_transformer(batch=2, seq=64, warmup=1,
+                                               iters=2, **tiny, **kw))
+        else:
+            out = _with_fused_fallback(bench_transformer)
     elif name == "transformer350":
         # flagship-scale proof point (~350M params): MFU must rise with
         # model size if the 38M config is shape-bound, as claimed
         from hetu_tpu.models import transformer as tfm
 
         def cfg350(**kw):
-            return tfm.TransformerConfig(vocab_size=32768, d_model=1024,
-                                         n_heads=16, n_layers=24, d_ff=4096,
-                                         max_seq_len=512, remat=True, **kw)
+            big = dict(vocab_size=32768, d_model=1024, n_heads=16,
+                       n_layers=24, d_ff=4096, max_seq_len=512)
+            return tfm.TransformerConfig(remat=True,
+                                         **(tiny if smoke else big), **kw)
 
         out = _with_fused_fallback(
-            lambda **kw: bench_transformer(cfg=cfg350(**kw), batch=8,
-                                           seq=512, warmup=2, iters=8),
+            lambda **kw: bench_transformer(
+                cfg=cfg350(**kw), batch=2 if smoke else 8,
+                seq=64 if smoke else 512, warmup=1 if smoke else 2,
+                iters=2 if smoke else 8),
             flag_name="fused_lm_ce")
     elif name == "decode":
-        dtoks, dms = bench_decode()
+        kw = dict(batch=2, prompt_len=4, max_len=16) if smoke else {}
+        dtoks, dms = bench_decode(**kw)
         out = {"tokens_per_sec": round(dtoks, 0),
                "ms_per_token": round(dms, 3)}
     elif name == "flash4k":
-        out = bench_flash_attention()
+        kw = dict(b=1, h=2, s=256, d=64, iters=2) if smoke else {}
+        out = bench_flash_attention(**kw)
     elif name == "bert":
-        out = _with_fused_fallback(bench_bert, flag_name="fused_mlm_ce")
+        if smoke:
+            out = _with_fused_fallback(
+                lambda **kw: bench_bert(batch_size=2, seq_len=64, warmup=1,
+                                        iters=2, **tiny, **kw),
+                flag_name="fused_mlm_ce")
+        else:
+            out = _with_fused_fallback(bench_bert, flag_name="fused_mlm_ce")
     elif name == "probe":
         import jax
         import jax.numpy as jnp
         x = jnp.ones((512, 512))
         out = {"ok": float(jnp.sum(jax.jit(lambda a: a @ a)(x))) > 0}
     elif name == "wdl":
-        out = bench_wdl_ps()
+        kw = dict(batch_size=16, warmup=1, iters=4,
+                  feature_dim=1000) if smoke else {}
+        out = bench_wdl_ps(**kw)
         out["servers"] = 2
     else:
         raise SystemExit(f"unknown section {name}")

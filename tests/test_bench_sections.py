@@ -1,0 +1,36 @@
+"""Every bench section's Python path executes end to end (smoke configs).
+
+The driver gets ONE hardware run per round; several sections (bert,
+transformer350, decode, flash4k, wdl) have historically reached that run
+without ever executing end to end, so an API drift in the framework
+would surface as a lost bench cell. HETU_BENCH_SMOKE=1 shrinks each
+section to a seconds-scale config; each runs here as the REAL
+``--run-section`` subprocess (the exact child the driver spawns), on the
+CPU backend the conftest pins.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
+            "transformer", "transformer350", "twin", "decode", "flash4k",
+            "wdl"]
+
+
+@pytest.mark.parametrize("name", SECTIONS)
+def test_section_runs_in_smoke_mode(name, monkeypatch):
+    monkeypatch.setenv("HETU_BENCH_SMOKE", "1")
+    # the child re-runs this image's sitecustomize (PYTHONPATH points at
+    # it), which pins the axon backend BEFORE the inherited
+    # JAX_PLATFORMS=cpu can take effect — on a dead tunnel every section
+    # would hang. Blank it: bench.py's cwd makes the repo importable.
+    monkeypatch.setenv("PYTHONPATH", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    out = bench._section_subprocess(name, timeout=600)
+    assert "error" not in out, out
+    # every section's JSON records which device it actually ran on
+    assert out.pop("_device", None) is not None
