@@ -315,5 +315,4 @@ def batch_from_instances(instances):
 init_opt_state = tfm.init_opt_state
 
 
-def count_params(params) -> int:
-    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+count_params = tfm.count_params

@@ -1,0 +1,187 @@
+"""HuggingFace ViT checkpoint import — the vision side of the interop.
+
+``transformers`` ViT (ViTModel / ViTForImageClassification) is the
+flagship trunk's pre-LN dialect with projection biases: HF's
+``layernorm_before`` is ln1 (before attention), ``layernorm_after`` is
+ln2 (before the MLP), activation is erf gelu at eps 1e-12, and the final
+``layernorm`` is lnf. The stride=P patch-projection conv flattens to
+``models/vit.py``'s single patch matmul by pure reshape (the kernel's
+(C, Ps, Ps) receptive field is exactly one flattened patch).
+``tests/test_hf_vit.py`` pins hidden states and classifier logits to the
+torch forward. The reference has no pretrained-checkpoint interop.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .hf_common import np_f32, tree_to_jnp
+from .vit import ViTConfig
+
+
+def config_from_hf(hf_config, **overrides) -> ViTConfig:
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(f"hidden_act={act!r}: only gelu variants")
+    if not getattr(hf_config, "qkv_bias", True):
+        raise NotImplementedError("qkv_bias=False ViT variants")
+    kw = dict(
+        image_size=hf_config.image_size,
+        patch_size=hf_config.patch_size,
+        n_channels=hf_config.num_channels,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        ln_eps=hf_config.layer_norm_eps,
+        gelu_exact=(act == "gelu"),
+    )
+    kw.update(overrides)
+    return ViTConfig(**kw)
+
+
+def params_from_hf(model, cfg: ViTConfig = None):
+    """(transformers ViTModel/ViTForImageClassification, cfg?) ->
+    (params, cfg); a caller-supplied cfg is validated against the
+    checkpoint's architecture (including the classifier head: an
+    n_classes that disagrees with the checkpoint's refuses; n_classes=0
+    explicitly DROPS the checkpoint's head)."""
+    ckpt_classes = (len(getattr(model.config, "id2label", {}) or {})
+                    if _has_classifier(model) else 0)
+    want = config_from_hf(model.config, n_classes=ckpt_classes)
+    if cfg is None:
+        cfg = want
+    mismatched = [f
+                  for f in ("image_size", "patch_size", "n_channels",
+                            "d_model", "n_heads", "n_layers", "d_ff",
+                            "ln_eps", "gelu_exact")
+                  if getattr(cfg, f) != getattr(want, f)]
+    if cfg.n_classes not in (0, ckpt_classes):
+        mismatched.append("n_classes")
+    if mismatched:
+        raise ValueError(
+            "cfg disagrees with the checkpoint's architecture on "
+            + ", ".join(f"{f} ({getattr(cfg, f)} != {getattr(want, f)})"
+                        for f in mismatched))
+    sd: Dict[str, Any] = {}
+    for k, v in model.state_dict().items():
+        if k.startswith("vit."):
+            k = k[len("vit."):]
+        sd[k] = np_f32(v)
+    L, D = cfg.n_layers, cfg.d_model
+
+    def layer(i, name):
+        return sd[f"encoder.layer.{i}.{name}"]
+
+    wqkv = np.stack([
+        np.concatenate([layer(i, "attention.attention.query.weight").T,
+                        layer(i, "attention.attention.key.weight").T,
+                        layer(i, "attention.attention.value.weight").T],
+                       axis=1)
+        for i in range(L)])                                   # (L, D, 3D)
+    bqkv = np.stack([
+        np.concatenate([layer(i, "attention.attention.query.bias"),
+                        layer(i, "attention.attention.key.bias"),
+                        layer(i, "attention.attention.value.bias")])
+        for i in range(L)])
+    blocks = {
+        "wqkv": wqkv,
+        "bqkv": bqkv,
+        "wo": np.stack([layer(i, "attention.output.dense.weight").T
+                        for i in range(L)]),
+        "bo": np.stack([layer(i, "attention.output.dense.bias")
+                        for i in range(L)]),
+        # pre-LN: layernorm_before runs before attention (ln1),
+        # layernorm_after before the MLP (ln2)
+        "ln1_scale": np.stack([layer(i, "layernorm_before.weight")
+                               for i in range(L)]),
+        "ln1_bias": np.stack([layer(i, "layernorm_before.bias")
+                              for i in range(L)]),
+        "ln2_scale": np.stack([layer(i, "layernorm_after.weight")
+                               for i in range(L)]),
+        "ln2_bias": np.stack([layer(i, "layernorm_after.bias")
+                              for i in range(L)]),
+        "w1": np.stack([layer(i, "intermediate.dense.weight").T
+                        for i in range(L)]),
+        "b1": np.stack([layer(i, "intermediate.dense.bias")
+                        for i in range(L)]),
+        "w2": np.stack([layer(i, "output.dense.weight").T
+                        for i in range(L)]),
+        "b2": np.stack([layer(i, "output.dense.bias") for i in range(L)]),
+    }
+    # the stride=P conv kernel (D, C, Ps, Ps): its (C, Ps, Ps) receptive
+    # field flattens to one patch row, so reshape+transpose IS the matmul
+    # weight (no resampling of any kind)
+    conv_w = sd["embeddings.patch_embeddings.projection.weight"]
+    params = {
+        "patch_w": conv_w.reshape(D, -1).T.copy(),     # (C*Ps*Ps, D)
+        "patch_b": sd["embeddings.patch_embeddings.projection.bias"],
+        "cls_token": sd["embeddings.cls_token"],
+        "pos": sd["embeddings.position_embeddings"][0],
+        "lnf_scale": sd["layernorm.weight"],
+        "lnf_bias": sd["layernorm.bias"],
+        "blocks": blocks,
+    }
+    if "classifier.weight" in sd and cfg.n_classes:
+        params["cls_w"] = sd["classifier.weight"].T
+        params["cls_b"] = sd["classifier.bias"]
+    return tree_to_jnp(params), cfg
+
+
+def _has_classifier(model) -> bool:
+    return any(k.startswith("classifier.") for k in model.state_dict())
+
+
+def state_dict_from_params(params, cfg: ViTConfig):
+    """Inverse of ``params_from_hf``: params -> HF-named numpy state dict
+    so TPU-trained/fine-tuned ViT weights deploy back through
+    ``transformers``."""
+    blocks = {k: np.asarray(v) for k, v in params["blocks"].items()}
+    D = cfg.d_model
+    sd = {
+        "embeddings.cls_token": np.asarray(params["cls_token"]),
+        "embeddings.position_embeddings": np.asarray(params["pos"])[None],
+        "embeddings.patch_embeddings.projection.weight":
+            np.asarray(params["patch_w"]).T.reshape(
+                D, cfg.n_channels, cfg.patch_size, cfg.patch_size),
+        "embeddings.patch_embeddings.projection.bias":
+            np.asarray(params["patch_b"]),
+        "layernorm.weight": np.asarray(params["lnf_scale"]),
+        "layernorm.bias": np.asarray(params["lnf_bias"]),
+    }
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        wqkv, bqkv = blocks["wqkv"][i], blocks["bqkv"][i]
+        sd[p + "attention.attention.query.weight"] = wqkv[:, :D].T
+        sd[p + "attention.attention.key.weight"] = wqkv[:, D:2 * D].T
+        sd[p + "attention.attention.value.weight"] = wqkv[:, 2 * D:].T
+        sd[p + "attention.attention.query.bias"] = bqkv[:D]
+        sd[p + "attention.attention.key.bias"] = bqkv[D:2 * D]
+        sd[p + "attention.attention.value.bias"] = bqkv[2 * D:]
+        sd[p + "attention.output.dense.weight"] = blocks["wo"][i].T
+        sd[p + "attention.output.dense.bias"] = blocks["bo"][i]
+        sd[p + "layernorm_before.weight"] = blocks["ln1_scale"][i]
+        sd[p + "layernorm_before.bias"] = blocks["ln1_bias"][i]
+        sd[p + "layernorm_after.weight"] = blocks["ln2_scale"][i]
+        sd[p + "layernorm_after.bias"] = blocks["ln2_bias"][i]
+        sd[p + "intermediate.dense.weight"] = blocks["w1"][i].T
+        sd[p + "intermediate.dense.bias"] = blocks["b1"][i]
+        sd[p + "output.dense.weight"] = blocks["w2"][i].T
+        sd[p + "output.dense.bias"] = blocks["b2"][i]
+    if "cls_w" in params:
+        sd["classifier.weight"] = np.asarray(params["cls_w"]).T
+        sd["classifier.bias"] = np.asarray(params["cls_b"])
+    return sd
+
+
+def export_to_hf(params, cfg: ViTConfig, model):
+    """Load params into a live transformers ViT ``model``
+    (ViTForImageClassification, or ViTModel built with
+    ``add_pooling_layer=False`` — our ViT has no pooler, and silently
+    leaving a random pooler in the target would be a partial deploy).
+    Bidirectionally validated via ``hf_common.load_into_hf``."""
+    from .hf_common import load_into_hf
+    sd = state_dict_from_params(params, cfg)
+    return load_into_hf(sd, model, scope="vit.",
+                        droppable=("classifier.",))

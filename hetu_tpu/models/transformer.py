@@ -510,6 +510,12 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
 # train step (adamw fused into the step, buffers donated)
 # ---------------------------------------------------------------------------
 
+def count_params(params) -> int:
+    """Total parameter count of any params pytree (shared by every model
+    family — bert/vit re-export it)."""
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
 def init_opt_state(params):
     zeros = lambda p: jnp.zeros_like(p)
     return {"m": jax.tree.map(zeros, params),

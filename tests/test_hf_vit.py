@@ -1,0 +1,144 @@
+"""HuggingFace ViT numerical parity (models/hf_vit.py) — the vision side
+of the checkpoint interop, pinned exactly like the BERT/GPT-2 suites:
+random-weight transformers ViT (no network), import, compare forwards."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from hetu_tpu.models import vit as hvit
+from hetu_tpu.models.hf_vit import (config_from_hf, export_to_hf,
+                                    params_from_hf)
+
+
+def small_hf_config(**over):
+    kw = dict(image_size=32, patch_size=8, num_channels=3, hidden_size=48,
+              num_hidden_layers=3, num_attention_heads=4,
+              intermediate_size=96, hidden_act="gelu",
+              layer_norm_eps=1e-12)
+    kw.update(over)
+    return transformers.ViTConfig(**kw)
+
+
+def images(rng, n=2, size=32):
+    return rng.standard_normal((n, 3, size, size)).astype(np.float32)
+
+
+def test_hidden_states_match_hf():
+    torch.manual_seed(0)
+    model = transformers.ViTModel(small_hf_config(),
+                                  add_pooling_layer=False).eval()
+    params, cfg = params_from_hf(model)
+    x = images(np.random.default_rng(1))
+    with torch.no_grad():
+        ref = model(pixel_values=torch.tensor(x)).last_hidden_state.numpy()
+    ours = np.asarray(hvit.encode(params, jnp.asarray(x), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_classifier_logits_match_hf():
+    torch.manual_seed(1)
+    model = transformers.ViTForImageClassification(
+        small_hf_config(num_labels=7)).eval()
+    params, cfg = params_from_hf(model)
+    assert cfg.n_classes == 7
+    x = images(np.random.default_rng(2), n=3)
+    with torch.no_grad():
+        ref = model(pixel_values=torch.tensor(x)).logits.numpy()
+    ours = np.asarray(hvit.classify_logits(params, jnp.asarray(x), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_import_refuses_mismatched_config():
+    torch.manual_seed(2)
+    model = transformers.ViTModel(small_hf_config(),
+                                  add_pooling_layer=False).eval()
+    bad = config_from_hf(model.config, n_layers=1)
+    with pytest.raises(ValueError, match="n_layers"):
+        params_from_hf(model, bad)
+
+
+def test_imported_vit_trains_a_step():
+    """Imported encoder + fresh head fine-tunes through the flagship step
+    and learns a trivial brightness rule above chance."""
+    import dataclasses
+    torch.manual_seed(3)
+    model = transformers.ViTModel(small_hf_config(),
+                                  add_pooling_layer=False).eval()
+    params, cfg = params_from_hf(model)
+    cfg = dataclasses.replace(cfg, n_classes=2)
+    k = jax.random.PRNGKey(0)
+    params["cls_w"] = jax.random.normal(k, (cfg.d_model, 2)) * 0.02
+    params["cls_b"] = jnp.zeros((2,))
+    step = hvit.make_train_step(cfg, lr=1e-3)
+    opt = hvit.init_opt_state(params)
+    rng = np.random.default_rng(4)
+    acc = 0.0
+    for _ in range(30):
+        x = images(rng, n=16)
+        labels = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+        x = x + labels[:, None, None, None] * 0.5   # separable signal
+        loss, acc, params, opt = step(params, opt, jnp.asarray(x),
+                                      jnp.asarray(labels))
+    assert float(acc) > 0.7
+
+
+def test_train_then_export_roundtrip():
+    """Fine-tune imported ViT weights, export into a fresh torch
+    ViTForImageClassification, logits must match ours."""
+    torch.manual_seed(4)
+    model = transformers.ViTForImageClassification(
+        small_hf_config(num_labels=4)).eval()
+    params, cfg = params_from_hf(model)
+    step = hvit.make_train_step(cfg, lr=1e-3)
+    trained = jax.tree.map(jnp.array, params)
+    rng = np.random.default_rng(5)
+    x = images(rng, n=8)
+    _, _, trained, _ = step(trained, hvit.init_opt_state(trained),
+                            jnp.asarray(x),
+                            jnp.asarray(rng.integers(0, 4, 8), jnp.int32))
+    fresh = transformers.ViTForImageClassification(
+        small_hf_config(num_labels=4)).eval()
+    export_to_hf(trained, cfg, fresh)
+    xt = images(rng, n=3)
+    with torch.no_grad():
+        ref = fresh(pixel_values=torch.tensor(xt)).logits.numpy()
+    ours = np.asarray(hvit.classify_logits(trained, jnp.asarray(xt), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_export_refuses_layer_mismatch():
+    torch.manual_seed(5)
+    model = transformers.ViTForImageClassification(
+        small_hf_config(num_labels=4)).eval()
+    params, cfg = params_from_hf(model)
+    small = transformers.ViTForImageClassification(
+        small_hf_config(num_labels=4, num_hidden_layers=2)).eval()
+    with pytest.raises(ValueError, match="no slot"):
+        export_to_hf(params, cfg, small)
+
+
+def test_flagship_vit_mesh_forward_matches_single_device():
+    """The from-scratch flagship ViT shards dp2/tp2 on the virtual mesh
+    and matches its own single-device forward (tp-divisible widths)."""
+    from hetu_tpu.parallel.mesh import make_mesh
+    cfg = hvit.ViTConfig(image_size=32, patch_size=8, d_model=64,
+                         n_heads=4, n_layers=2, d_ff=128, n_classes=6)
+    params = hvit.init_params(jax.random.PRNGKey(5), cfg)
+    x = images(np.random.default_rng(6), n=4)
+    solo = np.asarray(hvit.classify_logits(params, jnp.asarray(x), cfg))
+    mesh = make_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    import functools
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(
+            p, jax.sharding.NamedSharding(mesh, s)),
+        params, hvit.param_specs(cfg),
+        is_leaf=lambda v: isinstance(v, jax.sharding.PartitionSpec))
+    meshed = np.asarray(jax.jit(
+        lambda p, im: hvit.classify_logits(p, im, cfg, mesh))(
+            sharded, jnp.asarray(x)))
+    np.testing.assert_allclose(meshed, solo, atol=2e-4, rtol=2e-4)
