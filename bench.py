@@ -342,6 +342,45 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
         return out
 
 
+def bench_vit(batch=64, warmup=3, iters=15, **cfg_overrides):
+    """ViT-base/16 image-classification fine-tune step (the vision side of
+    the flagship trunk; same 6ND + attention-inclusive MFU accounting as
+    the LM cells, with T = n_patches + 1)."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models import vit as hvit
+
+    kw = dict(n_classes=1000, dtype=jnp.bfloat16, remat=True)
+    kw.update(cfg_overrides)
+    cfg = hvit.ViTConfig(**kw)
+    params = hvit.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = hvit.count_params(params)
+    opt = hvit.init_opt_state(params)
+    step = hvit.make_train_step(cfg, lr=1e-4)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, cfg.n_channels, cfg.image_size,
+                              cfg.image_size), jnp.float32)
+    y = jnp.asarray(rng.randint(0, cfg.n_classes, batch), jnp.int32)
+    loss = None
+    for _ in range(warmup):
+        loss, _, params, opt = step(params, opt, x, y)
+    float(np.asarray(loss))   # hard sync (see bench_bert)
+    t0 = time.time()
+    for _ in range(iters):
+        loss, _, params, opt = step(params, opt, x, y)
+    float(np.asarray(loss))
+    dt = (time.time() - t0) / iters
+    T = cfg.seq_len
+    flops_6nd = 6.0 * n_params * batch * T
+    flops_attn = _attn_flops(batch, T, cfg.n_layers, cfg.d_model,
+                             causal=False)
+    return {"images_per_sec": round(batch / dt, 1),
+            "step_ms": round(dt * 1000, 2),
+            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
+            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
+            "n_params": n_params}
+
+
 def _with_fused_fallback(fn, flag_name="fused_lm_ce"):
     """The fused-CE kernel's compiled (non-interpret) path first executes
     on the DRIVER's chip — if Mosaic rejects it there, retry the cell with
@@ -424,6 +463,11 @@ def _run_section(name):
                 flag_name="fused_mlm_ce")
         else:
             out = _with_fused_fallback(bench_bert, flag_name="fused_mlm_ce")
+    elif name == "vit":
+        kw = (dict(batch=2, warmup=1, iters=2, image_size=32, patch_size=8,
+                   d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                   n_classes=10) if smoke else {})
+        out = bench_vit(**kw)
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -559,6 +603,7 @@ def main():
                      ("jax_native_twin_bf16_bs512", "twin", 420),
                      ("decode_38M_greedy", "decode", 420),
                      ("flash_attention_seq4096", "flash4k", 420),
+                     ("vit_base_finetune", "vit", 600),
                      ("wdl_criteo_hybrid_ps", "wdl", 600)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent

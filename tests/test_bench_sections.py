@@ -18,7 +18,7 @@ import bench  # noqa: E402
 
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
-            "wdl"]
+            "vit", "wdl"]
 
 
 @pytest.mark.parametrize("name", SECTIONS)
