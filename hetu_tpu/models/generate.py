@@ -45,6 +45,13 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     carry: h (B, C, D); layer_inputs: (layer_params, k_cache, v_cache) with
     caches (B, nh, M, hd); the chunk occupies positions [pos, pos+C).
     Returns updated caches alongside the new h.
+
+    LOCKSTEP CONTRACT with ``transformer._block``: every architecture
+    dialect knob (post_ln, attn_proj_bias, ln_eps, gelu flavor, future
+    additions) must behave identically here, or decode silently runs a
+    different network than training —
+    test_incremental_logits_match_forward_postln_bias_dialect pins the
+    current knob set.
     """
     h = carry
     p, kc, vc = layer_inputs
@@ -449,8 +456,11 @@ def make_speculative_generate_fn(cfg: tfm.TransformerConfig,
     bandwidth-bound single-token steps), and the longest agreeing prefix
     is accepted plus the target's own next token. The greedy case of
     arXiv:2211.17192: output is TOKEN-EXACT equal to plain greedy decoding
-    with the target (pinned by test), only faster — each round advances
-    between 1 and k+1 tokens at one target forward.
+    with the target (pinned hard on the CPU backend; on TPU the C=k+1
+    verify chunk may tile/accumulate differently from the C=1 decode
+    step, so an EXACT logit tie can argmax differently — the same caveat
+    as ``chunked_prefill``), only faster — each round advances between 1
+    and k+1 tokens at one target forward.
 
     Returns jitted ``(params, draft_params, prompt (1, P) int32) ->
     (tokens (1, max_len), rounds)`` — rounds is the number of verify

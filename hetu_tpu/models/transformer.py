@@ -378,7 +378,11 @@ def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None,
     """One transformer block. Pre-LN (flagship default): LN -> sublayer ->
     residual. Post-LN (``cfg.post_ln``, canonical BERT / original
     Transformer): sublayer -> residual -> LN, with ln1 after attention and
-    ln2 after the MLP."""
+    ln2 after the MLP.
+
+    LOCKSTEP CONTRACT: any new dialect knob added here must be mirrored
+    in ``generate._decode_layer`` (the KV-cache form of this block) or
+    decode silently diverges from training for that config."""
     post = cfg.post_ln
     h = _constrain(h, mesh, "dp", "sp", None)
     attn_in = h if post else _layer_norm(

@@ -63,11 +63,14 @@ class GPT2Tokenizer:
         with open(vocab_file, encoding="utf-8") as f:
             self.encoder = json.load(f)
         # special tokens are never split by BPE; ones absent from the
-        # vocab are appended in SORTED order — both exactly HF's
-        # added-token behavior, so ids line up with the oracle
+        # vocab are appended in the GIVEN order. HF appends its specials
+        # in special-token-ATTRIBUTE order (bos, eos, unk, sep, pad, cls,
+        # mask, additional) — pass yours in that order and the appended
+        # ids line up with the transformers oracle (pinned by test)
         self.special_tokens = tuple(dict.fromkeys(special_tokens))
-        for tok in sorted(set(self.special_tokens) - set(self.encoder)):
-            self.encoder[tok] = len(self.encoder)
+        for tok in self.special_tokens:
+            if tok not in self.encoder:
+                self.encoder[tok] = len(self.encoder)
         self.decoder = {v: k for k, v in self.encoder.items()}
         with open(merges_file, encoding="utf-8") as f:
             # HF drops the first line (assumed #version header) and the

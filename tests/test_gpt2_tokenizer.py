@@ -83,16 +83,20 @@ def test_special_token_parity(pair, vocab_dir):
     assert eot == ref.encode("<|endoftext|>") and len(eot) == 1
 
 
-def test_custom_special_tokens_sorted_ids(vocab_dir):
-    # multiple distinct specials: HF appends them in SORTED order
+@pytest.mark.parametrize("bos,eos,unk", [("<b>", "<e>", "<u>"),
+                                         ("<z>", "<m>", "<a>")])
+def test_custom_special_tokens_attribute_order_ids(vocab_dir, bos, eos, unk):
+    # HF appends specials in ATTRIBUTE order (bos, eos, unk, ...), NOT
+    # alphabetically — the second parametrization is the ordering that
+    # would expose a sorted-append bug (z before a)
     ours = GPT2Tokenizer(os.path.join(vocab_dir, "vocab.json"),
                          os.path.join(vocab_dir, "merges.txt"),
-                         special_tokens=("<u>", "<b>", "<e>"))
+                         special_tokens=(bos, eos, unk))
     ref = transformers.GPT2Tokenizer(
         os.path.join(vocab_dir, "vocab.json"),
         os.path.join(vocab_dir, "merges.txt"),
-        unk_token="<u>", bos_token="<b>", eos_token="<e>")
-    text = "th<e>the<b>x<u>"
+        unk_token=unk, bos_token=bos, eos_token=eos)
+    text = f"th{eos}the{bos}x{unk}"
     assert ours.tokenize(text) == ref.tokenize(text)
     assert ours.encode(text) == ref.encode(text)
 
