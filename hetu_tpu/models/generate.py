@@ -57,19 +57,30 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     p, kc, vc = layer_inputs
     B, C, D = h.shape
     nh, hd = cfg.n_heads, cfg.head_dim
+    nkv = cfg.kv_heads
     M = kc.shape[2]
 
     post = cfg.post_ln
-    attn_in = h if post else tfm._layer_norm(h, p["ln1_scale"],
-                                             p["ln1_bias"], cfg.ln_eps)
+    attn_in = h if post else tfm._norm(h, p["ln1_scale"],
+                                       p["ln1_bias"], cfg)
     qkv = jnp.einsum("bod,de->boe", attn_in, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
     if cfg.attn_proj_bias:
         qkv = qkv + p["bqkv"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
     q = q.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)   # (B, nh, C, hd)
-    k = k.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, C, nh, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, C, nkv, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, C, nkv, hd).transpose(0, 2, 1, 3)
+    if cfg.rope:
+        # rotate at the chunk's absolute positions; the cache stores
+        # ROTATED keys (scores are position-relative after rotation)
+        q = tfm._rope(q, pos, cfg.rope_theta)
+        k = tfm._rope(k, pos, cfg.rope_theta)
+    if nkv != nh:
+        # gqa: the cache stores the BROADCAST heads (trades the kv-cache
+        # memory saving for identical attention math on every path)
+        k = jnp.repeat(k, nh // nkv, axis=1)
+        v = jnp.repeat(v, nh // nkv, axis=1)
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
 
@@ -89,13 +100,13 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
         attn_out = attn_out + p["bo"].astype(h.dtype)
     h = h + attn_out
     if post:
-        h = tfm._layer_norm(h, p["ln1_scale"], p["ln1_bias"], cfg.ln_eps)
+        h = tfm._norm(h, p["ln1_scale"], p["ln1_bias"], cfg)
 
-    mlp_in = h if post else tfm._layer_norm(h, p["ln2_scale"],
-                                            p["ln2_bias"], cfg.ln_eps)
+    mlp_in = h if post else tfm._norm(h, p["ln2_scale"],
+                                      p["ln2_bias"], cfg)
     h = h + tfm._dense_mlp(mlp_in, p, cfg, None)
     if post:
-        h = tfm._layer_norm(h, p["ln2_scale"], p["ln2_bias"], cfg.ln_eps)
+        h = tfm._norm(h, p["ln2_scale"], p["ln2_bias"], cfg)
     return h, (kc, vc)
 
 
@@ -107,8 +118,10 @@ def _chunk_hidden(params, cfg, toks, kcache, vcache, pos):
     every prompt position)."""
     B, C = toks.shape
     D = cfg.d_model
-    pos_emb = jax.lax.dynamic_slice(params["pos"], (pos, 0), (C, D))
-    h = (params["embed"][toks] + pos_emb[None]).astype(cfg.dtype)
+    h = params["embed"][toks].astype(cfg.dtype)
+    if cfg.use_pos_emb:
+        pos_emb = jax.lax.dynamic_slice(params["pos"], (pos, 0), (C, D))
+        h = h + pos_emb[None].astype(cfg.dtype)
     h, (kcache, vcache) = jax.lax.scan(
         functools.partial(_decode_layer, cfg=cfg, pos=pos), h,
         (params["blocks"], kcache, vcache))

@@ -1,0 +1,188 @@
+"""HuggingFace Llama-family checkpoint import — RoPE/RMSNorm/SwiGLU/GQA.
+
+``transformers`` Llama (LlamaModel / LlamaForCausalLM; windowless
+Mistral-class configs share the layout — sliding-window attention and
+non-default head_dim/rope_scaling refuse at import) is the flagship
+trunk's Llama dialect:
+pre-LN with RMSNorm (``input_layernorm`` -> ln1, ``post_attention_layernorm``
+-> ln2, final ``model.norm`` -> lnf; the unused *_bias params import as
+zeros), rotary position embeddings (HF rotate_half convention =
+``transformer._rope``), SwiGLU MLP (gate/up/down -> w1/w3/w2), optional
+grouped-query attention (num_key_value_heads < num_attention_heads), no
+learned position table, and an untied (D, V) lm_head unless the config
+ties it. Import is a pure weight relayout; the imported model rides the
+KV-cache decode (rotated keys in the cache), speculative decoding, and
+the training step. ``tests/test_hf_llama.py`` pins logits, decode, and
+generation against the torch forward. The reference has no checkpoint
+interop of any kind.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from .hf_common import np_f32, tree_to_jnp
+from .transformer import TransformerConfig
+
+
+def config_from_hf(hf_config, **overrides) -> TransformerConfig:
+    """transformers.LlamaConfig -> a flagship TransformerConfig; refuses
+    variants the trunk does not implement (importing them would run but
+    be numerically wrong)."""
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise NotImplementedError(f"hidden_act={act!r}: only silu")
+    if getattr(hf_config, "attention_bias", False):
+        raise NotImplementedError("attention_bias=True Llama variants")
+    if getattr(hf_config, "sliding_window", None):
+        # Mistral-style windowed attention: the trunk attends fully, so
+        # any sequence longer than the window would silently diverge
+        raise NotImplementedError(
+            f"sliding_window={hf_config.sliding_window}: only full "
+            "attention (windowless Mistral-class configs import fine)")
+    hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if getattr(hf_config, "head_dim", hd) not in (None, hd):
+        raise NotImplementedError(
+            f"head_dim={hf_config.head_dim} != hidden_size/num_heads "
+            f"({hd}): the trunk derives head_dim from d_model")
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling not in (None, {}) and (
+            not isinstance(scaling, dict)
+            or scaling.get("rope_type", scaling.get("type")) != "default"):
+        raise NotImplementedError(f"rope_scaling={scaling!r}")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=(hf_config.num_key_value_heads
+                    if hf_config.num_key_value_heads
+                    != hf_config.num_attention_heads else 0),
+        n_layers=hf_config.num_hidden_layers,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        ln_eps=hf_config.rms_norm_eps,
+        norm="rmsnorm",
+        rope=True,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        mlp="swiglu",
+        use_pos_emb=False,
+        tied_head=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        causal=True,
+        dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def params_from_hf(model, cfg: TransformerConfig = None):
+    """(transformers LlamaModel/LlamaForCausalLM, cfg?) -> (params, cfg);
+    a caller-supplied cfg is validated against the checkpoint."""
+    if cfg is None:
+        cfg = config_from_hf(model.config)
+    want = config_from_hf(model.config)
+    mismatched = [f
+                  for f in ("vocab_size", "d_model", "n_heads",
+                            "n_kv_heads", "n_layers", "d_ff", "max_seq_len",
+                            "ln_eps", "norm", "rope", "rope_theta", "mlp",
+                            "use_pos_emb", "tied_head", "causal",
+                            "post_ln", "attn_proj_bias", "n_experts")
+                  if getattr(cfg, f) != getattr(want, f)]
+    if mismatched:
+        raise ValueError(
+            "cfg disagrees with the checkpoint's architecture on "
+            + ", ".join(f"{f} ({getattr(cfg, f)} != {getattr(want, f)})"
+                        for f in mismatched))
+    sd: Dict[str, Any] = {}
+    for k, v in model.state_dict().items():
+        if k.startswith("model."):
+            k = k[len("model."):]
+        if "rotary_emb" in k:
+            continue              # inv_freq buffers; recomputed by _rope
+        sd[k] = np_f32(v)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+
+    def layer(i, name):
+        return sd[f"layers.{i}.{name}"]
+
+    wqkv = np.stack([
+        np.concatenate([layer(i, "self_attn.q_proj.weight").T,
+                        layer(i, "self_attn.k_proj.weight").T,
+                        layer(i, "self_attn.v_proj.weight").T], axis=1)
+        for i in range(L)])               # (L, D, (nh+2nkv)*hd)
+    blocks = {
+        "wqkv": wqkv,
+        "wo": np.stack([layer(i, "self_attn.o_proj.weight").T
+                        for i in range(L)]),
+        "ln1_scale": np.stack([layer(i, "input_layernorm.weight")
+                               for i in range(L)]),
+        "ln1_bias": np.zeros((L, D), np.float32),   # unused (rmsnorm)
+        "ln2_scale": np.stack([layer(i, "post_attention_layernorm.weight")
+                               for i in range(L)]),
+        "ln2_bias": np.zeros((L, D), np.float32),
+        "w1": np.stack([layer(i, "mlp.gate_proj.weight").T
+                        for i in range(L)]),
+        "w3": np.stack([layer(i, "mlp.up_proj.weight").T
+                        for i in range(L)]),
+        "w2": np.stack([layer(i, "mlp.down_proj.weight").T
+                        for i in range(L)]),
+        "b1": np.zeros((L, F), np.float32),         # unused (swiglu)
+        "b2": np.zeros((L, D), np.float32),
+    }
+    params = {
+        "embed": sd["embed_tokens.weight"],
+        "blocks": blocks,
+        "lnf_scale": sd["norm.weight"],
+        "lnf_bias": np.zeros((D,), np.float32),     # unused (rmsnorm)
+    }
+    if not cfg.tied_head:
+        if "lm_head.weight" in sd:
+            params["head"] = sd["lm_head.weight"].T.copy()
+        else:
+            raise ValueError(
+                "untied config but the checkpoint has no lm_head (pass a "
+                "LlamaForCausalLM, or a config with tie_word_embeddings)")
+    return tree_to_jnp(params), cfg
+
+
+def state_dict_from_params(params, cfg: TransformerConfig):
+    """Inverse relayout: params -> HF-named numpy state dict (unscoped
+    ``embed_tokens/layers.N/norm`` names + ``lm_head`` when untied)."""
+    blocks = {k: np.asarray(v) for k, v in params["blocks"].items()}
+    nh, nkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    sd = {
+        "embed_tokens.weight": np.asarray(params["embed"]),
+        "norm.weight": np.asarray(params["lnf_scale"]),
+    }
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        wqkv = blocks["wqkv"][i]
+        sd[p + "self_attn.q_proj.weight"] = wqkv[:, :nh * hd].T
+        sd[p + "self_attn.k_proj.weight"] = \
+            wqkv[:, nh * hd:(nh + nkv) * hd].T
+        sd[p + "self_attn.v_proj.weight"] = wqkv[:, (nh + nkv) * hd:].T
+        sd[p + "self_attn.o_proj.weight"] = blocks["wo"][i].T
+        sd[p + "input_layernorm.weight"] = blocks["ln1_scale"][i]
+        sd[p + "post_attention_layernorm.weight"] = blocks["ln2_scale"][i]
+        sd[p + "mlp.gate_proj.weight"] = blocks["w1"][i].T
+        sd[p + "mlp.up_proj.weight"] = blocks["w3"][i].T
+        sd[p + "mlp.down_proj.weight"] = blocks["w2"][i].T
+    if not cfg.tied_head:
+        sd["lm_head.weight"] = np.asarray(params["head"]).T
+    return sd
+
+
+def export_to_hf(params, cfg: TransformerConfig, model):
+    """Load params into a live transformers Llama ``model``
+    (LlamaModel or LlamaForCausalLM); bidirectionally validated."""
+    from .hf_common import load_into_hf
+    sd = dict(state_dict_from_params(params, cfg))
+    target = model.state_dict()
+    if cfg.tied_head and any(k.startswith("lm_head.") for k in target):
+        sd["lm_head.weight"] = sd["embed_tokens.weight"]
+    return load_into_hf(
+        sd, model, scope="model.",
+        # rope inv_freq buffers on some transformers versions
+        skip_target=lambda k: "rotary_emb" in k,
+        droppable=("lm_head.",))

@@ -76,6 +76,36 @@ class TransformerConfig:
                                 # vocab projection is embed itself — halves
                                 # embedding memory and keeps fine-tuned
                                 # weights exportable as a tied checkpoint
+    # Llama-family dialect knobs (models/hf_llama.py flips these to load
+    # HF Llama/Mistral-class checkpoints weight-for-weight):
+    norm: str = "layernorm"     # "rmsnorm": x·rsqrt(mean(x²)+eps)·scale,
+                                # no bias/mean-centering (the *_bias params
+                                # exist but are ignored so pytree structure
+                                # is dialect-independent)
+    rope: bool = False          # rotary position embeddings on q/k (the
+                                # cache stores ROTATED keys); replaces the
+                                # learned "pos" table
+    rope_theta: float = 10000.0
+    mlp: str = "gelu"           # "swiglu": down(silu(gate(x))·up(x)) with
+                                # an extra w3 (up) weight, no biases used
+    n_kv_heads: int = 0         # grouped-query attention: 0 = n_heads
+                                # (MHA); otherwise k/v project to n_kv
+                                # heads and broadcast to the q heads
+    use_pos_emb: bool = True    # False: no learned position table (rope
+                                # carries positions)
+
+    def __post_init__(self):
+        if self.mlp == "swiglu" and self.n_experts > 0:
+            raise ValueError(
+                "mlp='swiglu' with n_experts>0: the MoE expert MLP is "
+                "gelu-only — a swiglu config would silently train a "
+                "different architecture than requested")
+
+    @property
+    def kv_heads(self):
+        n = self.n_kv_heads or self.n_heads
+        assert self.n_heads % n == 0
+        return n
 
     @property
     def head_dim(self):
@@ -95,17 +125,20 @@ def init_params(rng, cfg: TransformerConfig):
     def norm(key, shape, scale):
         return (jax.random.normal(key, shape, jnp.float32) * scale)
 
+    qkv_width = (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim
     blocks = {
         "ln1_scale": jnp.ones((L, D), jnp.float32),
         "ln1_bias": jnp.zeros((L, D), jnp.float32),
-        "wqkv": norm(ks[0], (L, D, 3 * D), 0.02),
+        "wqkv": norm(ks[0], (L, D, qkv_width), 0.02),
         "wo": norm(ks[1], (L, D, D), 0.02 / np.sqrt(2 * L)),
         "ln2_scale": jnp.ones((L, D), jnp.float32),
         "ln2_bias": jnp.zeros((L, D), jnp.float32),
     }
     if cfg.attn_proj_bias:
-        blocks["bqkv"] = jnp.zeros((L, 3 * D), jnp.float32)
+        blocks["bqkv"] = jnp.zeros((L, qkv_width), jnp.float32)
         blocks["bo"] = jnp.zeros((L, D), jnp.float32)
+    if cfg.mlp == "swiglu":
+        blocks["w3"] = norm(ks[8], (L, D, F), 0.02)
     if E > 0:
         blocks.update({
             "router": norm(ks[2], (L, D, E), 0.02),
@@ -123,11 +156,12 @@ def init_params(rng, cfg: TransformerConfig):
         })
     params = {
         "embed": norm(ks[5], (V, D), 0.02),
-        "pos": norm(ks[6], (cfg.max_seq_len, D), 0.02),
         "blocks": blocks,
         "lnf_scale": jnp.ones((D,), jnp.float32),
         "lnf_bias": jnp.zeros((D,), jnp.float32),
     }
+    if cfg.use_pos_emb:
+        params["pos"] = norm(ks[6], (cfg.max_seq_len, D), 0.02)
     if not cfg.tied_head:
         params["head"] = norm(ks[7], (D, V), 0.02)
     return params
@@ -148,6 +182,8 @@ def param_specs(cfg: TransformerConfig):
     if cfg.attn_proj_bias:
         blocks["bqkv"] = P(None, "tp")
         blocks["bo"] = P(None, None)
+    if cfg.mlp == "swiglu":
+        blocks["w3"] = P(None, None, "tp")
     if moe:
         blocks.update({
             "router": P(None, None, None),
@@ -165,11 +201,12 @@ def param_specs(cfg: TransformerConfig):
         })
     specs = {
         "embed": P(None, "tp"),
-        "pos": P(None, "tp"),
         "blocks": blocks,
         "lnf_scale": P(None),
         "lnf_bias": P(None),
     }
+    if cfg.use_pos_emb:
+        specs["pos"] = P(None, "tp")
     if not cfg.tied_head:
         specs["head"] = P(None, "tp")
     return specs
@@ -205,6 +242,36 @@ def _gelu(x, cfg: TransformerConfig):
     # tanh approximation (fine for training-from-scratch, wrong for
     # checkpoint-exact parity)
     return jax.nn.gelu(x, approximate=not cfg.gelu_exact)
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype)
+
+
+def _norm(x, scale, bias, cfg: TransformerConfig):
+    """Dialect-dispatched normalization: LayerNorm (default) or RMSNorm
+    (Llama family — ``bias`` exists in the pytree but is ignored)."""
+    if cfg.norm == "rmsnorm":
+        return _rms_norm(x, scale, cfg.ln_eps)
+    return _layer_norm(x, scale, bias, cfg.ln_eps)
+
+
+def _rope(x, pos0, theta):
+    """Rotary position embeddings, HF rotate_half convention: x (B, nh, T,
+    hd) at absolute positions pos0..pos0+T-1; the head dim splits into two
+    halves rotated by position-dependent angles."""
+    B, nh, T, hd = x.shape
+    inv = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    t = pos0 + jnp.arange(T, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # (T, hd/2)
+    cos = jnp.concatenate([jnp.cos(freqs)] * 2, -1)  # (T, hd)
+    sin = jnp.concatenate([jnp.sin(freqs)] * 2, -1)
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :hd // 2], x32[..., hd // 2:]
+    rotated = jnp.concatenate([-x2, x1], -1)
+    return (x32 * cos + rotated * sin).astype(x.dtype)
 
 
 def _is_key_padding_bias(attn_bias):
@@ -301,23 +368,33 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
 def _attention(h, p, cfg: TransformerConfig, mesh, attn_bias=None):
     B, T, D = h.shape
     nh, hd = cfg.n_heads, cfg.head_dim
+    nkv = cfg.kv_heads
     impl = _resolve_attn_impl(cfg, mesh, T, attn_bias)
     qkv = jnp.einsum("btd,de->bte", h, p["wqkv"].astype(h.dtype),
                      preferred_element_type=jnp.float32).astype(h.dtype)
     if cfg.attn_proj_bias:
         qkv = qkv + p["bqkv"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
     q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
     if impl == "ring":
         # k/v stay sequence-sharded: the ring rotates chunks over ICI
-        k = k.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
     else:
         # Ulysses-style: gather k/v over sp, heads stay tp-sharded
         k = _constrain(k, mesh, "dp", None, "tp").reshape(
-            B, T, nh, hd).transpose(0, 2, 1, 3)
+            B, T, nkv, hd).transpose(0, 2, 1, 3)
         v = _constrain(v, mesh, "dp", None, "tp").reshape(
-            B, T, nh, hd).transpose(0, 2, 1, 3)
+            B, T, nkv, hd).transpose(0, 2, 1, 3)
+    if cfg.rope:
+        # rotate BEFORE any gqa broadcast (rope is per-kv-head)
+        q = _rope(q, 0, cfg.rope_theta)
+        k = _rope(k, 0, cfg.rope_theta)
+    if nkv != nh:
+        # grouped-query: broadcast each kv head to its query group; every
+        # attention impl then sees matching head counts
+        k = jnp.repeat(k, nh // nkv, axis=1)
+        v = jnp.repeat(v, nh // nkv, axis=1)
     out = _attention_core(q, k, v, cfg, mesh, impl, attn_bias)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
     out = jnp.einsum("btd,de->bte", out, p["wo"].astype(h.dtype),
@@ -328,6 +405,16 @@ def _attention(h, p, cfg: TransformerConfig, mesh, attn_bias=None):
 
 
 def _dense_mlp(h, p, cfg, mesh):
+    if cfg.mlp == "swiglu":
+        # Llama MLP: down(silu(gate(x)) * up(x)); the b1/b2 params exist
+        # but are zero/unused in this dialect (no biases in the family)
+        gate = jnp.einsum("btd,df->btf", h, p["w1"].astype(h.dtype),
+                          preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", h, p["w3"].astype(h.dtype),
+                        preferred_element_type=jnp.float32)
+        u = (jax.nn.silu(gate) * up).astype(h.dtype)
+        return jnp.einsum("btf,fd->btd", u, p["w2"].astype(h.dtype),
+                          preferred_element_type=jnp.float32).astype(h.dtype)
     u = jnp.einsum("btd,df->btf", h, p["w1"].astype(h.dtype),
                    preferred_element_type=jnp.float32).astype(h.dtype)
     u = _gelu(u + p["b1"].astype(h.dtype), cfg)
@@ -385,19 +472,19 @@ def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None,
     decode silently diverges from training for that config."""
     post = cfg.post_ln
     h = _constrain(h, mesh, "dp", "sp", None)
-    attn_in = h if post else _layer_norm(
-        h, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.ln_eps)
+    attn_in = h if post else _norm(
+        h, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg)
     attn_out = _attention(attn_in, layer_params, cfg, mesh, attn_bias)
     if dropout_rng is not None:
         k1, k2 = jax.random.split(dropout_rng)
         attn_out = _dropout(attn_out, cfg.dropout_rate, k1)
     h = h + attn_out
     if post:
-        h = _layer_norm(h, layer_params["ln1_scale"],
-                        layer_params["ln1_bias"], cfg.ln_eps)
+        h = _norm(h, layer_params["ln1_scale"],
+                  layer_params["ln1_bias"], cfg)
     h = _constrain(h, mesh, "dp", "sp", None)
-    mlp_in = h if post else _layer_norm(
-        h, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.ln_eps)
+    mlp_in = h if post else _norm(
+        h, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg)
     if cfg.n_experts > 0:
         out, aux = _moe_mlp(mlp_in, layer_params, cfg, mesh)
     else:
@@ -406,16 +493,19 @@ def _block(h, layer_params, cfg: TransformerConfig, mesh, attn_bias=None,
         out = _dropout(out, cfg.dropout_rate, k2)
     h = h + out
     if post:
-        h = _layer_norm(h, layer_params["ln2_scale"],
-                        layer_params["ln2_bias"], cfg.ln_eps)
+        h = _norm(h, layer_params["ln2_scale"],
+                  layer_params["ln2_bias"], cfg)
     return h, aux
 
 
 def embed_tokens(params, tokens, cfg: TransformerConfig):
-    """(..., T) int32 -> (..., T, D) embeddings + positions."""
+    """(..., T) int32 -> (..., T, D) embeddings (+ learned positions,
+    unless the dialect carries positions via rope)."""
     T = tokens.shape[-1]
     h = params["embed"][tokens].astype(cfg.dtype)
-    return h + params["pos"][:T].astype(cfg.dtype)
+    if cfg.use_pos_emb:
+        h = h + params["pos"][:T].astype(cfg.dtype)
+    return h
 
 
 def lm_head(params, h, cfg: TransformerConfig):
@@ -424,8 +514,7 @@ def lm_head(params, h, cfg: TransformerConfig):
     so only the projection applies. Tied configs project against the token
     embedding itself (no transposed copy is materialized)."""
     if not cfg.post_ln:
-        h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
-                        cfg.ln_eps)
+        h = _norm(h, params["lnf_scale"], params["lnf_bias"], cfg)
     if cfg.tied_head:
         return jnp.einsum("btd,vd->btv", h, params["embed"].astype(h.dtype),
                           preferred_element_type=jnp.float32)
@@ -491,8 +580,7 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
         h, aux = forward_hidden(params, tokens, cfg, mesh,
                                 dropout_rng=dropout_rng)
         if not cfg.post_ln:
-            h = _layer_norm(h, params["lnf_scale"], params["lnf_bias"],
-                            cfg.ln_eps)
+            h = _norm(h, params["lnf_scale"], params["lnf_bias"], cfg)
         B, T, D = h.shape
         # both weight orientations are kernel-native (no vocab-sized
         # transpose): tied configs stream the (V, D) embedding, untied the
