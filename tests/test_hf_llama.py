@@ -152,6 +152,25 @@ def test_mesh_forward_matches_hf(llama_pair):
                                atol=3e-4, rtol=3e-4)
 
 
+def test_windowless_mistral_imports(llama_pair):
+    """Mistral shares the Llama layout; a windowless config imports and
+    matches the torch forward (the windowed default refuses instead)."""
+    import dataclasses
+    torch.manual_seed(10)
+    model = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=96, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=112, max_position_embeddings=64,
+        rms_norm_eps=1e-6, sliding_window=None)).eval()
+    params, cfg = params_from_hf(model)
+    cfg = dataclasses.replace(cfg, remat=False, attn_impl="dot",
+                              fused_lm_ce=False)
+    ids = np.random.default_rng(11).integers(0, 96, (2, 12))
+    ours, _ = tfm.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(ours), hf_logits(model, ids),
+                               atol=3e-4, rtol=3e-4)
+
+
 def test_import_refuses_mismatched_config(llama_pair):
     model, _, _ = llama_pair
     truncated = config_from_hf(model.config, n_layers=2)
