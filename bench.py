@@ -23,6 +23,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -108,7 +109,7 @@ def _bench_resnet18(batch_size, warmup, iters, dtype):
 
 
 def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None,
-               **cfg_overrides):
+               trace_dir=None, **cfg_overrides):
     """BERT-base MLM+NSP pretrain step (BASELINE.md north star: 'BERT-base
     pretrain (Pallas attention)'). Dense packed batches -> the fused
     bidirectional flash kernel; tokens/s with BOTH the 6ND and the
@@ -168,6 +169,20 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None,
            "attn_impl": impl,
            "mlm_ce": "fused" if fused_ce else "einsum",
            "n_params": n_params}
+
+    # optional profiler trace (trace_dir arg, or HETU_BENCH_TRACE=dir): a
+    # below-target MFU number comes back with its own diagnosis — the
+    # trace shows whether the time went to attention, the MLM head, or
+    # data formatting. Captured AFTER the timed window so tracing
+    # overhead never pollutes the reported step time.
+    trace_dir = trace_dir or os.environ.get("HETU_BENCH_TRACE")
+    if trace_dir:
+        import jax.profiler
+        with jax.profiler.trace(trace_dir):
+            for _ in range(2):
+                loss, _, params, opt = step(params, opt, batch)
+            float(np.asarray(loss))
+        out["trace"] = trace_dir
 
     # masked A/B: padded batches keep the fused kernel via the key-padding
     # bias (before round 4 a mask forced the unfused (B,nh,T,T) path)
@@ -457,9 +472,14 @@ def _run_section(name):
         out = bench_flash_attention(**kw)
     elif name == "bert":
         if smoke:
+            # smoke exercises the trace-capture path too (the real cell
+            # only traces when the driver exports HETU_BENCH_TRACE)
+            tdir = os.environ.get("HETU_BENCH_TRACE") or os.path.join(
+                tempfile.mkdtemp(prefix="hetu_bench_"), "trace")
             out = _with_fused_fallback(
                 lambda **kw: bench_bert(batch_size=2, seq_len=64, warmup=1,
-                                        iters=2, **tiny, **kw),
+                                        iters=2, trace_dir=tdir, **tiny,
+                                        **kw),
                 flag_name="fused_mlm_ce")
         else:
             out = _with_fused_fallback(bench_bert, flag_name="fused_mlm_ce")
@@ -537,6 +557,75 @@ def _section_subprocess(name, timeout):
     return {"error": "no JSON line from section"}
 
 
+def _git_sha():
+    import subprocess
+    try:
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            text=True, stderr=subprocess.DEVNULL).strip()
+    except Exception:  # noqa: BLE001 — not a git checkout / no git
+        return None
+
+
+class _Ledger:
+    """Durable per-cell scoreboard (BENCH_PARTIAL.json).
+
+    Every completed cell is written to disk the moment it finishes, so a
+    tunnel death mid-run (it has happened three rounds straight) loses
+    nothing: the next invocation — self-run or driver-run — reuses the
+    recorded cells and spends its hardware minutes only on the missing
+    ones. The final JSON line merges ledger + fresh, flagging entries
+    recorded at a different git sha as stale. Smoke runs never open a
+    ledger at all (main() passes an empty path): smoke exists to validate
+    the section pipeline, and serving cached cells would defeat that.
+    Reference analogue: PS load recording persists to log_path
+    (/root/reference/python/hetu/gpu_ops/executor.py:292-295); this is the
+    same durability idea applied to the round scoreboard."""
+
+    def __init__(self, path):
+        self.path = path or None
+        self.sha = _git_sha()
+        self.cells = {}
+        if self.path and os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    data = json.load(f)
+                self.cells = data["cells"] if isinstance(data, dict) else {}
+            except (KeyError, ValueError, OSError) as e:
+                print(f"# bench ledger unreadable ({e}); starting fresh",
+                      file=sys.stderr)
+
+    def reuse(self, key):
+        """A reusable entry is a SUCCESS; errors and hangs are always
+        re-attempted. Returns the result dict with an ``_ledger``
+        provenance stamp, or None."""
+        ent = self.cells.get(key)
+        if not isinstance(ent, dict):
+            return None
+        result = ent.get("result")
+        if not isinstance(result, dict) or "error" in result:
+            return None
+        out = dict(result)
+        prov = {"ts": ent.get("ts")}
+        if ent.get("sha") != self.sha:
+            prov["stale"] = f"recorded at {ent.get('sha')}, HEAD is {self.sha}"
+        out["_ledger"] = prov
+        return out
+
+    def record(self, key, result):
+        self.cells[key] = {
+            "result": result, "sha": self.sha,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"cells": self.cells}, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)   # atomic: a kill never corrupts it
+
+
 def _wait_for_backend(budget, detail):
     """Probe-wait loop for a tunnel outage the caller JUST observed (so it
     sleeps before the first probe instead of re-confirming the hang).
@@ -573,8 +662,19 @@ def main():
     # the parent NEVER touches jax: a hung backend must not stall the
     # driver's one-JSON-line contract
     detail = {"assumed_peak_tflops": PEAK_TFLOPS}
-    headline = 0.0
     backend_dead = False
+    # durable scoreboard: HETU_BENCH_LEDGER overrides the path; empty
+    # string disables (the scripted driver tests run ledger-less). Smoke
+    # mode NEVER opens a ledger — a smoke run must execute every section
+    # (that's what it validates), and its toy numbers must never be
+    # served to (or shadow) a real run.
+    lpath = os.environ.get("HETU_BENCH_LEDGER")
+    if lpath is None:
+        lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_PARTIAL.json")
+    if os.environ.get("HETU_BENCH_SMOKE") == "1":
+        lpath = ""
+    ledger = _Ledger(lpath)
     alive_hangs = 0   # consecutive section hangs while probes still answer
     # one shared wait budget for every outage in the run (at-start AND
     # mid-run), so an intermittent tunnel can't stretch the bench unboundedly
@@ -635,6 +735,14 @@ def main():
                 # carries the "started down, came back" signal
             else:
                 detail["_probe"] = out   # crash, not a hang: run sections
+            continue
+        cached = ledger.reuse(key)
+        if cached is not None:
+            # ledger reuse comes BEFORE the dead-backend/backstop skips:
+            # a cell captured by an earlier invocation must survive a run
+            # whose own hardware window is gone
+            detail[key] = cached
+            detail.setdefault("from_ledger", []).append(key)
             continue
         if backend_dead:
             # wait budget exhausted with the tunnel still down
@@ -722,9 +830,15 @@ def main():
             dev = out.pop("_device", None)
             if dev and "device" not in detail:
                 detail["device"] = dev
-            if name.startswith("resnet:") and "samples_per_sec" in out:
-                headline = max(headline, out["samples_per_sec"])
+            ledger.record(key, out)
         detail[key] = out
+
+    # headline over the MERGED detail (fresh + ledger): a resnet cell
+    # captured by a killed earlier invocation still counts
+    headline = 0.0
+    for k, v in detail.items():
+        if k.startswith("resnet18_") and isinstance(v, dict):
+            headline = max(headline, v.get("samples_per_sec") or 0.0)
 
     if headline == 0.0:
         # nothing survived — make it unmistakably a failure, not a
