@@ -5,7 +5,8 @@ re-runs the full forward; it has no incremental decoding. For an LM
 framework that is half the user surface, so this module adds it the TPU
 way: the whole generate loop is ONE ``lax.scan`` over time steps (static
 shapes, no retrace, no host round-trips), each step updating a
-(L, B, nh, max_len, hd) key/value cache via ``dynamic_update_slice`` and
+(L, B, n_kv_heads, max_len, hd) key/value cache via ``dynamic_update_slice``
+(GQA checkpoints keep their kv-cache memory saving at serving time) and
 scanning the layer stack exactly like training does
 (``models/transformer.py`` keeps per-layer params stacked on a leading L
 axis).
@@ -43,7 +44,7 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
     over the cached prefix).
 
     carry: h (B, C, D); layer_inputs: (layer_params, k_cache, v_cache) with
-    caches (B, nh, M, hd); the chunk occupies positions [pos, pos+C).
+    caches (B, nkv, M, hd); the chunk occupies positions [pos, pos+C).
     Returns updated caches alongside the new h.
 
     LOCKSTEP CONTRACT with ``transformer._block``: every architecture
@@ -76,24 +77,25 @@ def _decode_layer(carry, layer_inputs, *, cfg, pos):
         # ROTATED keys (scores are position-relative after rotation)
         q = tfm._rope(q, pos, cfg.rope_theta)
         k = tfm._rope(k, pos, cfg.rope_theta)
-    if nkv != nh:
-        # gqa: the cache stores the BROADCAST heads (trades the kv-cache
-        # memory saving for identical attention math on every path)
-        k = jnp.repeat(k, nh // nkv, axis=1)
-        v = jnp.repeat(v, nh // nkv, axis=1)
+    # gqa: the cache stores the nkv UNBROADCAST heads — the memory saving
+    # is the point of a GQA checkpoint at serving time — and the scores
+    # ride a grouped einsum (g query heads share each kv head); g=1
+    # degenerates to classic MHA with identical math
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
 
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+    g = nh // nkv
+    qg = q.reshape(B, nkv, g, C, hd)
+    scores = jnp.einsum("bngqd,bnkd->bngqk", qg, kc,
                         preferred_element_type=jnp.float32) / np.sqrt(hd)
     # query i (global position pos+i) sees cache entries <= pos+i
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, C, M), 3)
-    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, C, M), 2)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, C, M), 4)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, C, M), 3)
     scores = jnp.where(kpos <= qpos, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vc,
+    ctx = jnp.einsum("bngqk,bnkd->bngqd", probs, vc,
                      preferred_element_type=jnp.float32).astype(h.dtype)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, C, D)
+    ctx = ctx.reshape(B, nh, C, hd).transpose(0, 2, 1, 3).reshape(B, C, D)
     attn_out = jnp.einsum("bod,de->boe", ctx, p["wo"].astype(h.dtype),
                           preferred_element_type=jnp.float32).astype(h.dtype)
     if cfg.attn_proj_bias:
@@ -219,8 +221,15 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
     cache_sharding = None
     if mesh is not None:
         from jax.sharding import NamedSharding
+        # the cache holds the nkv UNBROADCAST heads: shard them over tp
+        # only when they divide evenly (GQA/MQA can have fewer kv heads
+        # than tp shards — replicate the head axis then; batch stays
+        # dp-sharded either way)
+        tp = mesh.shape.get("tp", 1)
+        head_axis = "tp" if cfg.kv_heads % tp == 0 else None
         cache_sharding = NamedSharding(
-            mesh, jax.sharding.PartitionSpec(None, "dp", "tp", None, None))
+            mesh, jax.sharding.PartitionSpec(None, "dp", head_axis,
+                                             None, None))
 
     def gen(params, prompt, key, temperature=1.0, prompt_lens=None):
         B, P = prompt.shape
@@ -232,8 +241,8 @@ def make_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         # no pad token ever reaches the model or the KV cache
         plens = (jnp.full((B,), P, jnp.int32) if prompt_lens is None
                  else jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, P))
-        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
-        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype,
+        L, nkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        kcache = jnp.zeros((L, B, nkv, max_len, hd), cfg.dtype,
                            device=cache_sharding)
         vcache = jnp.zeros_like(kcache)
         padded = jnp.zeros((B, max_len), jnp.int32)
@@ -314,8 +323,8 @@ def make_eos_generate_fn(cfg: tfm.TransformerConfig, max_len: int,
         assert P <= max_len
         plens = (jnp.full((B,), P, jnp.int32) if prompt_lens is None
                  else jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, P))
-        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
-        kcache = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        L, nkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+        kcache = jnp.zeros((L, B, nkv, max_len, hd), cfg.dtype)
         vcache = jnp.zeros_like(kcache)
         padded = jnp.full((B, max_len), eos_id, jnp.int32)
         padded = jax.lax.dynamic_update_slice(padded, prompt, (0, 0))
@@ -389,7 +398,7 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
     def beam(params, prompt):
         B, P = prompt.shape
         assert 1 <= P < max_len, "beam search must generate >= 1 token"
-        L, nh, hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+        L, nkv, hd = cfg.n_layers, cfg.kv_heads, cfg.head_dim
         BK = B * K
         V = cfg.vocab_size
 
@@ -398,7 +407,7 @@ def make_beam_search_fn(cfg: tfm.TransformerConfig, max_len: int,
         # P sequential single-token steps; the head runs on the LAST
         # position only (full-prompt logits would be a (B, P, V) dead
         # buffer) --
-        kc = jnp.zeros((L, B, nh, max_len, hd), cfg.dtype)
+        kc = jnp.zeros((L, B, nkv, max_len, hd), cfg.dtype)
         vc = jnp.zeros_like(kc)
         h, kc, vc = _chunk_hidden(params, cfg, prompt, kc, vc, 0)
         last_logits = tfm.lm_head(params, h[:, P - 1:P], cfg)[:, 0]
@@ -502,9 +511,9 @@ def make_speculative_generate_fn(cfg: tfm.TransformerConfig,
         assert 1 <= P < max_len
 
         def cache(c):
-            L, nh, hd = c.n_layers, c.n_heads, c.head_dim
-            return (jnp.zeros((L, B, nh, M, hd), c.dtype),
-                    jnp.zeros((L, B, nh, M, hd), c.dtype))
+            L, nkv, hd = c.n_layers, c.kv_heads, c.head_dim
+            return (jnp.zeros((L, B, nkv, M, hd), c.dtype),
+                    jnp.zeros((L, B, nkv, M, hd), c.dtype))
 
         kc_t, vc_t = cache(cfg)
         kc_d, vc_d = cache(draft_cfg)

@@ -235,6 +235,29 @@ def test_tp_sharded_decode_matches_single_device():
     assert len({s.device for s in wqkv.addressable_shards}) == 8
 
 
+def test_mqa_sharded_decode_replicates_undivisible_kv_heads():
+    """MQA (1 kv head) under tp=2: the cache stores nkv UNBROADCAST heads,
+    which tp cannot divide — the head axis must fall back to replication
+    (regression guard for the round-5 GQA cache change) while tokens still
+    match the unsharded decode."""
+    from hetu_tpu.parallel.mesh import auto_mesh
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_kv_heads=1, n_layers=2, d_ff=64,
+                                max_seq_len=16, dtype=jnp.float32,
+                                remat=False)
+    mesh = auto_mesh(8, tp=2)
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, cfg.vocab_size, (4, 4)).astype(np.int32)
+
+    ref = gen.generate(params, cfg, prompt, max_len=12)
+    sharded = tfm.shard_params(params, cfg, mesh)
+    fn = gen.make_generate_fn(cfg, max_len=12, mesh=mesh)
+    toks, _ = fn(sharded, jnp.asarray(prompt), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(toks), ref)
+
+
 def test_beam_size_one_equals_greedy():
     params = tfm.init_params(jax.random.PRNGKey(6), CFG)
     rng = np.random.RandomState(3)
