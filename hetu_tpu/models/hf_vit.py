@@ -47,7 +47,10 @@ def params_from_hf(model, cfg: ViTConfig = None):
     checkpoint's architecture (including the classifier head: an
     n_classes that disagrees with the checkpoint's refuses; n_classes=0
     explicitly DROPS the checkpoint's head)."""
-    ckpt_classes = (len(getattr(model.config, "id2label", {}) or {})
+    # num_labels is the authoritative HF field; id2label can be absent or
+    # inconsistent on hand-edited configs
+    ckpt_classes = ((getattr(model.config, "num_labels", 0)
+                     or len(getattr(model.config, "id2label", {}) or {}))
                     if _has_classifier(model) else 0)
     want = config_from_hf(model.config, n_classes=ckpt_classes)
     if cfg is None:

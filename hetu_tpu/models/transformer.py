@@ -117,13 +117,22 @@ class TransformerConfig:
 # parameter init + sharding rules
 # ---------------------------------------------------------------------------
 
-def init_params(rng, cfg: TransformerConfig):
-    L, D, F, V = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.vocab_size
-    E = cfg.n_experts
-    ks = jax.random.split(rng, 12)
+def _init_normal(key, shape, scale):
+    return jax.random.normal(key, shape, jnp.float32) * scale
 
-    def norm(key, shape, scale):
-        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+def init_trunk_params(rng, cfg: TransformerConfig):
+    """The block stack + final norm ONLY — for trunk-reusing families
+    (ViT) that would otherwise materialize a dead embedding/pos/head just
+    to throw them away. ``init_params`` shares the same key schedule, so a
+    trunk initialized here is bit-identical to one sliced out of it."""
+    return _init_trunk(jax.random.split(rng, 12), cfg)
+
+
+def _init_trunk(ks, cfg: TransformerConfig):
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    E = cfg.n_experts
+    norm = _init_normal
 
     qkv_width = (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim
     blocks = {
@@ -154,16 +163,22 @@ def init_params(rng, cfg: TransformerConfig):
             "w2": norm(ks[4], (L, F, D), 0.02 / np.sqrt(2 * L)),
             "b2": jnp.zeros((L, D), jnp.float32),
         })
-    params = {
-        "embed": norm(ks[5], (V, D), 0.02),
+    return {
         "blocks": blocks,
         "lnf_scale": jnp.ones((D,), jnp.float32),
         "lnf_bias": jnp.zeros((D,), jnp.float32),
     }
+
+
+def init_params(rng, cfg: TransformerConfig):
+    D, V = cfg.d_model, cfg.vocab_size
+    ks = jax.random.split(rng, 12)
+    params = _init_trunk(ks, cfg)
+    params["embed"] = _init_normal(ks[5], (V, D), 0.02)
     if cfg.use_pos_emb:
-        params["pos"] = norm(ks[6], (cfg.max_seq_len, D), 0.02)
+        params["pos"] = _init_normal(ks[6], (cfg.max_seq_len, D), 0.02)
     if not cfg.tied_head:
-        params["head"] = norm(ks[7], (D, V), 0.02)
+        params["head"] = _init_normal(ks[7], (D, V), 0.02)
     return params
 
 

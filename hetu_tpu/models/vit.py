@@ -72,7 +72,8 @@ def init_params(rng, cfg: ViTConfig):
     D = cfg.d_model
     pdim = cfg.patch_size * cfg.patch_size * cfg.n_channels
     ks = jax.random.split(rng, 5)
-    trunk = tfm.init_params(ks[0], cfg.trunk())
+    # blocks + final norm only: no dead token-embedding/pos/head tensors
+    trunk = tfm.init_trunk_params(ks[0], cfg.trunk())
     params = {
         "patch_w": jax.random.normal(ks[1], (pdim, D), jnp.float32) * 0.02,
         "patch_b": jnp.zeros((D,), jnp.float32),

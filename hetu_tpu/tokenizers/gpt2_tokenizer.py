@@ -149,7 +149,16 @@ class GPT2Tokenizer:
         return toks
 
     def encode(self, text: str) -> list[int]:
-        return [self.encoder[t] for t in self.tokenize(text)]
+        out = []
+        for t in self.tokenize(text):
+            try:
+                out.append(self.encoder[t])
+            except KeyError:
+                raise ValueError(
+                    f"token {t!r} produced by merges.txt is absent from "
+                    f"vocab.json — the vocab/merges pair is mismatched "
+                    f"(files from different checkpoints?)") from None
+        return out
 
     def decode(self, ids) -> str:
         # byte proxies must be concatenated ACROSS tokens before UTF-8
