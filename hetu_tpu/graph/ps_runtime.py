@@ -177,8 +177,9 @@ class PSRuntime:
         self._prefetched: dict[int, tuple[np.ndarray, Future]] = {}
         self._pending_pushes: list[Future] = []
         self._dense_push_fut: dict[int, Future] = {}
-        self.perf = {"sync_pulls": 0, "prefetch_hits": 0,
-                     "prefetch_misses": 0, "async_pushes": 0}
+        self.perf = {"sync_pulls": 0, "prefetch_issued": 0,
+                     "prefetch_hits": 0, "prefetch_misses": 0,
+                     "async_pushes": 0}
         ps_pkg._register_runtime(self)  # drained at worker_finish
 
     # ------------------------------------------------------------------
@@ -339,6 +340,7 @@ class PSRuntime:
         step's push — staleness bounded by one step, like the reference;
         under BSP the pull stream is the push stream, so ordering is exact."""
         idx = np.array(idx, copy=True)
+        self.perf["prefetch_issued"] += 1
         self._prefetched[key] = (idx, self._io_pull.submit(
             lambda: self._pull_rows(p, idx)))
 
