@@ -261,6 +261,32 @@ def test_transformer_block_roundtrip(tmp_path):
     np.testing.assert_allclose(orig, imported, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("name", ["rnn", "lstm"])
+def test_recurrent_roundtrip(name, tmp_path):
+    """RNN and LSTM (statically unrolled over 28 time steps: per-step
+    slice, fused gate matmuls, sigmoid/tanh, elementwise carries) survive
+    export -> import — the reference's recurrent ONNX capability
+    (/root/reference/tests/onnx/rnn_hetu_onnx_tf.py:1)."""
+    from conftest import import_example_models
+    model = getattr(import_example_models("cnn"), name)
+
+    B = 4
+    xv = RNG.randn(B, 28 * 28).astype(np.float32)
+    yv = np.eye(10, dtype=np.float32)[RNG.randint(0, 10, B)]
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    loss, logits = model(x, y_, 10, dimhidden=24)
+    ex = ht.Executor([logits], ctx=ht.cpu(0))
+    (orig,) = ex.run("default", feed_dict={x: xv, y_: yv},
+                     convert_to_numpy_ret_vals=True)
+
+    path = str(tmp_path / f"{name}.onnx")
+    hetu2onnx.export(ex, [x], [logits], path, input_shapes={x: xv.shape})
+    in_map, outs = onnx2hetu.load(path)
+    (imported,) = _run(outs, {in_map["x"]: xv})
+    np.testing.assert_allclose(orig, imported, rtol=1e-4, atol=1e-5)
+
+
 def test_vit_roundtrip(tmp_path):
     """Full ViT forward (patch conv, [CLS] BroadcastShape concat, MHA
     blocks, LayerNorm, slice head) survives export -> import."""
