@@ -21,6 +21,16 @@ SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "vit", "wdl"]
 
 
+# sections whose cells must carry their own diagnosis fields: a
+# below-target hardware number is only actionable if the cell says which
+# attention/CE path it ran and (bert) where its profiler trace landed
+EXPECTED_KEYS = {
+    "bert": ("attn_impl", "mlm_ce", "trace"),
+    "transformer": ("attn_impl",),
+    "transformer350": ("attn_impl",),
+}
+
+
 @pytest.mark.parametrize("name", SECTIONS)
 def test_section_runs_in_smoke_mode(name, monkeypatch):
     monkeypatch.setenv("HETU_BENCH_SMOKE", "1")
@@ -34,3 +44,9 @@ def test_section_runs_in_smoke_mode(name, monkeypatch):
     assert "error" not in out, out
     # every section's JSON records which device it actually ran on
     assert out.pop("_device", None) is not None
+    for key in EXPECTED_KEYS.get(name, ()):
+        assert key in out, (name, key, out)
+    if name == "bert":
+        # the profiler trace actually landed on disk (the smoke child
+        # created its own tmp dir and reported it)
+        assert os.path.isdir(out["trace"]) and os.listdir(out["trace"]), out

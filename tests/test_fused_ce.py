@@ -31,6 +31,18 @@ def test_forward_matches_reference(n, v, d, bn, bv):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_bert_vocab_30522_forced_path():
+    """The EXACT BERT-base vocab (30522 = 59*512 + 314: ragged against the
+    default 512 vocab block) through the fused kernel — the bench's BERT
+    cell must not discover a padding/tail-mask bug on its one hardware
+    run. Small N/D keep interpret mode fast; the vocab axis is full."""
+    h, w, b, t = _data(np.random.RandomState(1), 8, 30522, 16)
+    out = fused_linear_nll(h, w, b, t, block_n=8, block_v=512)
+    ref = linear_nll_reference(h, w, b, t)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_gradients_match_reference():
     h, w, b, t = _data(np.random.RandomState(1), 48, 200, 24)
     ct = jnp.asarray(np.random.RandomState(2).rand(48), jnp.float32)
