@@ -85,6 +85,16 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
         procs.append(spawn_light_role("scheduler", base))
         procs += [spawn_light_server(i, base, stopfile)
                   for i in range(n_servers)]
+        # fault-injection hook (bench hang-proofing tests): SIGKILL server
+        # <idx> right after spawn, so the caller's RPCs face a cluster
+        # that can never complete registration. The section-subprocess
+        # group-kill is the only thing standing between this and a hung
+        # bench cell — tests/test_bench_driver.py pins that it holds.
+        kill_idx = os.environ.get("HETU_PS_TEST_KILL_SERVER")
+        if kill_idx is not None:
+            victim = procs[1 + int(kill_idx)]
+            victim.kill()
+            victim.wait()
         os.environ.update(base)
         os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
         yield port
