@@ -49,27 +49,17 @@ def pipeline_spec(cfg: tfm.TransformerConfig, pp: int):
     return {**replicated, "blocks": blocks}
 
 
-def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
-                             num_microbatches: int, lr: float = 1e-3,
-                             aux_weight: float = 0.01):
-    """Build the jitted GPipe step.
+def _make_stage_fn(cfg: tfm.TransformerConfig, layers_per_stage: int):
+    """One stage's forward: this device's layers over one microbatch
+    activation — SHARED by the GPipe and 1F1B builders, so 'identical
+    math between schedules' is true by construction, not by keeping two
+    copies in sync.
 
-    tokens/targets: (M, mb, T) — M microbatches. Returns
-    (loss, params, opt_state).
-    """
-    pp = mesh.shape["pp"]
-    M = num_microbatches
-    assert cfg.n_layers % pp == 0
-    layers_per_stage = cfg.n_layers // pp
-    use_dropout = cfg.dropout_rate > 0.0
-
+    ``rng_mb``: this microbatch's dropout key (None when dropout is
+    off). Each layer folds in its GLOBAL index, so key(mb, layer)
+    matches the non-pipelined trunk's grad-accumulation schedule
+    (make_train_step: fold_in(rng, mi) then encode's fold_in(·, li))."""
     def stage_fn(h, stage_blocks, stage, rng_mb):
-        """Run this device's layers over one microbatch activation.
-
-        ``rng_mb``: this microbatch's dropout key (None when dropout is
-        off). Each layer folds in its GLOBAL index, so key(mb, layer)
-        matches the non-pipelined trunk's grad-accumulation schedule
-        (make_train_step: fold_in(rng, mi) then encode's fold_in(·, li))."""
         block = functools.partial(tfm._block, cfg=cfg, mesh=None)
         if cfg.remat:
             block = jax.checkpoint(block)
@@ -83,10 +73,55 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
             h, a = block(h, layer_params, dropout_rng=rng)
             return (h, aux + a), None
 
-        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",),
+                             to="varying")
         (h, aux), _ = jax.lax.scan(
             body, (h, aux0), (stage_blocks, jnp.arange(layers_per_stage)))
         return h, aux
+
+    return stage_fn
+
+
+def _wrap_step(step, cfg: tfm.TransformerConfig, mesh: Mesh, pp: int,
+               use_dropout: bool):
+    """Shared jit wrapper for both schedule builders: identical
+    shardings, donation, and the dropout arity switch — the two steps
+    stay drop-in interchangeable (same input layouts) by construction."""
+    specs = pipeline_spec(cfg, pp)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                          is_leaf=lambda x: isinstance(x, P))
+    opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    data_shard = NamedSharding(mesh, P(None, "dp", None))
+    in_sh = [pshard, opt_shard, data_shard, data_shard]
+    if use_dropout:
+        step_fn = step
+        in_sh.append(NamedSharding(mesh, P()))
+    else:
+        # keep the historical 4-arg signature for deterministic configs
+        step_fn = lambda params, opt_state, tokens, targets: step(  # noqa: E731
+            params, opt_state, tokens, targets)
+    return jax.jit(
+        step_fn,
+        in_shardings=tuple(in_sh),
+        out_shardings=(NamedSharding(mesh, P()), pshard, opt_shard),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                             num_microbatches: int, lr: float = 1e-3,
+                             aux_weight: float = 0.01):
+    """Build the jitted GPipe step.
+
+    tokens/targets: (M, mb, T) — M microbatches. Returns
+    (loss, params, opt_state).
+    """
+    pp = mesh.shape["pp"]
+    M = num_microbatches
+    assert cfg.n_layers % pp == 0
+    layers_per_stage = cfg.n_layers // pp
+    use_dropout = cfg.dropout_rate > 0.0
+    stage_fn = _make_stage_fn(cfg, layers_per_stage)
 
     def fwd_loss(params, tokens, targets, dropout_rng=None):
         """Pipelined forward + loss, manual over pp via shard_map."""
@@ -173,25 +208,11 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
         new_params, new_opt = tfm.adamw_update(params, grads, opt_state, lr=lr)
         return loss, new_params, new_opt
 
-    specs = pipeline_spec(cfg, pp)
-    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                          is_leaf=lambda x: isinstance(x, P))
-    opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
-    data_shard = NamedSharding(mesh, P(None, "dp", None))
-    in_sh = [pshard, opt_shard, data_shard, data_shard]
-    if use_dropout:
-        step_fn = step
-        in_sh.append(NamedSharding(mesh, P()))
-    else:
-        # keep the historical 4-arg signature for deterministic configs
-        step_fn = lambda params, opt_state, tokens, targets: step(  # noqa: E731
-            params, opt_state, tokens, targets)
-    return jax.jit(
-        step_fn,
-        in_shardings=tuple(in_sh),
-        out_shardings=(NamedSharding(mesh, P()), pshard, opt_shard),
-        donate_argnums=(0, 1),
-    )
+    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout)
+    # the raw loss function, for grad-level parity tests against the 1F1B
+    # twin (jax.grad(fwd_loss) is this schedule's exact gradient)
+    jitted.fwd_loss = fwd_loss
+    return jitted
 
 
 def init_pipeline_params(rng, cfg: tfm.TransformerConfig, mesh: Mesh):
@@ -201,3 +222,424 @@ def init_pipeline_params(rng, cfg: tfm.TransformerConfig, mesh: Mesh):
     specs = pipeline_spec(cfg, pp)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) schedule — beyond reference (the reference has only
+# the GPipe all-forwards-then-all-backwards schedule, executor.py:675-746).
+#
+# Same math, different memory law: GPipe's one-scan forward stashes an
+# activation per TICK for the outer jax.grad (peak ~ M + pp - 1 per stage);
+# 1F1B hand-rolls the backward INSIDE the scan, so each stage keeps only a
+# ring of at most ``pp`` stashed stage-INPUT activations and recomputes its
+# block forward in the per-microbatch vjp (remat at stage granularity).
+# Peak activation memory per stage drops from O(M) to O(pp) — the enabler
+# for large microbatch counts, where GPipe's stash is the OOM.
+# ---------------------------------------------------------------------------
+
+def simulate_1f1b_schedule(pp: int, num_microbatches: int):
+    """Greedy dependency-driven 1F1B schedule table (host-side, static).
+
+    Stage ``s`` executes the op string F*w + (FB)*(M-w) + B*w with
+    w = min(pp-1-s, M); an op fires at tick t only when its dependency
+    (producer's op at an EARLIER tick — activations/grads move one hop
+    per tick) is met, at most one op per stage per tick. Returns
+    ``table``: list over ticks of per-stage entries ``None | ("F", m) |
+    ("B", m)``. The table is baked into the jitted step as constant
+    arrays, so the runtime program is lockstep-static."""
+    M = num_microbatches
+    w = [min(pp - 1 - s, M) for s in range(pp)]
+    ops = []
+    for s in range(pp):
+        seq = ["F"] * w[s]
+        for _ in range(M - w[s]):
+            seq += ["F", "B"]
+        seq += ["B"] * w[s]
+        ops.append(seq)
+    head = [0] * pp
+    next_f = [0] * pp
+    next_b = [0] * pp
+    fwd_done = [[None] * M for _ in range(pp)]
+    bwd_done = [[None] * M for _ in range(pp)]
+    table = []
+    t = 0
+    while any(head[s] < len(ops[s]) for s in range(pp)):
+        row = [None] * pp
+        for s in range(pp):
+            if head[s] >= len(ops[s]):
+                continue
+            op = ops[s][head[s]]
+            if op == "F":
+                m = next_f[s]
+                ready = s == 0 or (fwd_done[s - 1][m] is not None
+                                   and fwd_done[s - 1][m] < t)
+                # backpressure (single-slot receive buffer): don't compute
+                # F(m) until the downstream stage has consumed F(m-1) —
+                # the send would overwrite its one recv slot
+                if ready and s < pp - 1 and m > 0:
+                    ready = (fwd_done[s + 1][m - 1] is not None
+                             and fwd_done[s + 1][m - 1] <= t)
+            else:
+                m = next_b[s]
+                if s == pp - 1:
+                    ready = (fwd_done[s][m] is not None
+                             and fwd_done[s][m] < t)
+                else:
+                    ready = (bwd_done[s + 1][m] is not None
+                             and bwd_done[s + 1][m] < t)
+                if ready and s > 0 and m > 0:
+                    ready = (bwd_done[s - 1][m - 1] is not None
+                             and bwd_done[s - 1][m - 1] <= t)
+            if ready:
+                row[s] = (op, m)
+        fired = False
+        for s in range(pp):
+            if row[s] is not None:
+                kind, m = row[s]
+                head[s] += 1
+                fired = True
+                if kind == "F":
+                    fwd_done[s][m] = t
+                    next_f[s] += 1
+                else:
+                    bwd_done[s][m] = t
+                    next_b[s] += 1
+        assert fired, f"1F1B schedule deadlock at tick {t} (pp={pp}, M={M})"
+        table.append(row)
+        t += 1
+    return table
+
+
+def schedule_stats(pp: int, num_microbatches: int) -> dict:
+    """Per-stage bubble accounting for both schedules (printed by the
+    dryrun; the numbers a pipeline tuning session starts from).
+
+    - gpipe: one fwd wave of M+pp-1 ticks and its autodiff mirror; every
+      stage is busy M of each wave -> bubble = (pp-1)/(M+pp-1). Peak
+      activation stash per stage ~ one per TICK (the scan saves its
+      carry for the outer grad): M + pp - 1.
+    - 1f1b: measured on the simulated table; peak stash is the ring
+      high-water mark of in-flight (forwarded, not-yet-backproped)
+      microbatches — bounded by pp by construction."""
+    M = num_microbatches
+    table = simulate_1f1b_schedule(pp, M)
+    n_ticks = len(table)
+    busy = [sum(1 for row in table if row[s] is not None) for s in range(pp)]
+    inflight = [0] * pp
+    peak = [0] * pp
+    for row in table:
+        for s in range(pp):
+            if row[s] is not None:
+                kind, _ = row[s]
+                inflight[s] += 1 if kind == "F" else -1
+                peak[s] = max(peak[s], inflight[s])
+    g_ticks = M + pp - 1
+    return {
+        "gpipe": {"ticks_per_wave": g_ticks,
+                  "bubble_fraction": round((pp - 1) / g_ticks, 4),
+                  "peak_act_stash_per_stage": g_ticks},
+        "1f1b": {"ticks": n_ticks,
+                 "per_stage_busy": busy,
+                 "bubble_fraction": round(1.0 - sum(busy) / (pp * n_ticks),
+                                          4),
+                 "peak_act_stash_per_stage": max(peak)},
+    }
+
+
+def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
+                                  num_microbatches: int, lr: float = 1e-3,
+                                  aux_weight: float = 0.01):
+    """1F1B twin of ``make_pipeline_train_step`` — identical signature,
+    identical math (bit-matching dropout keys per (microbatch, layer)),
+    different memory law (see module section comment).
+
+    Mechanics: one ``lax.scan`` over the simulated schedule's ticks inside
+    a ``shard_map`` manual over ``pp``. Each tick, each stage runs its
+    scheduled micro-op behind ``lax.cond`` (real branches — an idle stage
+    burns no FLOPs), then activations hop forward and gradients hop
+    backward via two unconditional ``ppermute``s. The backward micro-op
+    re-runs the stage forward from the stashed stage INPUT under
+    ``jax.vjp`` (stage-granular remat) — the last stage differentiates
+    through the head+NLL with cotangent 1/M, others seed with the grad
+    received from downstream."""
+    pp = mesh.shape["pp"]
+    M = num_microbatches
+    assert cfg.n_layers % pp == 0
+    layers_per_stage = cfg.n_layers // pp
+    use_dropout = cfg.dropout_rate > 0.0
+    # Micro-op gating has two lowerings. On a pure dp x pp mesh the
+    # micro-ops sit behind lax.cond — an idle tick costs nothing. With
+    # model axes (tp/sp/ep) in play, GSPMD inserts collectives INSIDE the
+    # branches (e.g. tp all-reduces of the Megatron matmuls); stages
+    # diverge on the predicate, the tp group's peers wait forever, and
+    # the program deadlocks (observed on the CPU backend) — so those
+    # meshes run the masked lowering: every device computes every tick
+    # and the schedule selects effects. Same math, no divergent
+    # collectives, idle ticks cost FLOPs.
+    use_cond = (mesh.shape.get("tp", 1) * mesh.shape.get("sp", 1)
+                * mesh.shape.get("ep", 1)) == 1
+
+    table = simulate_1f1b_schedule(pp, M)
+    n_ticks = len(table)
+    is_f = np.zeros((n_ticks, pp), np.bool_)
+    f_mb = np.zeros((n_ticks, pp), np.int32)
+    is_b = np.zeros((n_ticks, pp), np.bool_)
+    b_mb = np.zeros((n_ticks, pp), np.int32)
+    for t, row in enumerate(table):
+        for s, ent in enumerate(row):
+            if ent is None:
+                continue
+            kind, m = ent
+            if kind == "F":
+                is_f[t, s], f_mb[t, s] = True, m
+            else:
+                is_b[t, s], b_mb[t, s] = True, m
+
+    stage_fn = _make_stage_fn(cfg, layers_per_stage)
+
+    def fwd_bwd(params, tokens, targets, dropout_rng=None):
+        """Fused pipelined forward+backward: returns (loss, grads)."""
+        stage_blocks = params["blocks"]
+        other = {k: v for k, v in params.items() if k != "blocks"}
+        B, T = tokens.shape[1], tokens.shape[2]
+
+        tis_f, tf_mb = jnp.asarray(is_f), jnp.asarray(f_mb)
+        tis_b, tb_mb = jnp.asarray(is_b), jnp.asarray(b_mb)
+
+        def pipelined(stage_blocks, other, tokens, targets, dropout_rng=None):
+            stage = jax.lax.axis_index("pp")
+            local_blocks = jax.tree.map(lambda x: x[0], stage_blocks)
+            perm_f = [(i, (i + 1) % pp) for i in range(pp)]
+            perm_b = [(i, (i - 1) % pp) for i in range(pp)]
+            varying = lambda x: jax.lax.pcast(x, ("pp",), to="varying")
+
+            zero_act = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
+            carry0 = (
+                varying(jnp.zeros((pp, B, T, cfg.d_model), cfg.dtype)),
+                varying(zero_act),                       # recv_f
+                varying(zero_act),                       # recv_b
+                # zeros_like(local_blocks) is born varying (sliced from the
+                # pp-sharded input); zeros_like(other) is born invariant
+                jax.tree.map(jnp.zeros_like, local_blocks),   # g_blocks
+                jax.tree.map(lambda x: varying(jnp.zeros_like(x)), other),
+                varying(jnp.zeros((), jnp.float32)),     # loss_sum
+                varying(jnp.zeros((), jnp.float32)),     # aux_sum
+            )
+
+            def mb_rng(m):
+                return (None if dropout_rng is None
+                        else jax.random.fold_in(dropout_rng, m))
+
+            def tick(carry, t):
+                act_buf, recv_f, recv_b, g_blocks, g_other, loss_sum, \
+                    aux_sum = carry
+                isf = jax.lax.dynamic_index_in_dim(
+                    jax.lax.dynamic_index_in_dim(tis_f, t, 0, False),
+                    stage, 0, False)
+                fm = jax.lax.dynamic_index_in_dim(
+                    jax.lax.dynamic_index_in_dim(tf_mb, t, 0, False),
+                    stage, 0, False)
+                isb = jax.lax.dynamic_index_in_dim(
+                    jax.lax.dynamic_index_in_dim(tis_b, t, 0, False),
+                    stage, 0, False)
+                bm = jax.lax.dynamic_index_in_dim(
+                    jax.lax.dynamic_index_in_dim(tb_mb, t, 0, False),
+                    stage, 0, False)
+
+                # ---- forward micro-op -------------------------------
+                def do_fwd(act_buf, recv_f, aux_sum):
+                    tok_m = jax.lax.dynamic_index_in_dim(tokens, fm, 0,
+                                                         False)
+                    h0 = tfm.embed_tokens(other, tok_m, cfg)
+                    h_in = jnp.where(stage == 0, h0, recv_f)
+                    h_out, aux = stage_fn(h_in, local_blocks, stage,
+                                          mb_rng(fm))
+                    act_buf = jax.lax.dynamic_update_index_in_dim(
+                        act_buf, h_in, fm % pp, 0)
+                    return act_buf, h_out, aux_sum + aux
+
+                if use_cond:
+                    # real branch: idle ticks are free
+                    act_buf, send_f, aux_sum = jax.lax.cond(
+                        isf, do_fwd,
+                        lambda ab, rf, ax: (ab, jnp.zeros_like(rf), ax),
+                        act_buf, recv_f, aux_sum)
+                else:
+                    # masked: compute unconditionally, select the effect
+                    nb, h_out, na = do_fwd(act_buf, recv_f, aux_sum)
+                    act_buf = jnp.where(isf, nb, act_buf)
+                    send_f = jnp.where(isf, h_out, jnp.zeros_like(h_out))
+                    aux_sum = jnp.where(isf, na, aux_sum)
+
+                # ---- backward micro-op (stage-granular remat vjp) ------
+                # shared preamble: cheap ring/table reads and the ONE
+                # function both lowerings differentiate — defined once so
+                # the cond and masked paths cannot drift apart.
+                # ``other_v``: differentiate wrt a VARYING copy of the
+                # replicated params — the vjp of an invariant input would
+                # insert a psum (a collective inside a cond branch, where
+                # idle stages never arrive -> deadlock). The per-stage
+                # partial grads are psum'd once, outside the scan.
+                h_in_b = jax.lax.dynamic_index_in_dim(act_buf, bm % pp, 0,
+                                                      False)
+                tgt_m = jax.lax.dynamic_index_in_dim(targets, bm, 0, False)
+                tok_b = jax.lax.dynamic_index_in_dim(tokens, bm, 0, False)
+                rng_b = mb_rng(bm)
+                is_last = stage == pp - 1
+                other_v = jax.tree.map(varying, other)
+
+                def through_head(blocks_, other_, h_):
+                    h2, aux2 = stage_fn(h_, blocks_, stage, rng_b)
+                    nll = tfm.nll_loss(tfm.lm_head(other_, h2, cfg), tgt_m)
+                    return h2, aux2, nll
+
+                def embed_grads(dh):
+                    """d(embed output)/d(other) applied to dh — stage 0's
+                    dh is the grad of the embedding output."""
+                    _, evjp = jax.vjp(
+                        lambda o: tfm.embed_tokens(o, tok_b, cfg), other_v)
+                    (de,) = evjp(dh)
+                    return de
+
+                def do_bwd(g_blocks, g_other, recv_b, loss_sum):
+                    def mid_only(blocks_, other_, h_):
+                        h2, aux2 = stage_fn(h_, blocks_, stage, rng_b)
+                        # varying like through_head's nll, so both cond
+                        # branches type-match and take the same cotangent
+                        return h2, aux2, varying(jnp.zeros((), jnp.float32))
+
+                    def run_vjp(fn, ct_h2, ct_nll):
+                        (h2, aux2, nll), vjp = jax.vjp(fn, local_blocks,
+                                                       other_v, h_in_b)
+                        # cotangents must carry the same varying-over-pp
+                        # vma type as the outputs they correspond to
+                        db, dother, dh = vjp(
+                            (ct_h2,
+                             varying(jnp.full((), aux_weight / M,
+                                              jnp.float32)),
+                             varying(ct_nll)))
+                        return db, dother, dh, nll
+
+                    db, dother, dh, nll = jax.lax.cond(
+                        is_last,
+                        lambda: run_vjp(through_head,
+                                        jnp.zeros_like(recv_b),
+                                        jnp.full((), 1.0 / M, jnp.float32)),
+                        lambda: run_vjp(mid_only, recv_b,
+                                        jnp.zeros((), jnp.float32)))
+                    dother = jax.lax.cond(
+                        stage == 0,
+                        lambda d: jax.tree.map(jnp.add, d, embed_grads(dh)),
+                        lambda d: d, dother)
+                    g_blocks = jax.tree.map(jnp.add, g_blocks, db)
+                    g_other = jax.tree.map(jnp.add, g_other, dother)
+                    loss_sum = loss_sum + jnp.where(is_last, nll / M, 0.0)
+                    send_b = jnp.where(stage == 0, jnp.zeros_like(dh), dh)
+                    return g_blocks, g_other, send_b, loss_sum
+
+                def do_bwd_masked(g_blocks, g_other, recv_b, loss_sum):
+                    """Branch-free twin of do_bwd: ONE vjp through the
+                    head for every stage with where-selected cotangents
+                    (vjp is linear in cotangents, so ct_nll=0 makes the
+                    head contribution exactly zero for middle stages),
+                    embedding vjp always computed, all effects masked by
+                    isb/stage. Costs head FLOPs on every stage but keeps
+                    every GSPMD-inserted tp/dp collective on every
+                    device's path."""
+                    (h2, aux2, nll), vjp = jax.vjp(through_head,
+                                                   local_blocks, other_v,
+                                                   h_in_b)
+                    ct_h2 = jnp.where(is_last, jnp.zeros_like(recv_b),
+                                      recv_b)
+                    # already varying: is_last derives from axis_index
+                    ct_nll = jnp.where(is_last, 1.0 / M,
+                                       0.0).astype(jnp.float32)
+                    db, dother, dh = vjp(
+                        (ct_h2,
+                         varying(jnp.full((), aux_weight / M, jnp.float32)),
+                         ct_nll))
+                    de = embed_grads(dh)
+                    dother = jax.tree.map(
+                        lambda a, e: a + jnp.where(stage == 0, e,
+                                                   jnp.zeros_like(e)),
+                        dother, de)
+                    g_blocks = jax.tree.map(
+                        lambda g, d: g + jnp.where(isb, d,
+                                                   jnp.zeros_like(d)),
+                        g_blocks, db)
+                    g_other = jax.tree.map(
+                        lambda g, d: g + jnp.where(isb, d,
+                                                   jnp.zeros_like(d)),
+                        g_other, dother)
+                    loss_sum = loss_sum + jnp.where(isb & is_last,
+                                                    nll / M, 0.0)
+                    send_b = jnp.where(isb & (stage > 0), dh,
+                                       jnp.zeros_like(dh))
+                    return g_blocks, g_other, send_b, loss_sum
+
+                if use_cond:
+                    g_blocks, g_other, send_b, loss_sum = jax.lax.cond(
+                        isb, do_bwd,
+                        lambda gb, go, rb, ls: (gb, go, jnp.zeros_like(rb),
+                                                ls),
+                        g_blocks, g_other, recv_b, loss_sum)
+                else:
+                    g_blocks, g_other, send_b, loss_sum = do_bwd_masked(
+                        g_blocks, g_other, recv_b, loss_sum)
+
+                # ---- unconditional hops (collectives stay out of conds).
+                # Receives are STICKY: a hop only replaces the buffer when
+                # the sender actually sent this tick (flag rides along),
+                # so an idle sender's zeros can't clobber an activation the
+                # receiver consumes on a later tick. The schedule's
+                # backpressure rule guarantees one slot suffices.
+                sent_f = jnp.where(isf & (stage < pp - 1), 1.0, 0.0)
+                sent_b = jnp.where(isb & (stage > 0), 1.0, 0.0)
+                got_f = jax.lax.ppermute(sent_f, "pp", perm_f)
+                got_b = jax.lax.ppermute(sent_b, "pp", perm_b)
+                new_f = jax.lax.ppermute(send_f, "pp", perm_f)
+                new_b = jax.lax.ppermute(send_b, "pp", perm_b)
+                recv_f = jnp.where(got_f > 0, new_f, recv_f)
+                recv_b = jnp.where(got_b > 0, new_b, recv_b)
+                return (act_buf, recv_f, recv_b, g_blocks, g_other,
+                        loss_sum, aux_sum), None
+
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+            _, _, _, g_blocks, g_other, loss_sum, aux_sum = carry
+            loss = jax.lax.psum(loss_sum, "pp")        # lives on last stage
+            aux = jax.lax.psum(aux_sum, "pp") / M
+            g_other = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), g_other)
+            g_blocks = jax.tree.map(lambda g: g[None], g_blocks)
+            return loss + aux_weight * aux, g_blocks, g_other
+
+        block_in_spec = jax.tree.map(lambda _: P("pp"), stage_blocks)
+        other_spec = jax.tree.map(lambda _: P(), other)
+        in_specs = [block_in_spec, other_spec, P(), P()]
+        args = [stage_blocks, other, tokens, targets]
+        if dropout_rng is not None:
+            in_specs.append(P())
+            args.append(dropout_rng)
+        loss, g_blocks, g_other = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(P(), block_in_spec, other_spec),
+            axis_names=frozenset({"pp"}),
+        )(*args)
+        return loss, {**g_other, "blocks": g_blocks}
+
+    def step(params, opt_state, tokens, targets, dropout_rng=None):
+        if use_dropout:
+            assert dropout_rng is not None, (
+                "cfg.dropout_rate > 0: pass dropout_rng to the pipeline step")
+        loss, grads = fwd_bwd(params, tokens, targets,
+                              dropout_rng=dropout_rng)
+        new_params, new_opt = tfm.adamw_update(params, grads, opt_state,
+                                               lr=lr)
+        return loss, new_params, new_opt
+
+    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout)
+    # the hand-rolled (loss, grads) function, for grad-level parity tests
+    # against jax.grad of the GPipe twin's fwd_loss
+    jitted.fwd_bwd = fwd_bwd
+    return jitted
