@@ -396,6 +396,50 @@ def bench_vit(batch=64, warmup=3, iters=15, **cfg_overrides):
             "n_params": n_params}
 
 
+def bench_pipeline_ab(d_model=512, n_layers=8, d_ff=2048, vocab_size=8192,
+                      seq=256, mb=4, microbatches=16, pp=4):
+    """GPipe vs 1F1B on a pp4/dp2 virtual mesh: per-stage bubble
+    accounting (host schedule table) and AOT-compiled per-device memory
+    (the 1F1B selling point: activation stash O(pp) instead of O(M)).
+    No wall-clock — a CPU mesh says nothing about ICI timing; memory and
+    schedule structure are backend-independent."""
+    import jax
+    from hetu_tpu.models import transformer as tfm
+    from hetu_tpu.parallel import mesh as meshlib
+    from hetu_tpu.parallel import pipeline as pplib
+    from hetu_tpu.utils import ensure_devices
+
+    ensure_devices(8)
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab_size, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=seq,
+        dtype=jax.numpy.float32, remat=False)
+    mesh = meshlib.make_mesh(dp=8 // pp, pp=pp,
+                             devices=jax.devices()[:8])
+    M = microbatches
+    p_sds = jax.eval_shape(
+        lambda: pplib.init_pipeline_params(jax.random.PRNGKey(0), cfg, mesh))
+    o_sds = jax.eval_shape(tfm.init_opt_state, p_sds)
+    tok = jax.ShapeDtypeStruct((M, mb, seq), jax.numpy.int32)
+
+    out = {"config": {"d_model": d_model, "n_layers": n_layers, "pp": pp,
+                      "microbatches": M, "seq": seq, "mb": mb},
+           "schedule": pplib.schedule_stats(pp, M)}
+    for label, make in (("gpipe", pplib.make_pipeline_train_step),
+                        ("1f1b", pplib.make_pipeline_train_step_1f1b)):
+        step = make(cfg, mesh, num_microbatches=M, lr=1e-3)
+        ma = step.lower(p_sds, o_sds, tok, tok).compile().memory_analysis()
+        peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        out[label] = {
+            "per_device_mib": round(peak / 2**20, 1),
+            "temp_mib": round(ma.temp_size_in_bytes / 2**20, 1),
+        }
+    out["temp_ratio_gpipe_over_1f1b"] = round(
+        out["gpipe"]["temp_mib"] / max(out["1f1b"]["temp_mib"], 0.1), 2)
+    return out
+
+
 def _with_fused_fallback(fn, flag_name="fused_lm_ce"):
     """The fused-CE kernel's compiled (non-interpret) path first executes
     on the DRIVER's chip — if Mosaic rejects it there, retry the cell with
@@ -488,6 +532,14 @@ def _run_section(name):
                    d_model=64, n_heads=4, n_layers=2, d_ff=128,
                    n_classes=10) if smoke else {})
         out = bench_vit(**kw)
+    elif name == "pipeline":
+        # GPipe vs 1F1B A/B on an 8-device VIRTUAL CPU mesh (this cell
+        # measures the schedules' memory law and bubble accounting, which
+        # need pp>1 — the bench host has one chip; _run_section pins the
+        # child to the CPU backend for exactly this section)
+        out = bench_pipeline_ab(**(dict(d_model=64, n_layers=4, d_ff=128,
+                                        vocab_size=512, seq=32, mb=2,
+                                        microbatches=4) if smoke else {}))
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -503,6 +555,16 @@ def _run_section(name):
     import jax
     out["_device"] = str(jax.devices()[0].device_kind)
     print(json.dumps(out))
+
+
+# sections that must run on the virtual CPU mesh regardless of the host's
+# backend: the pipeline A/B needs 8 devices (pp>1), which the 1-chip bench
+# host cannot provide. PYTHONPATH is blanked so the image's sitecustomize
+# cannot re-pin the axon backend; bench.py's cwd keeps the repo importable.
+SECTION_ENV = {
+    "pipeline": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+}
 
 
 def _section_subprocess(name, timeout):
@@ -521,6 +583,7 @@ def _section_subprocess(name, timeout):
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.expanduser("~/.cache/hetu_tpu_xla_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    env.update(SECTION_ENV.get(name, {}))
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
                             cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -704,6 +767,7 @@ def main():
                      ("decode_38M_greedy", "decode", 420),
                      ("flash_attention_seq4096", "flash4k", 420),
                      ("vit_base_finetune", "vit", 600),
+                     ("pipeline_gpipe_vs_1f1b", "pipeline", 600),
                      ("wdl_criteo_hybrid_ps", "wdl", 600)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent
