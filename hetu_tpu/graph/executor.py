@@ -535,7 +535,11 @@ class SubExecutor:
             ps_grads = tuple(tc.ps_grad_outputs[id(op)] for op in ps_comm_ops)
             return outputs, new_params, new_slots, new_opstate, ps_grads
 
-        donate = (0, 1, 2) if training else ()
+        # HETU_NO_DONATE=1: bisect knob for the bench wedge harness
+        # (tools/wedge_bisect.py) — donation changes XLA's buffer
+        # assignment, one of the suspects for the bf16 bs>=256 hang
+        donate = ((0, 1, 2) if training
+                  and os.environ.get("HETU_NO_DONATE") != "1" else ())
         return jax.jit(step_fn, donate_argnums=donate)
 
     def profile_summary(self):

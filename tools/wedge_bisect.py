@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Sacrificial-window bisect for the bf16 bs>=256 backend wedge.
+
+In two separate hardware sessions (2026-07-30/31) the resnet18 bf16
+bs256/bs512 bench cells hung AND left the tunneled TPU unresponsive for
+hours, while bf16 bs128 and f32 bs128/256 ran green around them. This
+tool spends a DELIBERATELY sacrificial window reproducing and bisecting
+that wedge so the bench can either re-enable the cells or delete them
+with a post-mortem (round-5 directive #1).
+
+Protocol — escalating risk, one experiment per killed process group, a
+probe after every step, stop-and-wait on any wedge:
+
+  1. probe                       - is the backend up at all
+  2. resnet bf16 bs192           - the midpoint: does the wedge start
+                                   between 128 and 256?
+  3. resnet bf16 bs256 no-donate - HETU_NO_DONATE=1: donation changes
+                                   XLA buffer assignment (suspect #1)
+  4. twin bf16 bs512             - raw-JAX resnet twin: same shapes, no
+                                   define-then-run executor -> splits
+                                   framework-trace vs XLA/backend fault
+  5. resnet bf16 bs256 COLD      - the reproducer with a FRESH compile
+                                   cache: a wedge here is compile-or-
+                                   execute (ambiguous alone)
+  6. resnet bf16 bs256 WARM      - same cell again against the persistent
+                                   cache 5 populated: green-after-cold-
+                                   wedge => the wedge is COMPILE; a wedge
+                                   with a warm cache => EXECUTE
+  7. resnet bf16 bs512 WARM-able - the second risky cell, same split
+
+Every result lands in WEDGE_BISECT.json as it happens (ledger-style: a
+tunnel death mid-bisect loses nothing). Run on the bench host when the
+tunnel is healthy:  python tools/wedge_bisect.py [--quick]
+
+The matching "done" criterion: either the risky cells run green here
+(re-enable them in bench.py), or this file's JSON names the guilty stage
+(compile vs execute, donation, framework vs raw-XLA) and the cells get
+deleted with docs/WEDGE_POSTMORTEM.md citing it.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+REPORT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "WEDGE_BISECT.json")
+
+
+def record(report, key, result):
+    report[key] = result
+    tmp = REPORT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, REPORT)
+    status = "WEDGE" if result.get("hang") else (
+        "error" if "error" in result else "green")
+    print(f"[{time.strftime('%H:%M:%S')}] {key}: {status} "
+          f"{result.get('error', '')[:120]}", flush=True)
+
+
+def wait_for_backend(report, budget_s=3600):
+    t0 = time.time()
+    while time.time() - t0 < budget_s:
+        time.sleep(240)
+        probe = bench._section_subprocess("probe", 180)
+        if "error" not in probe:
+            record(report, f"recovery_probe_{int(time.time() - t0)}s",
+                   {"ok": True})
+            return True
+    return False
+
+
+def experiment(report, key, name, timeout, env=None, budget_s=3600):
+    """One killed-process-group experiment + post-probe; on a wedge,
+    wait out the recovery before letting the next experiment run."""
+    saved = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        out = bench._section_subprocess(name, timeout)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    record(report, key, out)
+    probe = bench._section_subprocess("probe", 180)
+    record(report, key + "_postprobe", probe)
+    if probe.get("hang"):
+        print(f"# backend wedged by {key}; waiting for recovery "
+              f"(budget {budget_s}s)", flush=True)
+        if not wait_for_backend(report, budget_s):
+            record(report, "aborted", {"error": f"backend never recovered "
+                                                f"after {key}"})
+            return False
+    return True
+
+
+def main():
+    quick = "--quick" in sys.argv
+    report = {"started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+              "host_note": "sacrificial window; see tools/wedge_bisect.py"}
+    if os.path.exists(REPORT):
+        with open(REPORT) as f:
+            report.update(json.load(f))
+
+    probe = bench._section_subprocess("probe", 180)
+    record(report, "initial_probe", probe)
+    if "error" in probe:
+        print("backend down at start; nothing to bisect", flush=True)
+        return 1
+
+    fresh_cache = tempfile.mkdtemp(prefix="hetu_wedge_cache_")
+    try:
+        steps = [
+            ("bf16_bs192", "resnet:192:bf16", 420, None),
+            ("bf16_bs256_no_donate", "resnet:256:bf16", 600,
+             {"HETU_NO_DONATE": "1"}),
+            ("twin_bf16_bs512", "twin", 600, None),
+            ("bf16_bs256_cold_cache", "resnet:256:bf16", 900,
+             {"JAX_COMPILATION_CACHE_DIR": fresh_cache}),
+            ("bf16_bs256_warm_cache", "resnet:256:bf16", 600,
+             {"JAX_COMPILATION_CACHE_DIR": fresh_cache}),
+        ]
+        if not quick:
+            steps.append(("bf16_bs512_warm_cache", "resnet:512:bf16", 900,
+                          {"JAX_COMPILATION_CACHE_DIR": fresh_cache}))
+        for key, name, timeout, env in steps:
+            if key in report and "error" not in report[key]:
+                print(f"skip {key}: already green in {REPORT}", flush=True)
+                continue
+            if not experiment(report, key, name, timeout, env):
+                return 2
+    finally:
+        shutil.rmtree(fresh_cache, ignore_errors=True)
+
+    # verdict synthesis
+    cold = report.get("bf16_bs256_cold_cache", {})
+    warm = report.get("bf16_bs256_warm_cache", {})
+    if cold.get("hang") and not warm.get("hang") and "error" not in warm:
+        verdict = ("COMPILE-side wedge: cold-cache run hung, warm-cache "
+                   "run green — the server-side compile is the fault")
+    elif warm.get("hang"):
+        verdict = ("EXECUTE-side wedge: the cell hangs even with a warm "
+                   "compile cache")
+    elif "error" not in cold and "error" not in warm:
+        verdict = ("no wedge reproduced this window — re-enable the "
+                   "risky cells (remove them from bench.py's `risky` "
+                   "set) and watch the next driver run")
+    else:
+        verdict = "inconclusive — see per-experiment entries"
+    record(report, "verdict", {"text": verdict})
+    print(f"\nVERDICT: {verdict}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
