@@ -656,16 +656,31 @@ def zero1_opt_specs(cfg: TransformerConfig, mesh: Mesh):
     specs = param_specs(cfg)
     shapes = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg))
+    return jax.tree.map(
+        lambda s, sh: shard_first_free_dim(s, sh, dp), specs, shapes,
+        is_leaf=lambda x: isinstance(x, P))
 
-    def shard_first_free(spec, shape):
-        parts = tuple(spec) + (None,) * (len(shape.shape) - len(tuple(spec)))
-        for ax, part in enumerate(parts):
-            if part is None and shape.shape[ax] % dp == 0:
-                return P(*parts[:ax], "dp", *parts[ax + 1:])
-        return P(*parts)
 
-    return jax.tree.map(shard_first_free, specs, shapes,
-                        is_leaf=lambda x: isinstance(x, P))
+def shard_first_free_dim(spec, shape, dp: int):
+    """Add 'dp' to a PartitionSpec on the first unsharded, dp-divisible
+    dimension (the ZeRO-1 slot layout rule — shared with the pipeline
+    builders, whose block specs carry a leading 'pp' dim)."""
+    parts = tuple(spec) + (None,) * (len(shape.shape) - len(tuple(spec)))
+    for ax, part in enumerate(parts):
+        if part is None and shape.shape[ax] % dp == 0:
+            return P(*parts[:ax], "dp", *parts[ax + 1:])
+    return P(*parts)
+
+
+def place_opt_state(opt_state, specs, mesh: Mesh):
+    """Place an AdamW state on the mesh: m/v per the param specs, the
+    step counter replicated — the one placement recipe shared by the
+    trunk and both pipeline schedule builders."""
+    shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                         is_leaf=lambda x: isinstance(x, P))
+    put = functools.partial(jax.tree.map, jax.device_put)
+    return {"m": put(opt_state["m"], shard), "v": put(opt_state["v"], shard),
+            "t": jax.device_put(opt_state["t"], NamedSharding(mesh, P()))}
 
 
 def shard_opt_state(opt_state, cfg: TransformerConfig, mesh: Mesh,
@@ -674,11 +689,7 @@ def shard_opt_state(opt_state, cfg: TransformerConfig, mesh: Mesh,
     ``zero1`` (jit pins committed input shardings, so the state must be
     placed before the first step)."""
     specs = zero1_opt_specs(cfg, mesh) if zero1 else param_specs(cfg)
-    shard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                         is_leaf=lambda x: isinstance(x, P))
-    put = functools.partial(jax.tree.map, jax.device_put)
-    return {"m": put(opt_state["m"], shard), "v": put(opt_state["v"], shard),
-            "t": jax.device_put(opt_state["t"], NamedSharding(mesh, P()))}
+    return place_opt_state(opt_state, specs, mesh)
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,

@@ -82,15 +82,51 @@ def _make_stage_fn(cfg: tfm.TransformerConfig, layers_per_stage: int):
     return stage_fn
 
 
+@functools.lru_cache(maxsize=8)
+def zero1_pipeline_opt_specs(cfg: tfm.TransformerConfig, mesh: Mesh):
+    """ZeRO-1 slot layout for pipeline params: each AdamW m/v leaf is
+    additionally sharded over ``dp`` on its first free, dp-divisible dim
+    (blocks keep their leading ``pp`` dim). Same recipe — and the same
+    GSPMD-materialized reduce-scatter/sharded-update/all-gather dataflow
+    — as ``transformer.zero1_opt_specs``; memory for optimizer state
+    drops ~dp x with bit-identical step math. Cached per (cfg, mesh):
+    both the step builder and ``shard_pipeline_opt_state`` need it, and
+    the abstract init trace is pure in its arguments."""
+    pp, dp = mesh.shape["pp"], mesh.shape["dp"]
+    specs = pipeline_spec(cfg, pp)
+    shapes = jax.eval_shape(lambda: {
+        **(p := tfm.init_params(jax.random.PRNGKey(0), cfg)),
+        "blocks": _stack_stages(p["blocks"], pp)})
+    return jax.tree.map(
+        lambda s, sh: tfm.shard_first_free_dim(s, sh, dp), specs, shapes,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_pipeline_opt_state(opt_state, cfg: tfm.TransformerConfig,
+                             mesh: Mesh, zero1: bool = False):
+    """Place a pipeline optimizer state on the mesh (the ZeRO-1 layout
+    when ``zero1`` — jit pins committed input shardings, so place the
+    state before the first step)."""
+    specs = (zero1_pipeline_opt_specs(cfg, mesh) if zero1
+             else pipeline_spec(cfg, mesh.shape["pp"]))
+    return tfm.place_opt_state(opt_state, specs, mesh)
+
+
 def _wrap_step(step, cfg: tfm.TransformerConfig, mesh: Mesh, pp: int,
-               use_dropout: bool):
+               use_dropout: bool, zero1: bool = False):
     """Shared jit wrapper for both schedule builders: identical
     shardings, donation, and the dropout arity switch — the two steps
     stay drop-in interchangeable (same input layouts) by construction."""
     specs = pipeline_spec(cfg, pp)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                           is_leaf=lambda x: isinstance(x, P))
-    opt_shard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    if zero1:
+        oshard = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                              zero1_pipeline_opt_specs(cfg, mesh),
+                              is_leaf=lambda x: isinstance(x, P))
+    else:
+        oshard = pshard
+    opt_shard = {"m": oshard, "v": oshard, "t": NamedSharding(mesh, P())}
     data_shard = NamedSharding(mesh, P(None, "dp", None))
     in_sh = [pshard, opt_shard, data_shard, data_shard]
     if use_dropout:
@@ -110,11 +146,14 @@ def _wrap_step(step, cfg: tfm.TransformerConfig, mesh: Mesh, pp: int,
 
 def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
                              num_microbatches: int, lr: float = 1e-3,
-                             aux_weight: float = 0.01):
+                             aux_weight: float = 0.01,
+                             zero1: bool = False):
     """Build the jitted GPipe step.
 
     tokens/targets: (M, mb, T) — M microbatches. Returns
-    (loss, params, opt_state).
+    (loss, params, opt_state). ``zero1``: shard AdamW m/v over dp
+    (place the state with ``shard_pipeline_opt_state(..., zero1=True)``
+    before the first step; step math is bit-identical).
     """
     pp = mesh.shape["pp"]
     M = num_microbatches
@@ -208,7 +247,7 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
         new_params, new_opt = tfm.adamw_update(params, grads, opt_state, lr=lr)
         return loss, new_params, new_opt
 
-    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout)
+    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout, zero1=zero1)
     # the raw loss function, for grad-level parity tests against the 1F1B
     # twin (jax.grad(fwd_loss) is this schedule's exact gradient)
     jitted.fwd_loss = fwd_loss
@@ -348,36 +387,50 @@ def schedule_stats(pp: int, num_microbatches: int) -> dict:
 
 def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                                   num_microbatches: int, lr: float = 1e-3,
-                                  aux_weight: float = 0.01):
-    """1F1B twin of ``make_pipeline_train_step`` — identical signature,
-    identical math (bit-matching dropout keys per (microbatch, layer)),
-    different memory law (see module section comment).
+                                  aux_weight: float = 0.01,
+                                  zero1: bool = False,
+                                  predication: str = "masked"):
+    """1F1B twin of ``make_pipeline_train_step`` — same signature plus
+    the 1F1B-only ``predication`` knob, identical math (bit-matching
+    dropout keys per (microbatch, layer)), different memory law (see
+    module section comment).
 
     Mechanics: one ``lax.scan`` over the simulated schedule's ticks inside
     a ``shard_map`` manual over ``pp``. Each tick, each stage runs its
-    scheduled micro-op behind ``lax.cond`` (real branches — an idle stage
-    burns no FLOPs), then activations hop forward and gradients hop
-    backward via two unconditional ``ppermute``s. The backward micro-op
-    re-runs the stage forward from the stashed stage INPUT under
-    ``jax.vjp`` (stage-granular remat) — the last stage differentiates
-    through the head+NLL with cotangent 1/M, others seed with the grad
-    received from downstream."""
+    scheduled micro-op (``predication``: "masked" default — computed
+    everywhere, effects selected; "cond" opt-in — lax.cond branches,
+    idle ticks free, but see the lowering comment below for why that is
+    only sound when no GSPMD collective lands inside a branch), then
+    activations hop forward and gradients hop backward via two
+    unconditional ``ppermute``s. The backward micro-op re-runs the stage
+    forward from the stashed stage INPUT under ``jax.vjp``
+    (stage-granular remat) — the last stage differentiates through the
+    head+NLL with cotangent 1/M, others seed with the grad received from
+    downstream."""
     pp = mesh.shape["pp"]
     M = num_microbatches
     assert cfg.n_layers % pp == 0
     layers_per_stage = cfg.n_layers // pp
     use_dropout = cfg.dropout_rate > 0.0
-    # Micro-op gating has two lowerings. On a pure dp x pp mesh the
-    # micro-ops sit behind lax.cond — an idle tick costs nothing. With
-    # model axes (tp/sp/ep) in play, GSPMD inserts collectives INSIDE the
-    # branches (e.g. tp all-reduces of the Megatron matmuls); stages
-    # diverge on the predicate, the tp group's peers wait forever, and
-    # the program deadlocks (observed on the CPU backend) — so those
-    # meshes run the masked lowering: every device computes every tick
-    # and the schedule selects effects. Same math, no divergent
-    # collectives, idle ticks cost FLOPs.
-    use_cond = (mesh.shape.get("tp", 1) * mesh.shape.get("sp", 1)
-                * mesh.shape.get("ep", 1)) == 1
+    # Micro-op gating has two lowerings. "masked" (the default) computes
+    # every micro-op on every device and selects effects by the schedule
+    # — idle ticks cost FLOPs, but every GSPMD-inserted collective runs
+    # on every device's path. "cond" puts the micro-ops behind lax.cond
+    # (idle ticks free) but is UNSOUND whenever GSPMD lowers ANY inner
+    # op to a collective, because stages diverge on the predicate and
+    # the collective's peers never arrive: observed deadlocks include tp
+    # all-reduces of the Megatron matmuls, AND — even on a pure dp x pp
+    # mesh — a reshard collective-permute GSPMD inserted for the
+    # pos-table gradient when max_seq_len > T. Since GSPMD's choices
+    # aren't statically checkable here, cond is opt-in for configs the
+    # caller has validated; it additionally refuses model axes outright.
+    assert predication in ("masked", "cond"), predication
+    use_cond = predication == "cond"
+    if use_cond:
+        assert (mesh.shape.get("tp", 1) * mesh.shape.get("sp", 1)
+                * mesh.shape.get("ep", 1)) == 1, (
+            "predication='cond' deadlocks with tp/sp/ep in the mesh "
+            "(GSPMD collectives inside divergent branches)")
 
     table = simulate_1f1b_schedule(pp, M)
     n_ticks = len(table)
@@ -402,6 +455,13 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
         stage_blocks = params["blocks"]
         other = {k: v for k, v in params.items() if k != "blocks"}
         B, T = tokens.shape[1], tokens.shape[2]
+        # the second OBSERVED cond deadlock is checkable here: with
+        # max_seq_len > T, GSPMD lowers the pos-table slice/grad to a
+        # reshard collective-permute inside the stage-0 branch
+        assert not (use_cond and cfg.use_pos_emb and cfg.max_seq_len > T), (
+            "predication='cond' deadlocks when max_seq_len > T with a "
+            "positional table (GSPMD reshard inside a divergent branch); "
+            "use the masked default or set max_seq_len == T")
 
         tis_f, tf_mb = jnp.asarray(is_f), jnp.asarray(f_mb)
         tis_b, tb_mb = jnp.asarray(is_b), jnp.asarray(b_mb)
@@ -638,7 +698,7 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                                                lr=lr)
         return loss, new_params, new_opt
 
-    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout)
+    jitted = _wrap_step(step, cfg, mesh, pp, use_dropout, zero1=zero1)
     # the hand-rolled (loss, grads) function, for grad-level parity tests
     # against jax.grad of the GPipe twin's fwd_loss
     jitted.fwd_bwd = fwd_bwd
