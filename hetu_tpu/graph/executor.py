@@ -53,7 +53,8 @@ class HetuConfig:
     def __init__(self, eval_node_list, train_name="*", val_name="*", ctx=None,
                  seed=None, comm_mode=None, mesh=None, use_sparse_pull=True,
                  cstable_policy=None, bsp=False, prefetch=True, enable_lazy=False,
-                 cache_bound=100, log_path=None, gpipe=False, dtype=np.float32,
+                 cache_bound=100, log_path=None, gpipe=False,
+                 gpipe_microbatches=None, dtype=np.float32,
                  dp_axis="dp", mp_axis="tp", **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
@@ -70,6 +71,9 @@ class HetuConfig:
         self.cache_bound = cache_bound
         self.log_path = log_path
         self.gpipe = gpipe
+        # microbatch count for dataloader-fed gpipe runs (run() without a
+        # feed list); explicit feed lists carry their own M
+        self.gpipe_microbatches = gpipe_microbatches
         # compute dtype: bf16 keeps the MXU fed at full rate; master params,
         # optimizer state and updates stay f32 (mixed precision — the
         # reference is f32-only, c_runtime_api.h GetDataSize :74-82)
@@ -986,9 +990,22 @@ class Executor:
                        eval_node_list=eval_node_list)
 
     def get_batch_num(self, name="default"):
+        """Batches per epoch for the target's dataloaders (min across
+        them). Under dataloader-fed gpipe this counts STEPS per epoch:
+        each gpipe run() consumes gpipe_microbatches batches per
+        loader."""
         sub = self.subexecutors[name]
-        nums = [n.get_batch_num(name) for n in sub.dataloader_nodes]
-        return min(nums) if nums else None
+        dls = getattr(sub, "dataloader_nodes", None)
+        if dls is None:
+            dls = getattr(sub, "dl_nodes", [])
+        nums = [n.get_batch_num(name) for n in dls]
+        if not nums:
+            return None
+        num = min(nums)
+        m = getattr(self.config, "gpipe_microbatches", None)
+        if self.config.gpipe and m:
+            num //= m
+        return num
 
     def _param_file_names(self):
         """Stable, collision-free file name per parameter: duplicates get a

@@ -146,11 +146,10 @@ class SubExecutor4Gpipe:
         self.fwd_evals = fwd_evals
         fwd_topo = [n for n in find_topo_sort(fwd_evals)
                     if not (n.is_gradient or n.is_optimizer)]
-        for n in fwd_topo:
-            if n.is_dataloader:
-                raise NotImplementedError(
-                    "gpipe feeds come from the feed_dicts list, not "
-                    "dataloader nodes (reference gpipe.py feeds explicitly)")
+        # dataloader-fed gpipe (round 5; the reference's gpipe is
+        # feed-list-only): dataloader nodes become per-stage feeds whose
+        # values run() pulls host-side, M microbatches per step
+        self.dl_nodes = [n for n in fwd_topo if n.is_dataloader]
 
         self.training = self.opt_node is not None
         self.stages = self._partition(fwd_topo)
@@ -172,7 +171,7 @@ class SubExecutor4Gpipe:
             return group_index[g]
 
         for n in fwd_topo:
-            if n.is_placeholder:
+            if n.is_placeholder or n.is_dataloader:
                 continue  # assigned to earliest consumer below
             if not isinstance(n.raw_ctx, DeviceGroup):
                 raise ValueError(
@@ -195,18 +194,20 @@ class SubExecutor4Gpipe:
         for st in stages:
             st.state_nodes = [n for n in st.nodes if n.stateful]
 
-        # placeholders (params and feeds) belong to their earliest consumer
+        # placeholders (params and feeds) and dataloader nodes belong to
+        # their earliest consumer; dataloaders join feed_nodes — the stage
+        # program treats them as feeds, run() supplies their batches
         for n in fwd_topo:
-            if not n.is_placeholder:
+            if not (n.is_placeholder or n.is_dataloader):
                 continue
             consumers = [stage_of[id(c)] for c in fwd_topo
-                         if not c.is_placeholder
+                         if not (c.is_placeholder or c.is_dataloader)
                          and any(i is n for i in c.inputs)]
             if not consumers:
                 continue
             s = min(consumers)
             stage_of[id(n)] = s
-            if getattr(n, "is_feed", False):
+            if n.is_dataloader or getattr(n, "is_feed", False):
                 stages[s].feed_nodes.append(n)
             else:
                 stages[s].param_nodes.append(n)
@@ -323,23 +324,46 @@ class SubExecutor4Gpipe:
         ex = self.executor
         if isinstance(feed_dict, dict):
             feed_dict = [feed_dict]
+        if feed_dict is None and self.dl_nodes:
+            # dataloader-fed step: M comes from the config (explicit feed
+            # lists carry their own M)
+            M = self.config.gpipe_microbatches
+            if not M:
+                raise ValueError(
+                    "gpipe with dataloader feeds and no feed_dicts list "
+                    "needs Executor(..., gpipe_microbatches=M)")
+            feed_dict = [{} for _ in range(M)]
         if not isinstance(feed_dict, (list, tuple)) or not feed_dict:
             raise ValueError(
                 "gpipe run() takes a non-empty list of microbatch feed_dicts")
+        if self.dl_nodes:
+            # pull M batches per dataloader, injected per microbatch (a
+            # user-supplied value for the same node would be ambiguous)
+            feed_dict = [dict(fd) for fd in feed_dict]
+            for fd in feed_dict:
+                for n in self.dl_nodes:
+                    if n in fd:
+                        raise ValueError(
+                            f"{n.name!r} is a dataloader node; its batches "
+                            "come from the loader, not the feed list")
+                    fd[n] = np.asarray(n.get_batch(self.name))
         M = len(feed_dict)
         step = ex.state["step"]
         rng_step = jax.random.fold_in(ex.rng_root, step)
 
-        # stage feeds per microbatch, batch-sharded over the stage devices
-        feeds = [[tuple(st.put_batch(np.asarray(fd[n]))
-                        for n in st.feed_nodes)
-                  for st in self.stages] for fd in feed_dict]
+        # validate BEFORE building feeds — the comprehension below indexes
+        # fd[n] eagerly, and a bare KeyError names the Op repr, not the
+        # microbatch/feed the user forgot
         for m, fd in enumerate(feed_dict):
             for st in self.stages:
                 for n in st.feed_nodes:
                     if n not in fd:
                         raise ValueError(
                             f"microbatch {m}: missing feed for {n.name!r}")
+        # stage feeds per microbatch, batch-sharded over the stage devices
+        feeds = [[tuple(st.put_batch(np.asarray(fd[n]))
+                        for n in st.feed_nodes)
+                  for st in self.stages] for fd in feed_dict]
 
         params = [self._stage_params(st) for st in self.stages]
         # op state (BN running stats) threads sequentially through the

@@ -36,7 +36,7 @@ DEFAULT = {"samples_per_sec": 50.0, "_device": "TPU v5 lite"}
 
 
 def run_sim(monkeypatch, behavior, budget=None, ledger_path="",
-            kill_after=None):
+            kill_after=None, wedge_report="/nonexistent/wedge.json"):
     """Run bench.main() --fast with a scripted section runner.
 
     ``behavior``: section name -> list of results returned per successive
@@ -64,6 +64,8 @@ def run_sim(monkeypatch, behavior, budget=None, ledger_path="",
     if budget is not None:
         monkeypatch.setenv("HETU_BENCH_PROBE_WAIT_S", str(budget))
     monkeypatch.setenv("HETU_BENCH_LEDGER", str(ledger_path))
+    # keep a real repo-root WEDGE_BISECT.json from leaking into the sims
+    monkeypatch.setenv("HETU_WEDGE_REPORT", str(wedge_report))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--fast"])
     buf = io.StringIO()
     monkeypatch.setattr(sys, "stdout", buf)
@@ -457,6 +459,34 @@ def test_wedge_bisect_all_green_says_reenable(monkeypatch, tmp_path):
               "bf16_bs256_cold_cache", "bf16_bs256_warm_cache",
               "bf16_bs512_warm_cache"):
         assert k in rep and k + "_postprobe" in rep
+
+
+def test_green_wedge_verdict_lifts_quarantine(monkeypatch, tmp_path):
+    # a green bisect report makes the bs256/bs512 cells ordinary again:
+    # a hang gets the normal outage-retry treatment instead of the
+    # never-retry quarantine
+    wp = tmp_path / "WEDGE_BISECT.json"
+    wp.write_text(json.dumps({"verdict": {"text":
+        "no wedge reproduced this window — re-enable the risky cells"}}))
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_TO, PROBE_OK],
+        "resnet:256:bf16": [TO, OK],
+    }, budget=100000, wedge_report=wp)
+    d = out["detail"]
+    assert "re-enable" in d["wedge_verdict"]
+    # retried after the outage and captured — impossible under quarantine
+    assert d["resnet18_bf16_bs256"] == {"samples_per_sec": 100.0}
+
+
+def test_non_green_wedge_verdict_keeps_quarantine(monkeypatch, tmp_path):
+    wp = tmp_path / "WEDGE_BISECT.json"
+    wp.write_text(json.dumps({"verdict": {"text":
+        "EXECUTE-side wedge: the cell hangs even with a warm cache"}}))
+    rc, out = run_sim(monkeypatch, {
+        "probe": [PROBE_OK, PROBE_OK],
+        "resnet:256:bf16": [TO, OK],
+    }, wedge_report=wp)
+    assert "not retried" in out["detail"]["resnet18_bf16_bs256"]["error"]
 
 
 def test_wedge_bisect_execute_side_verdict(monkeypatch, tmp_path):
