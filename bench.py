@@ -778,19 +778,21 @@ def main():
                  ("resnet18_bf16_bs512", "resnet:512:bf16", 900)]
     risky = {"resnet18_bf16_bs256", "resnet18_bf16_bs512"}
     # tools/wedge_bisect.py closes the loop: a green bisect verdict
-    # ("no wedge reproduced ... re-enable") lifts the quarantine, so the
+    # (the STRUCTURED verdict.green flag) lifts the quarantine, so the
     # cells get normal outage-retry treatment without a hand edit; any
     # other verdict (compile/execute-side, inconclusive) keeps it.
     wpath = os.environ.get("HETU_WEDGE_REPORT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "WEDGE_BISECT.json")
     try:
         with open(wpath) as f:
-            wverdict = json.load(f).get("verdict", {}).get("text", "")
+            wverdict = json.load(f).get("verdict", {})
+        lift = wverdict.get("green") is True
+        wtext = wverdict.get("text", "")
     except Exception:  # noqa: BLE001 — a malformed report must not break
-        wverdict = ""  # the driver's one-JSON-line contract
-    if isinstance(wverdict, str) and "re-enable" in wverdict:
+        lift, wtext = False, ""  # the driver's one-JSON-line contract
+    if lift:
         risky = set()
-        detail["wedge_verdict"] = wverdict
+        detail["wedge_verdict"] = wtext
 
     for key, name, timeout in sections:
         if name == "probe":

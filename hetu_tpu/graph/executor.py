@@ -1004,6 +1004,11 @@ class Executor:
         num = min(nums)
         m = getattr(self.config, "gpipe_microbatches", None)
         if self.config.gpipe and m:
+            if num < m:
+                raise ValueError(
+                    f"dataloader provides {num} batches/epoch but one "
+                    f"gpipe step consumes gpipe_microbatches={m}; a "
+                    f"0-step epoch loop would silently train nothing")
             num //= m
         return num
 

@@ -148,8 +148,16 @@ class SubExecutor4Gpipe:
                     if not (n.is_gradient or n.is_optimizer)]
         # dataloader-fed gpipe (round 5; the reference's gpipe is
         # feed-list-only): dataloader nodes become per-stage feeds whose
-        # values run() pulls host-side, M microbatches per step
+        # values run() pulls host-side, M microbatches per step. Plain
+        # DataloaderOp only — GNN double-buffered loaders have a
+        # step-driven get_batch contract this schedule does not drive.
+        from ..dataloader import DataloaderOp
         self.dl_nodes = [n for n in fwd_topo if n.is_dataloader]
+        for n in self.dl_nodes:
+            if not isinstance(n, DataloaderOp):
+                raise NotImplementedError(
+                    f"gpipe dataloader feeds support plain dataloader_op "
+                    f"nodes; {type(n).__name__} must be fed explicitly")
 
         self.training = self.opt_node is not None
         self.stages = self._partition(fwd_topo)
@@ -322,6 +330,10 @@ class SubExecutor4Gpipe:
         eval node, the list of per-microbatch values (None for the
         optimizer node)."""
         ex = self.executor
+        if not feed_dict:
+            # {} / [] mean the same as None: nothing fed by hand — the
+            # dataloader path must not silently run a 1-microbatch step
+            feed_dict = None
         if isinstance(feed_dict, dict):
             feed_dict = [feed_dict]
         if feed_dict is None and self.dl_nodes:

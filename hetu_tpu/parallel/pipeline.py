@@ -276,50 +276,67 @@ def init_pipeline_params(rng, cfg: tfm.TransformerConfig, mesh: Mesh):
 # for large microbatch counts, where GPipe's stash is the OOM.
 # ---------------------------------------------------------------------------
 
-def simulate_1f1b_schedule(pp: int, num_microbatches: int):
+def resolve_inflight_window(pp: int, max_inflight: int = None) -> int:
+    """The one place the dual-slot window defaults to 2*pp — the
+    simulator, the stats, and the step builder's ring depth must agree
+    or the table and the activation ring drift apart."""
+    return max_inflight or 2 * pp
+
+
+def simulate_1f1b_schedule(pp: int, num_microbatches: int,
+                           max_inflight: int = None):
     """Greedy dependency-driven 1F1B schedule table (host-side, static).
 
-    Stage ``s`` executes the op string F*w + (FB)*(M-w) + B*w with
-    w = min(pp-1-s, M); an op fires at tick t only when its dependency
-    (producer's op at an EARLIER tick — activations/grads move one hop
-    per tick) is met, at most one op per stage per tick. Returns
-    ``table``: list over ticks of per-stage entries ``None | ("F", m) |
-    ("B", m)``. The table is baked into the jitted step as constant
-    arrays, so the runtime program is lockstep-static."""
+    DUAL-SLOT ticks: each tick, each stage may fire its next forward AND
+    its next backward (the literal one-forward-one-backward) — the
+    runtime tick body executes one fwd micro-op and one bwd micro-op
+    anyway, so a denser table converts the masked lowering's idle halves
+    into scheduled work and roughly halves the tick count
+    (~M + 2(pp-1) ticks instead of ~2(M+pp-1)).
+
+    Firing rules (producers move one hop per tick, so deps must be
+    STRICTLY earlier; backpressure keeps the single-slot receive buffers
+    and the pp-deep activation ring sound):
+    - F(m) on stage s: upstream F(m) done earlier (s>0); downstream has
+      consumed F(m-1) (send would overwrite its recv slot); in-flight
+      microbatches (next_f - next_b) < max_inflight (default 2*pp —
+      the activation-ring capacity).
+    - B(m) on stage s: downstream B(m) done earlier (s<pp-1) or own F(m)
+      done earlier (last stage); upstream has consumed B(m-1).
+
+    ``max_inflight`` bounds each stage's un-backproped microbatches (its
+    activation-ring depth). Default 2*pp: the backward round trip takes
+    ~2*pp lockstep ticks, so a 2*pp window is what keeps BOTH slots busy
+    in steady state — still O(pp) memory (vs GPipe's O(M)); pass pp for
+    the classic minimum-memory 1F1B, which halves the steady-state duty
+    cycle in this lockstep model.
+
+    Returns ``table``: list over ticks of per-stage ``(fm, bm)`` pairs,
+    each entry an int microbatch or None. Baked into the jitted step as
+    constant arrays, so the runtime program is lockstep-static."""
     M = num_microbatches
-    w = [min(pp - 1 - s, M) for s in range(pp)]
-    ops = []
-    for s in range(pp):
-        seq = ["F"] * w[s]
-        for _ in range(M - w[s]):
-            seq += ["F", "B"]
-        seq += ["B"] * w[s]
-        ops.append(seq)
-    head = [0] * pp
+    W = resolve_inflight_window(pp, max_inflight)
     next_f = [0] * pp
     next_b = [0] * pp
     fwd_done = [[None] * M for _ in range(pp)]
     bwd_done = [[None] * M for _ in range(pp)]
     table = []
     t = 0
-    while any(head[s] < len(ops[s]) for s in range(pp)):
-        row = [None] * pp
+    while any(next_b[s] < M for s in range(pp)):
+        # Backpressure may be released by a SAME-tick consumption: the
+        # receiver reads its single recv slot during its micro-op, and
+        # the sender's replacement only lands at end-of-tick (ppermute) —
+        # so "receiver consumed my previous send" includes this tick.
+        # Evaluate receivers before senders so those credits are final:
+        # B flows toward stage 0 (ascending order decides s-1 before s),
+        # F flows toward stage pp-1 (descending decides s+1 before s).
+        # B decisions also precede F: the runtime tick body runs the
+        # backward micro-op FIRST, so a same-tick B frees its ring slot
+        # (and its window unit) for the same-tick F.
+        brow = [None] * pp
         for s in range(pp):
-            if head[s] >= len(ops[s]):
-                continue
-            op = ops[s][head[s]]
-            if op == "F":
-                m = next_f[s]
-                ready = s == 0 or (fwd_done[s - 1][m] is not None
-                                   and fwd_done[s - 1][m] < t)
-                # backpressure (single-slot receive buffer): don't compute
-                # F(m) until the downstream stage has consumed F(m-1) —
-                # the send would overwrite its one recv slot
-                if ready and s < pp - 1 and m > 0:
-                    ready = (fwd_done[s + 1][m - 1] is not None
-                             and fwd_done[s + 1][m - 1] <= t)
-            else:
-                m = next_b[s]
+            m = next_b[s]
+            if m < M:
                 if s == pp - 1:
                     ready = (fwd_done[s][m] is not None
                              and fwd_done[s][m] < t)
@@ -327,29 +344,44 @@ def simulate_1f1b_schedule(pp: int, num_microbatches: int):
                     ready = (bwd_done[s + 1][m] is not None
                              and bwd_done[s + 1][m] < t)
                 if ready and s > 0 and m > 0:
-                    ready = (bwd_done[s - 1][m - 1] is not None
-                             and bwd_done[s - 1][m - 1] <= t)
-            if ready:
-                row[s] = (op, m)
+                    ready = (brow[s - 1] == m - 1
+                             or (bwd_done[s - 1][m - 1] is not None
+                                 and bwd_done[s - 1][m - 1] <= t))
+                if ready:
+                    brow[s] = m
+        frow = [None] * pp
+        for s in range(pp - 1, -1, -1):
+            m = next_f[s]
+            inflight = (next_f[s] - next_b[s]
+                        - (1 if brow[s] is not None else 0))
+            if m < M and inflight < W:
+                ready = s == 0 or (fwd_done[s - 1][m] is not None
+                                   and fwd_done[s - 1][m] < t)
+                if ready and s < pp - 1 and m > 0:
+                    ready = (frow[s + 1] == m - 1
+                             or (fwd_done[s + 1][m - 1] is not None
+                                 and fwd_done[s + 1][m - 1] <= t))
+                if ready:
+                    frow[s] = m
+        row = list(zip(frow, brow))
         fired = False
-        for s in range(pp):
-            if row[s] is not None:
-                kind, m = row[s]
-                head[s] += 1
+        for s, (fm, bm) in enumerate(row):
+            if fm is not None:
+                fwd_done[s][fm] = t
+                next_f[s] += 1
                 fired = True
-                if kind == "F":
-                    fwd_done[s][m] = t
-                    next_f[s] += 1
-                else:
-                    bwd_done[s][m] = t
-                    next_b[s] += 1
+            if bm is not None:
+                bwd_done[s][bm] = t
+                next_b[s] += 1
+                fired = True
         assert fired, f"1F1B schedule deadlock at tick {t} (pp={pp}, M={M})"
         table.append(row)
         t += 1
     return table
 
 
-def schedule_stats(pp: int, num_microbatches: int) -> dict:
+def schedule_stats(pp: int, num_microbatches: int,
+                   max_inflight: int = None) -> dict:
     """Per-stage bubble accounting for both schedules (printed by the
     dryrun; the numbers a pipeline tuning session starts from).
 
@@ -359,18 +391,23 @@ def schedule_stats(pp: int, num_microbatches: int) -> dict:
       carry for the outer grad): M + pp - 1.
     - 1f1b: measured on the simulated table; peak stash is the ring
       high-water mark of in-flight (forwarded, not-yet-backproped)
-      microbatches — bounded by pp by construction."""
+      microbatches — bounded by max_inflight (default 2*pp)."""
     M = num_microbatches
-    table = simulate_1f1b_schedule(pp, M)
+    table = simulate_1f1b_schedule(pp, M, max_inflight)
     n_ticks = len(table)
-    busy = [sum(1 for row in table if row[s] is not None) for s in range(pp)]
+    busy = [0] * pp          # ops fired per stage (out of 2 slots/tick)
     inflight = [0] * pp
     peak = [0] * pp
     for row in table:
-        for s in range(pp):
-            if row[s] is not None:
-                kind, _ = row[s]
-                inflight[s] += 1 if kind == "F" else -1
+        for s, (fm, bm) in enumerate(row):
+            # B first, like the runtime tick body: a same-tick B frees
+            # its ring slot before the F stashes into it
+            if bm is not None:
+                busy[s] += 1
+                inflight[s] -= 1
+            if fm is not None:
+                busy[s] += 1
+                inflight[s] += 1
                 peak[s] = max(peak[s], inflight[s])
     g_ticks = M + pp - 1
     return {
@@ -379,8 +416,10 @@ def schedule_stats(pp: int, num_microbatches: int) -> dict:
                   "peak_act_stash_per_stage": g_ticks},
         "1f1b": {"ticks": n_ticks,
                  "per_stage_busy": busy,
-                 "bubble_fraction": round(1.0 - sum(busy) / (pp * n_ticks),
-                                          4),
+                 # each tick offers an F and a B slot; unused slots are
+                 # the bubble (what the masked lowering pays for)
+                 "bubble_fraction": round(
+                     1.0 - sum(busy) / (2.0 * pp * n_ticks), 4),
                  "peak_act_stash_per_stage": max(peak)},
     }
 
@@ -389,7 +428,8 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                                   num_microbatches: int, lr: float = 1e-3,
                                   aux_weight: float = 0.01,
                                   zero1: bool = False,
-                                  predication: str = "masked"):
+                                  predication: str = "masked",
+                                  max_inflight: int = None):
     """1F1B twin of ``make_pipeline_train_step`` — same signature plus
     the 1F1B-only ``predication`` knob, identical math (bit-matching
     dropout keys per (microbatch, layer)), different memory law (see
@@ -432,21 +472,20 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
             "predication='cond' deadlocks with tp/sp/ep in the mesh "
             "(GSPMD collectives inside divergent branches)")
 
-    table = simulate_1f1b_schedule(pp, M)
+    W = resolve_inflight_window(pp, max_inflight)
+    table = simulate_1f1b_schedule(pp, M, W)
+    ring = min(W, M)   # activation stash depth per stage (the memory law)
     n_ticks = len(table)
     is_f = np.zeros((n_ticks, pp), np.bool_)
     f_mb = np.zeros((n_ticks, pp), np.int32)
     is_b = np.zeros((n_ticks, pp), np.bool_)
     b_mb = np.zeros((n_ticks, pp), np.int32)
     for t, row in enumerate(table):
-        for s, ent in enumerate(row):
-            if ent is None:
-                continue
-            kind, m = ent
-            if kind == "F":
-                is_f[t, s], f_mb[t, s] = True, m
-            else:
-                is_b[t, s], b_mb[t, s] = True, m
+        for s, (fm, bm) in enumerate(row):
+            if fm is not None:
+                is_f[t, s], f_mb[t, s] = True, fm
+            if bm is not None:
+                is_b[t, s], b_mb[t, s] = True, bm
 
     stage_fn = _make_stage_fn(cfg, layers_per_stage)
 
@@ -475,7 +514,7 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
 
             zero_act = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
             carry0 = (
-                varying(jnp.zeros((pp, B, T, cfg.d_model), cfg.dtype)),
+                varying(jnp.zeros((ring, B, T, cfg.d_model), cfg.dtype)),
                 varying(zero_act),                       # recv_f
                 varying(zero_act),                       # recv_b
                 # zeros_like(local_blocks) is born varying (sliced from the
@@ -506,32 +545,10 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                     jax.lax.dynamic_index_in_dim(tb_mb, t, 0, False),
                     stage, 0, False)
 
-                # ---- forward micro-op -------------------------------
-                def do_fwd(act_buf, recv_f, aux_sum):
-                    tok_m = jax.lax.dynamic_index_in_dim(tokens, fm, 0,
-                                                         False)
-                    h0 = tfm.embed_tokens(other, tok_m, cfg)
-                    h_in = jnp.where(stage == 0, h0, recv_f)
-                    h_out, aux = stage_fn(h_in, local_blocks, stage,
-                                          mb_rng(fm))
-                    act_buf = jax.lax.dynamic_update_index_in_dim(
-                        act_buf, h_in, fm % pp, 0)
-                    return act_buf, h_out, aux_sum + aux
-
-                if use_cond:
-                    # real branch: idle ticks are free
-                    act_buf, send_f, aux_sum = jax.lax.cond(
-                        isf, do_fwd,
-                        lambda ab, rf, ax: (ab, jnp.zeros_like(rf), ax),
-                        act_buf, recv_f, aux_sum)
-                else:
-                    # masked: compute unconditionally, select the effect
-                    nb, h_out, na = do_fwd(act_buf, recv_f, aux_sum)
-                    act_buf = jnp.where(isf, nb, act_buf)
-                    send_f = jnp.where(isf, h_out, jnp.zeros_like(h_out))
-                    aux_sum = jnp.where(isf, na, aux_sum)
-
-                # ---- backward micro-op (stage-granular remat vjp) ------
+                # ---- backward micro-op FIRST (stage-granular remat vjp):
+                # it reads the ring slot its microbatch stashed earlier,
+                # and the same-tick forward may REUSE that slot (the
+                # schedule's window credit assumes this B-before-F order)
                 # shared preamble: cheap ring/table reads and the ONE
                 # function both lowerings differentiate — defined once so
                 # the cond and masked paths cannot drift apart.
@@ -540,8 +557,8 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                 # insert a psum (a collective inside a cond branch, where
                 # idle stages never arrive -> deadlock). The per-stage
                 # partial grads are psum'd once, outside the scan.
-                h_in_b = jax.lax.dynamic_index_in_dim(act_buf, bm % pp, 0,
-                                                      False)
+                h_in_b = jax.lax.dynamic_index_in_dim(act_buf, bm % ring,
+                                                      0, False)
                 tgt_m = jax.lax.dynamic_index_in_dim(targets, bm, 0, False)
                 tok_b = jax.lax.dynamic_index_in_dim(tokens, bm, 0, False)
                 rng_b = mb_rng(bm)
@@ -646,6 +663,31 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
                 else:
                     g_blocks, g_other, send_b, loss_sum = do_bwd_masked(
                         g_blocks, g_other, recv_b, loss_sum)
+
+                # ---- forward micro-op -------------------------------
+                def do_fwd(act_buf, recv_f, aux_sum):
+                    tok_m = jax.lax.dynamic_index_in_dim(tokens, fm, 0,
+                                                         False)
+                    h0 = tfm.embed_tokens(other, tok_m, cfg)
+                    h_in = jnp.where(stage == 0, h0, recv_f)
+                    h_out, aux = stage_fn(h_in, local_blocks, stage,
+                                          mb_rng(fm))
+                    act_buf = jax.lax.dynamic_update_index_in_dim(
+                        act_buf, h_in, fm % ring, 0)
+                    return act_buf, h_out, aux_sum + aux
+
+                if use_cond:
+                    # real branch: idle ticks are free
+                    act_buf, send_f, aux_sum = jax.lax.cond(
+                        isf, do_fwd,
+                        lambda ab, rf, ax: (ab, jnp.zeros_like(rf), ax),
+                        act_buf, recv_f, aux_sum)
+                else:
+                    # masked: compute unconditionally, select the effect
+                    nb, h_out, na = do_fwd(act_buf, recv_f, aux_sum)
+                    act_buf = jnp.where(isf, nb, act_buf)
+                    send_f = jnp.where(isf, h_out, jnp.zeros_like(h_out))
+                    aux_sum = jnp.where(isf, na, aux_sum)
 
                 # ---- unconditional hops (collectives stay out of conds).
                 # Receives are STICKY: a hop only replaces the buffer when

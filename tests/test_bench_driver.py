@@ -446,6 +446,7 @@ def test_wedge_bisect_compile_side_verdict(monkeypatch, tmp_path):
     })
     assert rc == 0
     assert "COMPILE-side" in rep["verdict"]["text"]
+    assert rep["verdict"]["green"] is False
     assert rep["bf16_bs256_cold_cache"]["hang"] is True
     assert rep["bf16_bs256_warm_cache"]["samples_per_sec"] == 100.0
 
@@ -453,7 +454,7 @@ def test_wedge_bisect_compile_side_verdict(monkeypatch, tmp_path):
 def test_wedge_bisect_all_green_says_reenable(monkeypatch, tmp_path):
     rc, rep = _run_wedge_sim(monkeypatch, tmp_path, {})
     assert rc == 0
-    assert "re-enable" in rep["verdict"]["text"]
+    assert rep["verdict"]["green"] is True
     # every experiment + its post-probe recorded durably
     for k in ("bf16_bs192", "bf16_bs256_no_donate", "twin_bf16_bs512",
               "bf16_bs256_cold_cache", "bf16_bs256_warm_cache",
@@ -466,8 +467,9 @@ def test_green_wedge_verdict_lifts_quarantine(monkeypatch, tmp_path):
     # a hang gets the normal outage-retry treatment instead of the
     # never-retry quarantine
     wp = tmp_path / "WEDGE_BISECT.json"
-    wp.write_text(json.dumps({"verdict": {"text":
-        "no wedge reproduced this window — re-enable the risky cells"}}))
+    wp.write_text(json.dumps({"verdict": {
+        "text": "no wedge reproduced this window — re-enable the risky "
+                "cells", "green": True}}))
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK, PROBE_TO, PROBE_OK],
         "resnet:256:bf16": [TO, OK],
@@ -480,8 +482,9 @@ def test_green_wedge_verdict_lifts_quarantine(monkeypatch, tmp_path):
 
 def test_non_green_wedge_verdict_keeps_quarantine(monkeypatch, tmp_path):
     wp = tmp_path / "WEDGE_BISECT.json"
-    wp.write_text(json.dumps({"verdict": {"text":
-        "EXECUTE-side wedge: the cell hangs even with a warm cache"}}))
+    wp.write_text(json.dumps({"verdict": {
+        "text": "EXECUTE-side wedge: the cell hangs even with a warm "
+                "cache", "green": False}}))
     rc, out = run_sim(monkeypatch, {
         "probe": [PROBE_OK, PROBE_OK],
         "resnet:256:bf16": [TO, OK],

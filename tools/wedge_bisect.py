@@ -141,9 +141,11 @@ def main():
     finally:
         shutil.rmtree(fresh_cache, ignore_errors=True)
 
-    # verdict synthesis
+    # verdict synthesis; ``green`` is the STRUCTURED field bench.py keys
+    # its quarantine lift on (the text is for humans)
     cold = report.get("bf16_bs256_cold_cache", {})
     warm = report.get("bf16_bs256_warm_cache", {})
+    green = False
     if cold.get("hang") and not warm.get("hang") and "error" not in warm:
         verdict = ("COMPILE-side wedge: cold-cache run hung, warm-cache "
                    "run green — the server-side compile is the fault")
@@ -152,11 +154,11 @@ def main():
                    "compile cache")
     elif "error" not in cold and "error" not in warm:
         verdict = ("no wedge reproduced this window — re-enable the "
-                   "risky cells (remove them from bench.py's `risky` "
-                   "set) and watch the next driver run")
+                   "risky cells and watch the next driver run")
+        green = True
     else:
         verdict = "inconclusive — see per-experiment entries"
-    record(report, "verdict", {"text": verdict})
+    record(report, "verdict", {"text": verdict, "green": green})
     print(f"\nVERDICT: {verdict}", flush=True)
     return 0
 
