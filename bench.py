@@ -398,11 +398,15 @@ def bench_vit(batch=64, warmup=3, iters=15, **cfg_overrides):
 
 def bench_pipeline_ab(d_model=512, n_layers=8, d_ff=2048, vocab_size=8192,
                       seq=256, mb=4, microbatches=16, pp=4):
-    """GPipe vs 1F1B on a pp4/dp2 virtual mesh: per-stage bubble
-    accounting (host schedule table) and AOT-compiled per-device memory
-    (the 1F1B selling point: activation stash O(pp) instead of O(M)).
-    No wall-clock — a CPU mesh says nothing about ICI timing; memory and
-    schedule structure are backend-independent."""
+    """GPipe vs 1F1B (both window endpoints) on a pp4/dp2 virtual mesh:
+    per-stage bubble accounting (host schedule table) and AOT-compiled
+    per-device memory for THREE cases — gpipe, 1f1b (default 2pp
+    window), 1f1b_minmem (classic pp window: least stash, half-rate
+    steady state). The 1F1B selling point is the stash: O(pp) instead
+    of O(M). No wall-clock — a CPU mesh says nothing about ICI timing;
+    memory and schedule structure are backend-independent. The cell's
+    timeout budget covers the three AOT compiles (~30s total on the
+    bench host's CPU)."""
     import jax
     from hetu_tpu.models import transformer as tfm
     from hetu_tpu.parallel import mesh as meshlib
@@ -424,10 +428,17 @@ def bench_pipeline_ab(d_model=512, n_layers=8, d_ff=2048, vocab_size=8192,
 
     out = {"config": {"d_model": d_model, "n_layers": n_layers, "pp": pp,
                       "microbatches": M, "seq": seq, "mb": mb},
-           "schedule": pplib.schedule_stats(pp, M)}
-    for label, make in (("gpipe", pplib.make_pipeline_train_step),
-                        ("1f1b", pplib.make_pipeline_train_step_1f1b)):
-        step = make(cfg, mesh, num_microbatches=M, lr=1e-3)
+           "schedule": pplib.schedule_stats(pp, M),
+           # the memory/duty tradeoff's other endpoint: classic 1F1B
+           # window (stash <= pp, half-rate steady state)
+           "schedule_minmem": pplib.schedule_stats(pp, M,
+                                                   max_inflight=pp)["1f1b"]}
+    cases = (("gpipe", pplib.make_pipeline_train_step, {}),
+             ("1f1b", pplib.make_pipeline_train_step_1f1b, {}),
+             ("1f1b_minmem", pplib.make_pipeline_train_step_1f1b,
+              {"max_inflight": pp}))
+    for label, make, kw in cases:
+        step = make(cfg, mesh, num_microbatches=M, lr=1e-3, **kw)
         ma = step.lower(p_sds, o_sds, tok, tok).compile().memory_analysis()
         peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
                 + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
@@ -533,7 +544,7 @@ def _run_section(name):
                    n_classes=10) if smoke else {})
         out = bench_vit(**kw)
     elif name == "pipeline":
-        # GPipe vs 1F1B A/B on an 8-device VIRTUAL CPU mesh (this cell
+        # GPipe vs 1F1B (x2 windows) on an 8-device VIRTUAL CPU mesh (cell
         # measures the schedules' memory law and bubble accounting, which
         # need pp>1 — the bench host has one chip; _run_section pins the
         # child to the CPU backend for exactly this section)
