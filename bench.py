@@ -548,9 +548,11 @@ def _run_section(name):
         # measures the schedules' memory law and bubble accounting, which
         # need pp>1 — the bench host has one chip; _run_section pins the
         # child to the CPU backend for exactly this section)
+        # smoke keeps microbatches > 2*pp so the minmem window actually
+        # binds (at M <= pp both windows yield the same table/ring)
         out = bench_pipeline_ab(**(dict(d_model=64, n_layers=4, d_ff=128,
                                         vocab_size=512, seq=32, mb=2,
-                                        microbatches=4) if smoke else {}))
+                                        microbatches=12) if smoke else {}))
     elif name == "probe":
         import jax
         import jax.numpy as jnp
