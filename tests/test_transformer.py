@@ -255,6 +255,14 @@ def test_1f1b_schedule_is_dependency_valid_and_stash_bounded():
         # the classic minimum-memory window still schedules validly
         lo = pplib.schedule_stats(pp, M, max_inflight=pp)
         assert lo["1f1b"]["peak_act_stash_per_stage"] <= min(pp, M)
+    # exact tick counts: a greedy-simulator regression that loosens the
+    # schedule shows up here before it shows up as lost throughput
+    assert {(pp, M): pplib.schedule_stats(pp, M)["1f1b"]["ticks"]
+            for pp, M in [(2, 1), (2, 4), (4, 3), (4, 8), (8, 16)]} == {
+        (2, 1): 4, (2, 4): 7, (4, 3): 10, (4, 8): 15, (8, 16): 31}
+    # the steady state really densifies: at M >> pp the slot bubble
+    # approaches 2(pp-1)/M (measured 9.9% at pp4/M64)
+    assert pplib.schedule_stats(4, 64)["1f1b"]["bubble_fraction"] < 0.12
 
 
 def test_1f1b_matches_gpipe_and_dense():
