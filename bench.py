@@ -108,6 +108,24 @@ def _bench_resnet18(batch_size, warmup, iters, dtype):
     return batch_size / dt, dt * 1000, _mfu(flops, dt)
 
 
+
+def _capture_trace(out, step_twice, trace_dir, label):
+    """Post-window jax.profiler capture shared by the LM cells (bert,
+    transformer/350): runs AFTER the timed window so tracing overhead
+    never pollutes the reported step time. An explicit ``trace_dir`` is
+    used as-is; the HETU_BENCH_TRACE env dir gains a per-section
+    ``label`` subdir so each cell's flame graph stays attributable."""
+    if not trace_dir:
+        env = os.environ.get("HETU_BENCH_TRACE")
+        trace_dir = os.path.join(env, label) if env else None
+    if not trace_dir:
+        return
+    import jax.profiler
+    with jax.profiler.trace(trace_dir):
+        step_twice()
+    out["trace"] = trace_dir
+
+
 def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None,
                trace_dir=None, **cfg_overrides):
     """BERT-base MLM+NSP pretrain step (BASELINE.md north star: 'BERT-base
@@ -170,19 +188,14 @@ def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None,
            "mlm_ce": "fused" if fused_ce else "einsum",
            "n_params": n_params}
 
-    # optional profiler trace (trace_dir arg, or HETU_BENCH_TRACE=dir): a
-    # below-target MFU number comes back with its own diagnosis — the
-    # trace shows whether the time went to attention, the MLM head, or
-    # data formatting. Captured AFTER the timed window so tracing
-    # overhead never pollutes the reported step time.
-    trace_dir = trace_dir or os.environ.get("HETU_BENCH_TRACE")
-    if trace_dir:
-        import jax.profiler
-        with jax.profiler.trace(trace_dir):
-            for _ in range(2):
-                loss, _, params, opt = step(params, opt, batch)
-            float(np.asarray(loss))
-        out["trace"] = trace_dir
+    def _two_steps():
+        nonlocal params, opt
+        loss = None
+        for _ in range(2):
+            loss, _, params, opt = step(params, opt, batch)
+        float(np.asarray(loss))
+
+    _capture_trace(out, _two_steps, trace_dir, "bert")
 
     # masked A/B: padded batches keep the fused kernel via the key-padding
     # bias (before round 4 a mask forced the unfused (B,nh,T,T) path)
@@ -264,6 +277,7 @@ def bench_decode(batch=8, prompt_len=16, max_len=256):
 
 
 def bench_transformer(cfg=None, batch=16, seq=512, warmup=3, iters=20,
+                      trace_dir=None, trace_label="transformer",
                       **cfg_overrides):
     import jax
     import jax.numpy as jnp
@@ -296,12 +310,21 @@ def bench_transformer(cfg=None, batch=16, seq=512, warmup=3, iters=20,
     flops_6nd = 6.0 * n_params * tokens
     flops_attn = _attn_flops(batch, seq, cfg.n_layers, cfg.d_model,
                              causal=True)
-    return {"tokens_per_sec": round(tokens / dt, 0),
-            "step_ms": round(dt * 1000, 2),
-            "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
-            "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
-            "attn_impl": tfm._resolve_attn_impl(cfg, None, seq),
-            "n_params": n_params}
+    out = {"tokens_per_sec": round(tokens / dt, 0),
+           "step_ms": round(dt * 1000, 2),
+           "mfu_6nd": round(_mfu(flops_6nd, dt), 4),
+           "mfu_attn_incl": round(_mfu(flops_6nd + flops_attn, dt), 4),
+           "attn_impl": tfm._resolve_attn_impl(cfg, None, seq),
+           "n_params": n_params}
+    def _two_steps():
+        nonlocal params, opt
+        loss = None
+        for _ in range(2):
+            loss, params, opt = step(params, opt, tok, tgt)
+        float(np.asarray(loss))
+
+    _capture_trace(out, _two_steps, trace_dir, trace_label)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -511,11 +534,18 @@ def _run_section(name):
             return tfm.TransformerConfig(remat=True,
                                          **(tiny if smoke else big), **kw)
 
+        # smoke exercises the trace path like the bert cell does (env
+        # runs get their per-section subdir from _capture_trace)
+        tdir350 = (os.path.join(tempfile.mkdtemp(prefix="hetu_bench_"),
+                                "trace")
+                   if smoke and not os.environ.get("HETU_BENCH_TRACE")
+                   else None)
         out = _with_fused_fallback(
             lambda **kw: bench_transformer(
                 cfg=cfg350(**kw), batch=2 if smoke else 8,
                 seq=64 if smoke else 512, warmup=1 if smoke else 2,
-                iters=2 if smoke else 8),
+                iters=2 if smoke else 8, trace_dir=tdir350,
+                trace_label="transformer350"),
             flag_name="fused_lm_ce")
     elif name == "decode":
         kw = dict(batch=2, prompt_len=4, max_len=16) if smoke else {}
@@ -529,8 +559,9 @@ def _run_section(name):
         if smoke:
             # smoke exercises the trace-capture path too (the real cell
             # only traces when the driver exports HETU_BENCH_TRACE)
-            tdir = os.environ.get("HETU_BENCH_TRACE") or os.path.join(
-                tempfile.mkdtemp(prefix="hetu_bench_"), "trace")
+            tdir = (os.path.join(tempfile.mkdtemp(prefix="hetu_bench_"),
+                                 "trace")
+                    if not os.environ.get("HETU_BENCH_TRACE") else None)
             out = _with_fused_fallback(
                 lambda **kw: bench_bert(batch_size=2, seq_len=64, warmup=1,
                                         iters=2, trace_dir=tdir, **tiny,
