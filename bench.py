@@ -124,6 +124,10 @@ def _capture_trace(out, step_twice, trace_dir, label):
     with jax.profiler.trace(trace_dir):
         step_twice()
     out["trace"] = trace_dir
+    # counted in-child: smoke trace dirs are TemporaryDirectories deleted
+    # when the section exits, so "did the trace land" must be recorded
+    # before cleanup (tests/test_bench_sections.py asserts on it)
+    out["trace_files"] = sum(len(fs) for _, _, fs in os.walk(trace_dir))
 
 
 def bench_bert(batch_size=32, seq_len=512, warmup=3, iters=15, cfg=None,
@@ -487,6 +491,20 @@ def _with_fused_fallback(fn, flag_name="fused_lm_ce"):
         return out
 
 
+@contextlib.contextmanager
+def _smoke_trace_dir(smoke):
+    """Trace dir for smoke runs, DELETED on exit — smoke only exercises the
+    capture path, and the former bare mkdtemp leaked a hetu_bench_* dir per
+    run. Yields None outside smoke (or when the driver exported
+    HETU_BENCH_TRACE: real runs get their per-section dir from
+    _capture_trace and must keep it)."""
+    if smoke and not os.environ.get("HETU_BENCH_TRACE"):
+        with tempfile.TemporaryDirectory(prefix="hetu_bench_") as td:
+            yield os.path.join(td, "trace")
+    else:
+        yield None
+
+
 def _run_section(name):
     """Child mode: compute ONE section, print one JSON object, exit.
     Runs in its own process so a hung compile (degraded tunnel) can be
@@ -536,17 +554,14 @@ def _run_section(name):
 
         # smoke exercises the trace path like the bert cell does (env
         # runs get their per-section subdir from _capture_trace)
-        tdir350 = (os.path.join(tempfile.mkdtemp(prefix="hetu_bench_"),
-                                "trace")
-                   if smoke and not os.environ.get("HETU_BENCH_TRACE")
-                   else None)
-        out = _with_fused_fallback(
-            lambda **kw: bench_transformer(
-                cfg=cfg350(**kw), batch=2 if smoke else 8,
-                seq=64 if smoke else 512, warmup=1 if smoke else 2,
-                iters=2 if smoke else 8, trace_dir=tdir350,
-                trace_label="transformer350"),
-            flag_name="fused_lm_ce")
+        with _smoke_trace_dir(smoke) as tdir350:
+            out = _with_fused_fallback(
+                lambda **kw: bench_transformer(
+                    cfg=cfg350(**kw), batch=2 if smoke else 8,
+                    seq=64 if smoke else 512, warmup=1 if smoke else 2,
+                    iters=2 if smoke else 8, trace_dir=tdir350,
+                    trace_label="transformer350"),
+                flag_name="fused_lm_ce")
     elif name == "decode":
         kw = dict(batch=2, prompt_len=4, max_len=16) if smoke else {}
         dtoks, dms = bench_decode(**kw)
@@ -559,14 +574,12 @@ def _run_section(name):
         if smoke:
             # smoke exercises the trace-capture path too (the real cell
             # only traces when the driver exports HETU_BENCH_TRACE)
-            tdir = (os.path.join(tempfile.mkdtemp(prefix="hetu_bench_"),
-                                 "trace")
-                    if not os.environ.get("HETU_BENCH_TRACE") else None)
-            out = _with_fused_fallback(
-                lambda **kw: bench_bert(batch_size=2, seq_len=64, warmup=1,
-                                        iters=2, trace_dir=tdir, **tiny,
-                                        **kw),
-                flag_name="fused_mlm_ce")
+            with _smoke_trace_dir(smoke) as tdir:
+                out = _with_fused_fallback(
+                    lambda **kw: bench_bert(batch_size=2, seq_len=64,
+                                            warmup=1, iters=2,
+                                            trace_dir=tdir, **tiny, **kw),
+                    flag_name="fused_mlm_ce")
         else:
             out = _with_fused_fallback(bench_bert, flag_name="fused_mlm_ce")
     elif name == "vit":
@@ -682,8 +695,9 @@ class _Ledger:
     tunnel death mid-run (it has happened three rounds straight) loses
     nothing: the next invocation — self-run or driver-run — reuses the
     recorded cells and spends its hardware minutes only on the missing
-    ones. The final JSON line merges ledger + fresh, flagging entries
-    recorded at a different git sha as stale. Smoke runs never open a
+    ones. The final JSON line merges ledger + fresh; entries recorded at
+    a different git sha are re-measured, not served (HETU_BENCH_REUSE_STALE
+    opts in, flagged). Smoke runs never open a
     ledger at all (main() passes an empty path): smoke exists to validate
     the section pipeline, and serving cached cells would defeat that.
     Reference analogue: PS load recording persists to log_path
@@ -704,8 +718,12 @@ class _Ledger:
                       file=sys.stderr)
 
     def reuse(self, key):
-        """A reusable entry is a SUCCESS; errors and hangs are always
-        re-attempted. Returns the result dict with an ``_ledger``
+        """A reusable entry is a SUCCESS recorded at THIS git sha; errors
+        and hangs are always re-attempted, and a cell from a different
+        commit is re-measured rather than fed into the merged headline
+        (HETU_BENCH_REUSE_STALE=1 opts back into serving it, flagged
+        ``stale`` — for triage runs on a dead backend, where an old number
+        beats none). Returns the result dict with an ``_ledger``
         provenance stamp, or None."""
         ent = self.cells.get(key)
         if not isinstance(ent, dict):
@@ -716,6 +734,13 @@ class _Ledger:
         out = dict(result)
         prov = {"ts": ent.get("ts")}
         if ent.get("sha") != self.sha:
+            # resilience.env_truthy's convention, re-inlined because this
+            # driver must stay jax-free (importing hetu_tpu pulls jax):
+            # REUSE_STALE=false means what it says
+            if os.environ.get("HETU_BENCH_REUSE_STALE",
+                              "").strip().lower() not in ("1", "true",
+                                                          "yes", "on"):
+                return None
             prov["stale"] = f"recorded at {ent.get('sha')}, HEAD is {self.sha}"
         out["_ledger"] = prov
         return out

@@ -32,6 +32,7 @@ from .cstable import CacheSparseTable
 # graph-level ops.ps MODULE under the name `ps`, shadowing hetu_tpu.ps
 from . import ps
 from . import optimizer as optim
+from . import resilience
 from . import lr_scheduler as lr
 from . import initializers as init
 from . import data

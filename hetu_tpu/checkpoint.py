@@ -66,9 +66,13 @@ def restore(path: str, like: Any = None, mesh=None, specs: Any = None):
         # raw numpy restore works regardless of which devices/processes
         # wrote the checkpoint (inspection, cross-world recovery)
         with ocp.PyTreeCheckpointer() as ckptr:
-            meta = ckptr.metadata(path).item_metadata.tree
+            meta = ckptr.metadata(path)
+            # orbax <=0.7 returns the metadata tree directly; newer wraps
+            # it in CheckpointMetadata.item_metadata.tree
+            item = getattr(meta, "item_metadata", None)
+            tree = getattr(item, "tree", None) if item is not None else meta
             args = jax.tree.map(
-                lambda m: ocp.RestoreArgs(restore_type=np.ndarray), meta)
+                lambda m: ocp.RestoreArgs(restore_type=np.ndarray), tree)
             return ckptr.restore(path, args=ocp.args.PyTreeRestore(
                 restore_args=args))
     with ocp.StandardCheckpointer() as ckptr:
@@ -90,8 +94,18 @@ class TrainCheckpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                                  create=True))
 
-    def save_step(self, step: int, state: Any) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save_step(self, step: int, state: Any, force: bool = False) -> None:
+        """Write the checkpoint for ``step`` and block until durable.
+        ``force=True`` overwrites an existing checkpoint at the same step —
+        the preemption-emergency path (resilience.Supervisor.save) may land
+        on a step the periodic cadence already wrote, and losing the save
+        to a refusal would lose the preemption guarantee. (orbax's own
+        ``force`` only bypasses should_save policies; an existing step still
+        raises StepAlreadyExistsError, so it is deleted first — older
+        retained steps stay untouched if the rewrite dies midway.)"""
+        if force and step in (self._mgr.all_steps() or ()):
+            self._mgr.delete(step)
+        self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:

@@ -70,6 +70,37 @@ class Dataloader:
 
     _peeked: Optional[np.ndarray] = None
 
+    # -- resume support (resilience layer) ---------------------------------
+    def state_dict(self) -> dict:
+        """Epoch position as a flat dict of numpy arrays (checkpointable by
+        TrainCheckpointer): cursor, shuffle order, MT19937 RNG position, and
+        any peeked-but-unconsumed batch — restoring reproduces the exact
+        batch sequence an uninterrupted run would have seen."""
+        key, pos, has_gauss, cached = self._rng.get_state()[1:5]
+        d = {"cursor": np.asarray(self._cursor, np.int64),
+             "order": np.asarray(self._order),
+             "rng_key": np.asarray(key),
+             "rng_pos": np.asarray(pos, np.int64),
+             "rng_has_gauss": np.asarray(has_gauss, np.int64),
+             "rng_cached_gaussian": np.asarray(cached, np.float64)}
+        if self._peeked is not None:
+            d["peeked"] = np.asarray(self._peeked)
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        order = np.asarray(d["order"])
+        if order.shape != self._order.shape:
+            raise ValueError(
+                f"dataloader state has {order.shape[0]} samples, this "
+                f"loader has {self._order.shape[0]} — restoring onto a "
+                "different dataset/sharding would silently skew batches")
+        self._order = order.copy()
+        self._cursor = int(d["cursor"])
+        self._rng.set_state(("MT19937", np.asarray(d["rng_key"], np.uint32),
+                             int(d["rng_pos"]), int(d["rng_has_gauss"]),
+                             float(d["rng_cached_gaussian"])))
+        self._peeked = (np.asarray(d["peeked"]) if "peeked" in d else None)
+
     def get_arr(self) -> np.ndarray:
         if self._peeked is not None:
             batch, self._peeked = self._peeked, None
@@ -114,6 +145,14 @@ class DataloaderOp(Op):
     def set_dp_rank(self, rank, nrank):
         for d in self.dataloaders.values():
             d.init_states(rank, nrank)
+
+    def state_dict(self, name) -> Optional[dict]:
+        dl = self.dataloaders.get(name)
+        return None if dl is None else dl.state_dict()
+
+    def load_state_dict(self, name, d) -> None:
+        if name in self.dataloaders:
+            self.dataloaders[name].load_state_dict(d)
 
     def compute(self, input_vals, tc):
         raise AssertionError("Dataloader batches are supplied by the executor")

@@ -55,7 +55,7 @@ class HetuConfig:
                  cstable_policy=None, bsp=False, prefetch=True, enable_lazy=False,
                  cache_bound=100, log_path=None, gpipe=False,
                  gpipe_microbatches=None, dtype=np.float32,
-                 dp_axis="dp", mp_axis="tp", **kwargs):
+                 dp_axis="dp", mp_axis="tp", anomaly_guard=False, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -81,6 +81,18 @@ class HetuConfig:
         self.compute_dtype = self.dtype
         self.dp_axis = dp_axis
         self.mp_axis = mp_axis
+        # resilience: in-trace finite-check gating the state commit (see
+        # hetu_tpu/resilience.py). A NaN/Inf loss, parameter update or slot
+        # leaves params/slots/op-state bit-identical to pre-step.
+        from ..resilience import env_truthy
+        self.anomaly_guard = bool(anomaly_guard) \
+            or env_truthy("HETU_ANOMALY_GUARD")
+        if self.anomaly_guard and comm_mode in ("PS", "Hybrid"):
+            raise ValueError(
+                "anomaly_guard gates the on-device state commit, but PS-"
+                "hosted parameters update server-side per gradient push and "
+                "cannot be skipped after the fact — run PS/Hybrid jobs "
+                "without the guard")
         if mesh is not None and not isinstance(mesh, Mesh):
             raise ValueError(
                 f"mesh must be a jax.sharding.Mesh, got {type(mesh).__name__}")
@@ -341,6 +353,8 @@ class SubExecutor:
         self.dataloader_nodes = [n for n in self.topo if n.is_dataloader]
         self.stateful_nodes = [n for n in self.topo if n.stateful]
         self.optimizer_nodes = [n for n in self.topo if n.is_optimizer]
+        # finite-check + gated commit only makes sense where state commits
+        self.anomaly_guard = self.training and self.config.anomaly_guard
         self._compiled: dict[tuple, Any] = {}
         self._last_call = None  # (jitted fn, args) of the latest run
         # device-side input double buffer: id(node) -> (host batch, device arr)
@@ -480,9 +494,11 @@ class SubExecutor:
                 return v.astype(compute_dtype)
             return v
 
+        guard = self.anomaly_guard
+
         def step_fn(params_t, slots_t, opstate_t, rng_root, step, feeds_t,
                     batches_t, dl_cursors_t, res_data_t, ps_staged_t,
-                    ps_dense_t):
+                    ps_dense_t, inject_nan_t):
             # fold the step into the rng INSIDE the trace: doing it eagerly
             # costs ~5 dispatched host ops per step (measured ~3ms over the
             # tunneled chip; free here)
@@ -537,7 +553,45 @@ class SubExecutor:
             new_opstate = tuple(tc.op_state_updates.get(id(n), op_state_in[id(n)])
                                 for n in stateful_nodes)
             ps_grads = tuple(tc.ps_grad_outputs[id(op)] for op in ps_comm_ops)
-            return outputs, new_params, new_slots, new_opstate, ps_grads
+            finite = jnp.bool_(True)
+            if guard:
+                # -- anomaly guard (resilience layer) ----------------------
+                # inject_nan_t is the deterministic fault hook: poison the
+                # update BEFORE the finite-check, so the guard path is
+                # exercised end to end (a scalar arg — no retrace per step)
+                def is_float(v):
+                    return (hasattr(v, "dtype")
+                            and jnp.issubdtype(v.dtype, jnp.floating))
+
+                new_params = tuple(
+                    jnp.where(inject_nan_t, jnp.full_like(p, jnp.nan), p)
+                    if is_float(p) else p for p in new_params)
+                checks = [jnp.all(jnp.isfinite(v)) for v in outputs
+                          if is_float(v)]
+                checks += [jnp.all(jnp.isfinite(p)) for p in new_params
+                           if is_float(p)]
+                for s in new_slots + new_opstate:
+                    checks += [jnp.all(jnp.isfinite(l))
+                               for l in jax.tree.leaves(s) if is_float(l)]
+                if checks:
+                    finite = jnp.all(jnp.stack(checks))
+
+                # gate the whole commit: an anomalous step leaves params,
+                # slots and op state bit-identical to pre-step
+                def keep(new, old):
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(finite, a, b), new, old)
+
+                new_params = tuple(
+                    jnp.where(finite, p, masters[id(n)])
+                    for p, n in zip(new_params, param_nodes))
+                new_slots = tuple(keep(s, slots_in[id(n)])
+                                  for s, n in zip(new_slots, opt_nodes))
+                new_opstate = tuple(
+                    keep(s, op_state_in[id(n)])
+                    for s, n in zip(new_opstate, stateful_nodes))
+            return outputs, new_params, new_slots, new_opstate, ps_grads, \
+                finite
 
         # HETU_NO_DONATE=1: bisect knob for the bench wedge harness
         # (tools/wedge_bisect.py) — donation changes XLA's buffer
@@ -606,6 +660,11 @@ class SubExecutor:
         ex = self.executor
         prof = self._profile  # HETU_PROFILE=1: per-phase wall-time ledger
         t_run0 = time.perf_counter() if prof is not None else 0.0
+        # resilience supervisor (watchdog beat, host fault injection);
+        # training targets only — an eval pass is not a supervised step
+        sup = getattr(ex, "supervisor", None) if self.training else None
+        if sup is not None:
+            sup.pre_step(ex, self, ex.state["step"])
         feed_dict = feed_dict or {}
         feed_vals = []
         for node in self.feed_nodes:
@@ -694,12 +753,16 @@ class SubExecutor:
 
         res_data = tuple(self.resident_dl[id(n)][0]
                          for n in self.res_dl_nodes)
+        inject_nan = bool(self.anomaly_guard and sup is not None
+                          and sup.inject_nan(step))
         args = (params_t, slots_t, opstate_t, ex.rng_root, np.int32(step),
                 tuple(feed_vals), tuple(batch_vals), tuple(dl_cursors),
-                res_data, tuple(ps_staged_vals), tuple(ps_dense_vals))
+                res_data, tuple(ps_staged_vals), tuple(ps_dense_vals),
+                np.bool_(inject_nan))
         self._last_call = (fn, args)
         t_d0 = time.perf_counter() if prof is not None else 0.0
-        outputs, new_params, new_slots, new_opstate, ps_grads = fn(*args)
+        outputs, new_params, new_slots, new_opstate, ps_grads, finite_t = \
+            fn(*args)
         t_d1 = time.perf_counter() if prof is not None else 0.0
         if prof is not None:
             prof["dispatch_s"] += t_d1 - t_d0
@@ -759,9 +822,27 @@ class SubExecutor:
                 ex.state["op_state"][id(node)] = val
             ex.state["step"] = step + 1
 
+        finite = True
+        if self.anomaly_guard:
+            # materializing the scalar syncs on the step — the documented
+            # cost of the guard (callers reading the loss sync anyway)
+            finite = bool(np.asarray(finite_t))
+            if finite:
+                ex.state["anomaly_streak"] = 0
+            else:
+                ex.state["anomaly_streak"] += 1
+                ex.state["anomaly_total"] += 1
+            ex.state["last_step_finite"] = finite
+
         if prof is not None:
             prof["poststep_s"] += time.perf_counter() - t_d1
             prof["steps"] += 1
+
+        # post-step supervision LAST: a rollback rewrites ex.state, an
+        # emergency save captures it, and Preempted aborts the return — all
+        # only valid after the commit above
+        if sup is not None:
+            sup.post_step(ex, self, step, finite=finite)
 
         results = []
         wanted = eval_node_list if eval_node_list is not None else self.eval_nodes
@@ -864,7 +945,12 @@ class Executor:
             if node.stateful:
                 op_state[id(node)] = jax.tree.map(jnp.asarray, node.state_init())
         self.state = {"params": params, "slots": slots, "op_state": op_state,
-                      "step": 0}
+                      "step": 0,
+                      # resilience counters (anomaly_guard):
+                      "anomaly_streak": 0, "anomaly_total": 0,
+                      "last_step_finite": True}
+        # resilience.Supervisor hook point (attach_supervisor)
+        self.supervisor = None
 
         self.subexecutors = {}
         for name, nodes in self.eval_node_dict.items():
@@ -974,6 +1060,14 @@ class Executor:
             return jax.device_put(arr, self.config.device)
         return jnp.asarray(arr)
 
+    def attach_supervisor(self, sup):
+        """Attach a ``resilience.Supervisor``: its pre_step/post_step hooks
+        then run at every training-step boundary (watchdog beat, fault
+        injection, anomaly rollback, periodic + emergency checkpoints,
+        preemption exit). Pass None to detach. Returns ``sup``."""
+        self.supervisor = sup
+        return sup
+
     @property
     def rank(self) -> int:
         """Reference examples gate printing on ``executor.rank``; the
@@ -1041,20 +1135,25 @@ class Executor:
         with open(os.path.join(file_path, "executor_state.pkl"), "wb") as f:
             pickle.dump(aux, f)
 
+    def _place_param(self, node, value):
+        """A host value as this parameter's device/mesh-resident array (the
+        same placement rule as init/load; shared with resilience restore)."""
+        value = jnp.asarray(value, dtype=node.dtype)
+        if self.config.mesh is not None:
+            spec = self.config.param_specs.get(id(node), P())
+            value = jax.device_put(value, NamedSharding(self.config.mesh, spec))
+        elif self.config.device is not None:
+            value = jax.device_put(value, self.config.device)
+        return value
+
     def load(self, file_path: str):
         if self.ps_runtime is not None:
             self.ps_runtime.load(file_path)
         for node, fname in zip(self.param_nodes, self._param_file_names()):
             path = os.path.join(file_path, fname + ".npy")
             if os.path.exists(path):
-                value = jnp.asarray(np.load(path), dtype=node.dtype)
-                if self.config.mesh is not None:
-                    spec = self.config.param_specs.get(id(node), P())
-                    value = jax.device_put(
-                        value, NamedSharding(self.config.mesh, spec))
-                elif self.config.device is not None:
-                    value = jax.device_put(value, self.config.device)
-                self.state["params"][id(node)] = value
+                self.state["params"][id(node)] = self._place_param(
+                    node, np.load(path))
         aux_path = os.path.join(file_path, "executor_state.pkl")
         if os.path.exists(aux_path):
             with open(aux_path, "rb") as f:

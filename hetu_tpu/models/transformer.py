@@ -349,7 +349,7 @@ def _attention_core(q, k, v, cfg: TransformerConfig, mesh, impl,
             kb = jnp.broadcast_to(kb, (q.shape[0], kb.shape[1]))
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention
-        from jax import shard_map
+        from ..utils import shard_map
         spec = P("dp", "tp", "sp", None)
         fn_part = functools.partial(ring_attention, axis_name="sp",
                                     causal=cfg.causal)

@@ -30,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils import shard_map
 
 
 def partition_adjacency(rows: np.ndarray, cols: np.ndarray,

@@ -83,7 +83,16 @@ def initialize(coordinator_address: Optional[str] = None,
         # must happen before the backend initializes; a sitecustomize may pin
         # another platform, so config updates, not env vars (see conftest)
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", int(local_device_count))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(local_device_count))
+        except AttributeError:
+            # jax 0.4.x predates the config option; the XLA flag read at
+            # backend init is its exact equivalent (backend not yet live
+            # here — initialize() is the process's first jax touch)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{int(local_device_count)}")
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
@@ -121,11 +130,34 @@ def host_local_batch(mesh: Mesh, spec: P, host_data: np.ndarray):
         NamedSharding(mesh, spec), np.asarray(host_data))
 
 
-def barrier(name: str = "hetu_barrier") -> None:
+def barrier(name: str = "hetu_barrier",
+            deadline_s: Optional[float] = None) -> None:
     """Block until every process arrives (reference: PS worker barrier /
-    MPI_Barrier)."""
+    MPI_Barrier).
+
+    ``deadline_s`` arms a one-shot hang watchdog around the wait: a barrier
+    a dead peer will never reach dumps thread stacks and aborts with
+    ``resilience.EXIT_WATCHDOG`` instead of hanging the job forever (the
+    supervising launcher then restarts from the latest checkpoint)."""
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    if deadline_s is None:
+        multihost_utils.sync_global_devices(name)
+        return
+    from ..resilience import Watchdog
+    with Watchdog(deadline_s) as wd:
+        wd.beat(phase=f"barrier:{name}")
+        multihost_utils.sync_global_devices(name)
+
+
+def any_process_flag(flag) -> bool:
+    """True iff ANY process passed a truthy flag — the coordinated-decision
+    primitive for preemption (one host gets SIGTERM; every host must join
+    the emergency checkpoint at the same step or the collective write
+    deadlocks). Plain local bool outside a multi-process world."""
+    if not _initialized or jax.process_count() <= 1:
+        return bool(flag)
+    flags = process_allgather(np.asarray(bool(flag), np.int32))
+    return bool(np.max(flags) > 0)
 
 
 def process_allgather(x):

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import transformer as tfm
+from ..utils import pvary, shard_map
 
 
 def _stack_stages(params, pp: int):
@@ -73,8 +74,7 @@ def _make_stage_fn(cfg: tfm.TransformerConfig, layers_per_stage: int):
             h, a = block(h, layer_params, dropout_rng=rng)
             return (h, aux + a), None
 
-        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("pp",),
-                             to="varying")
+        aux0 = pvary(jnp.zeros((), jnp.float32), ("pp",))
         (h, aux), _ = jax.lax.scan(
             body, (h, aux0), (stage_blocks, jnp.arange(layers_per_stage)))
         return h, aux
@@ -179,7 +179,7 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
             n_ticks = M + pp - 1
             # carries vary per pp-shard: mark them 'varying' for the vma type
             # system before entering the scan
-            varying = lambda x: jax.lax.pcast(x, ("pp",), to="varying")
+            varying = lambda x: pvary(x, ("pp",))
             state = varying(state0)
             loss_sum = varying(jnp.zeros((), jnp.float32))
             aux_sum = varying(jnp.zeros((), jnp.float32))
@@ -229,7 +229,7 @@ def make_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
         if dropout_rng is not None:
             in_specs.append(P())
             args.append(dropout_rng)
-        return jax.shard_map(
+        return shard_map(
             pipelined,
             mesh=mesh,
             in_specs=tuple(in_specs),
@@ -279,8 +279,13 @@ def init_pipeline_params(rng, cfg: tfm.TransformerConfig, mesh: Mesh):
 def resolve_inflight_window(pp: int, max_inflight: int = None) -> int:
     """The one place the dual-slot window defaults to 2*pp — the
     simulator, the stats, and the step builder's ring depth must agree
-    or the table and the activation ring drift apart."""
-    return max_inflight or 2 * pp
+    or the table and the activation ring drift apart. Only None means
+    "default" (a former ``or`` silently turned an explicit 0 into 2*pp);
+    sub-1 windows cannot schedule anything and are rejected."""
+    window = 2 * pp if max_inflight is None else int(max_inflight)
+    if window < 1:
+        raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    return window
 
 
 def simulate_1f1b_schedule(pp: int, num_microbatches: int,
@@ -510,7 +515,7 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
             local_blocks = jax.tree.map(lambda x: x[0], stage_blocks)
             perm_f = [(i, (i + 1) % pp) for i in range(pp)]
             perm_b = [(i, (i - 1) % pp) for i in range(pp)]
-            varying = lambda x: jax.lax.pcast(x, ("pp",), to="varying")
+            varying = lambda x: pvary(x, ("pp",))
 
             zero_act = jnp.zeros((B, T, cfg.d_model), cfg.dtype)
             carry0 = (
@@ -721,7 +726,7 @@ def make_pipeline_train_step_1f1b(cfg: tfm.TransformerConfig, mesh: Mesh,
         if dropout_rng is not None:
             in_specs.append(P())
             args.append(dropout_rng)
-        loss, g_blocks, g_other = jax.shard_map(
+        loss, g_blocks, g_other = shard_map(
             pipelined,
             mesh=mesh,
             in_specs=tuple(in_specs),

@@ -65,6 +65,27 @@ def reap_light_procs(procs, timeout: float = 15.0):
             p.wait()
 
 
+def resolve_test_kill_index(n_servers: int):
+    """The ``HETU_PS_TEST_KILL_SERVER`` fault hook's gate + bounds check.
+
+    Follows the resilience fault-injection convention (HETU_FAULT_SPEC):
+    destructive test hooks are INERT unless ``HETU_TEST_MODE`` is explicitly
+    truthy, so an env var leaked from a test session cannot SIGKILL a real
+    server. In test mode an out-of-range index is a hard error — silently
+    killing the wrong process (or IndexError-ing into the scheduler slot)
+    would make the fault test meaningless."""
+    from ..resilience import test_mode_enabled
+    raw = os.environ.get("HETU_PS_TEST_KILL_SERVER")
+    if raw is None or not test_mode_enabled():
+        return None
+    idx = int(raw)
+    if not 0 <= idx < n_servers:
+        raise ValueError(
+            f"HETU_PS_TEST_KILL_SERVER={raw} out of range for "
+            f"{n_servers} servers")
+    return idx
+
+
 @contextlib.contextmanager
 def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
     """Spawn scheduler + servers, set THIS process up as worker 0, yield.
@@ -90,9 +111,10 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
         # that can never complete registration. The section-subprocess
         # group-kill is the only thing standing between this and a hung
         # bench cell — tests/test_bench_driver.py pins that it holds.
-        kill_idx = os.environ.get("HETU_PS_TEST_KILL_SERVER")
+        # Gated on HETU_TEST_MODE + bounds-checked (resolve_test_kill_index).
+        kill_idx = resolve_test_kill_index(n_servers)
         if kill_idx is not None:
-            victim = procs[1 + int(kill_idx)]
+            victim = procs[1 + kill_idx]
             victim.kill()
             victim.wait()
         os.environ.update(base)

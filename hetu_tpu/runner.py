@@ -19,19 +19,43 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 
 import yaml
+
+# mirrored from hetu_tpu.resilience (EXIT_PREEMPTED/EXIT_WATCHDOG) without
+# importing the package here: the launcher parent must stay jax-free
+EXIT_PREEMPTED = 75
+EXIT_WATCHDOG = 85
 
 _procs: list = []
 _shells: list = []
 
 
 def _signal_handler(sig, frame):
+    """Preemption-aware teardown: forward the signal to the WORKERS first so
+    their resilience.PreemptionHandler can take the emergency checkpoint,
+    give them a grace window, then tear down the PS roles. Exits with
+    EXIT_PREEMPTED on SIGTERM (the cluster-level 'preempted cleanly' code)
+    and the conventional 130 on SIGINT."""
     for p in _shells:
-        p.terminate()
+        if p.poll() is None:
+            try:
+                p.send_signal(sig)
+            except OSError:
+                pass
+    grace = float(os.environ.get("HETU_PREEMPT_GRACE_S", "30"))
+    deadline = time.time() + grace
+    for p in _shells:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            # SIGKILL: it already had the SIGTERM + grace window — a wedged
+            # worker must not outlive the launcher as an orphan
+            p.kill()
     for p in _procs:
         p.terminate()
-    sys.exit(0)
+    sys.exit(EXIT_PREEMPTED if sig == signal.SIGTERM else 130)
 
 
 def _get_available_port(addr: str) -> int:
@@ -72,11 +96,18 @@ def _server_entry(server_id, env):
 
 def main(argv=None):
     signal.signal(signal.SIGINT, _signal_handler)
+    signal.signal(signal.SIGTERM, _signal_handler)
     parser = argparse.ArgumentParser(prog="heturun")
     parser.add_argument("-c", "--config", required=True,
                         help="cluster yaml (nodes: host/servers/workers/chief)")
     parser.add_argument("-i", "--identify", default="",
                         help="SSH identity file for multi-machine launch")
+    parser.add_argument("-r", "--max-restarts", type=int, default=0,
+                        help="restart a worker that exits with a recoverable "
+                             "(nonzero, non-preempted) code up to N times "
+                             "total, with exponential backoff — workers "
+                             "resume from their checkpointer (single-host "
+                             "mode; see docs/FAULT_TOLERANCE.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -107,21 +138,84 @@ def main(argv=None):
                 _procs.append(ctx.Process(target=_server_entry, args=(i, env)))
             for p in _procs:
                 p.start()
-        for w in range(num_workers):
+        def spawn_worker(w):
             wenv = dict(env)
             wenv["WORKER_ID"] = str(w)
             if enable_ps:
                 wenv["DMLC_ROLE"] = "worker"
             # multi-chip single host: each worker is one jax process
             wenv["HETU_NUM_WORKER"] = str(num_workers)
-            _shells.append(subprocess.Popen(args.command, env=wenv))
-        rc = 0
-        for p in _shells:
-            rc |= p.wait()
+            p = subprocess.Popen(args.command, env=wenv)
+            _shells.append(p)   # visible to the signal handler
+            return p
+
+        running = {w: spawn_worker(w) for w in range(num_workers)}
+        respawn_at = {}   # worker id -> monotonic deadline (backoff pending)
+        restarts, delay = 0, 2.0
+        rc_final, preempted = 0, False
+        teardown_at = None
+        while running or respawn_at:
+            for w, p in list(running.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del running[w]
+                if rc == 0:
+                    continue
+                if rc == EXIT_PREEMPTED:
+                    # clean preemption: emergency checkpoint written; never
+                    # counted against the restart budget
+                    preempted = True
+                    continue
+                if restarts < args.max_restarts:
+                    restarts += 1
+                    print(f"# heturun: worker {w} exited rc={rc}; auto-"
+                          f"resume restart {restarts}/{args.max_restarts} "
+                          f"in {delay:.0f}s", file=sys.stderr, flush=True)
+                    # deadline, not an inline sleep: other workers' exits
+                    # (preemption!) must keep being reaped during backoff
+                    respawn_at[w] = time.monotonic() + delay
+                    delay *= 2
+                elif not rc_final:
+                    # first failure wins: survivors killed by the teardown
+                    # below exit -15, which must not mask the real code
+                    rc_final = rc
+            now = time.monotonic()
+            if rc_final:
+                # a permanently failed worker strands the survivors in
+                # dead-rank collectives — preempt them (SIGTERM so they can
+                # emergency-checkpoint, then terminate after the grace
+                # window) instead of polling forever
+                respawn_at.clear()
+                if teardown_at is None:
+                    print(f"# heturun: worker failed rc={rc_final} with no "
+                          "restart budget; preempting remaining workers",
+                          file=sys.stderr, flush=True)
+                    for p in running.values():
+                        if p.poll() is None:
+                            try:
+                                p.send_signal(signal.SIGTERM)
+                            except OSError:
+                                pass
+                    teardown_at = now + float(
+                        os.environ.get("HETU_PREEMPT_GRACE_S", "30"))
+                elif now >= teardown_at:
+                    for p in running.values():
+                        if p.poll() is None:
+                            # SIGKILL, not terminate(): a worker wedged in a
+                            # hung collective already ignored the SIGTERM
+                            p.kill()
+            for w, when in list(respawn_at.items()):
+                if now >= when:
+                    del respawn_at[w]
+                    running[w] = spawn_worker(w)
+            if running or respawn_at:
+                time.sleep(0.2)
         for p in _procs:
             p.terminate()
             p.join(timeout=10)
-        sys.exit(rc)
+        sys.exit(rc_final if rc_final else
+                 (EXIT_PREEMPTED if preempted else 0))
     else:
         # multi-machine: ssh remote roles; workers get jax.distributed
         # coordinator env (reference: paramiko remote PS + mpirun -host)

@@ -6,6 +6,47 @@ import os
 import jax
 
 
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              axis_names=None, **kwargs):
+    """``jax.shard_map`` across jax versions: newer jax exports it at top
+    level (manual axes named via ``axis_names``); 0.4.x only has
+    ``jax.experimental.shard_map``, where the same intent is spelled as its
+    complement (``auto`` = the axes NOT manual).
+
+    Known 0.4.x limit: forward-only and fully-manual programs work
+    (ring attention, DistGCN), but differentiating through a PARTIAL-auto
+    shard_map (the pp-pipeline step builders) still trips 0.4.x's
+    experimental autodiff — those paths need the newer jax the seed was
+    written against."""
+    if hasattr(jax, "shard_map"):
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    # 0.4.x's replication checker predates the varying-manual-axes (vma)
+    # type system the pipeline carries rely on (pvary below is an identity
+    # there) — it would reject those programs, so it is off by default
+    kwargs.setdefault("check_rep", False)
+    return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` device-varying over the named manual axes: newer jax's
+    ``lax.pcast(..., to="varying")`` feeds the vma type system; on jax
+    without it this is an identity (no vma tracking to satisfy)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
 def ensure_devices(n_devices: int) -> None:
     """Ensure >= n_devices jax devices exist, forcing a virtual CPU mesh if
     the host has fewer real chips (the reference requires a physical GPU per

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from hetu_tpu.utils import shard_map
 
 from hetu_tpu.kernels.flash_attention import flash_attention, mha_reference
 from hetu_tpu.parallel.ring_attention import ring_attention

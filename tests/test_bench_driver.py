@@ -326,12 +326,33 @@ def test_ledger_error_cells_are_rerun(monkeypatch, tmp_path):
     assert "from_ledger" not in out["detail"]
 
 
-def test_ledger_stale_sha_is_flagged_but_reused(monkeypatch, tmp_path):
+def test_ledger_stale_sha_is_remeasured_not_reused(monkeypatch, tmp_path):
+    # a cell recorded at another commit must not feed the merged headline:
+    # the section re-runs at HEAD and the fresh number replaces the old one
     lp = tmp_path / "ledger.json"
     lp.write_text(json.dumps({"cells": {
         "resnet18_bf16_bs128": {"result": {"samples_per_sec": 77.0},
                                 "smoke": False, "sha": "0000000", "ts": "t"},
     }}))
+    rc, out = run_sim(monkeypatch, {}, ledger_path=lp)
+    cell = out["detail"]["resnet18_bf16_bs128"]
+    assert cell["samples_per_sec"] == 50.0        # DEFAULT: section re-ran
+    assert "from_ledger" not in out["detail"]
+    # the re-measurement was recorded at HEAD's sha
+    saved = json.loads(lp.read_text())["cells"]["resnet18_bf16_bs128"]
+    assert saved["sha"] != "0000000"
+    assert saved["result"]["samples_per_sec"] == 50.0
+
+
+def test_ledger_stale_sha_reused_only_with_optin(monkeypatch, tmp_path):
+    # triage escape hatch (dead backend, any number beats none): explicit
+    # env opt-in serves the stale cell, flagged as such
+    lp = tmp_path / "ledger.json"
+    lp.write_text(json.dumps({"cells": {
+        "resnet18_bf16_bs128": {"result": {"samples_per_sec": 77.0},
+                                "smoke": False, "sha": "0000000", "ts": "t"},
+    }}))
+    monkeypatch.setenv("HETU_BENCH_REUSE_STALE", "1")
     rc, out = run_sim(monkeypatch, {}, ledger_path=lp)
     cell = out["detail"]["resnet18_bf16_bs128"]
     assert cell["samples_per_sec"] == 77.0
@@ -383,6 +404,9 @@ def test_wdl_dead_server_cannot_outlive_group_kill(monkeypatch):
     monkeypatch.setenv("HETU_BENCH_SMOKE", "1")
     monkeypatch.setenv("PYTHONPATH", "")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # the kill hook follows the resilience fault-injection convention:
+    # inert unless HETU_TEST_MODE is explicitly set
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
     monkeypatch.setenv("HETU_PS_TEST_KILL_SERVER", "1")
     before = _light_main_count()
     t0 = _time.time()

@@ -37,14 +37,17 @@ def test_save_restore_across_meshes(tmp_path):
 
 
 def test_raw_restore_without_target(tmp_path):
+    # 0-d arrays, not numpy scalars: orbax's standard handler rejects
+    # np.int32(7)-style scalar instances
     state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
-             "step": np.int32(7)}
+             "step": np.asarray(7, np.int32)}
     checkpoint.save(tmp_path / "raw", state)
     # refuses to clobber by default; force=True overwrites in place
     with pytest.raises(ValueError):
         checkpoint.save(tmp_path / "raw", state)
     checkpoint.save(tmp_path / "raw", {"w": state["w"] * 2,
-                                       "step": np.int32(8)}, force=True)
+                                       "step": np.asarray(8, np.int32)},
+                    force=True)
     out = checkpoint.restore(tmp_path / "raw")
     np.testing.assert_array_equal(out["w"], state["w"] * 2)
     assert int(out["step"]) == 8

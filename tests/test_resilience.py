@@ -1,0 +1,467 @@
+"""Training supervision layer (hetu_tpu/resilience.py): anomaly detection
+with bit-identical NaN-skip and rollback, preemption-safe emergency
+checkpointing with exact-step resume, the hang watchdog's stack dump, and
+supervise() restart-with-backoff — every path driven by the deterministic
+fault-injection hook on the CPU backend.
+"""
+import io
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import resilience as rs
+from hetu_tpu.checkpoint import TrainCheckpointer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared tiny training job (graph API, deterministic)
+# ---------------------------------------------------------------------------
+
+def build_job(seed=0, anomaly_guard=True, shuffle=True):
+    """2-layer softmax regression over a dataloader; returns (executor,
+    feed-free run closure). Deterministic: fixed seeds everywhere."""
+    rng = np.random.RandomState(7)
+    data_x = rng.randn(64, 6).astype(np.float32)
+    data_y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 64)]
+    x = ht.dataloader_op([ht.Dataloader(data_x, 16, "train",
+                                        shuffle=shuffle, seed=11)])
+    y_ = ht.dataloader_op([ht.Dataloader(data_y, 16, "train",
+                                         shuffle=shuffle, seed=11)])
+    w = ht.init.random_normal((6, 3), stddev=0.5, name="w")
+    b = ht.init.zeros((3,), name="b")
+    h = ht.matmul_op(x, w)
+    logits = h + ht.broadcastto_op(b, h)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=seed,
+                     anomaly_guard=anomaly_guard)
+    return ex
+
+
+def params_snapshot(ex):
+    return {n.name: np.asarray(ex.state["params"][id(n)]).copy()
+            for n in ex.param_nodes}
+
+
+# ---------------------------------------------------------------------------
+# fault injection: spec parsing + gating
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    fi = rs.FaultInjector("nan_grads@3, stall@5:2.5, sigterm@7")
+    assert fi.fires("nan_grads", 3)
+    assert not fi.fires("nan_grads", 3)      # one-shot
+    assert not fi.fires("nan_grads", 4)
+    e = fi.take("stall", 5)
+    assert e["arg"] == 2.5
+    with pytest.raises(ValueError):
+        rs.FaultInjector("teleport@3")
+    with pytest.raises(ValueError):
+        rs.FaultInjector("nan_grads3")
+
+
+def test_fault_env_is_inert_without_test_mode(monkeypatch):
+    monkeypatch.setenv("HETU_FAULT_SPEC", "nan_grads@0")
+    monkeypatch.delenv("HETU_TEST_MODE", raising=False)
+    assert rs.FaultInjector.from_env() is None      # leaked spec: inert
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    assert rs.FaultInjector.from_env() is not None
+
+
+def test_ps_kill_hook_gated_and_bounds_checked(monkeypatch):
+    from hetu_tpu.ps.local_cluster import resolve_test_kill_index
+    monkeypatch.setenv("HETU_PS_TEST_KILL_SERVER", "1")
+    monkeypatch.delenv("HETU_TEST_MODE", raising=False)
+    assert resolve_test_kill_index(2) is None        # leaked var: inert
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    assert resolve_test_kill_index(2) == 1
+    with pytest.raises(ValueError):
+        resolve_test_kill_index(1)                   # out of range
+
+
+def test_pipeline_inflight_window_rejects_zero():
+    from hetu_tpu.parallel.pipeline import resolve_inflight_window
+    assert resolve_inflight_window(4) == 8           # default 2*pp
+    assert resolve_inflight_window(4, 3) == 3        # explicit wins
+    with pytest.raises(ValueError):
+        resolve_inflight_window(4, 0)                # no longer 'or'-swallowed
+    with pytest.raises(ValueError):
+        resolve_inflight_window(4, -1)
+
+
+# ---------------------------------------------------------------------------
+# anomaly detection
+# ---------------------------------------------------------------------------
+
+def test_nan_step_leaves_params_bit_identical():
+    ex = build_job()
+    sup = ex.attach_supervisor(
+        rs.Supervisor(fault_injector=rs.FaultInjector("nan_grads@2")))
+    with sup:
+        for step in range(5):
+            pre = params_snapshot(ex)
+            (lv, _) = ex.run("train")
+            assert np.isfinite(float(lv.asnumpy()))
+            post = params_snapshot(ex)
+            if step == 2:
+                for k in pre:       # bit-identical, not just close
+                    np.testing.assert_array_equal(pre[k], post[k])
+            else:
+                assert any((pre[k] != post[k]).any() for k in pre)
+    assert ex.state["anomaly_total"] == 1
+    assert ex.state["anomaly_streak"] == 0          # reset by finite step 3
+    assert ex.state["last_step_finite"] is True
+    assert sup.anomaly.total == 1
+
+
+def test_anomaly_rollback_after_k_consecutive(tmp_path):
+    ex = build_job()
+    with TrainCheckpointer(tmp_path / "ck", keep=2) as ck:
+        sup = ex.attach_supervisor(rs.Supervisor(
+            ckptr=ck, ckpt_every=1,
+            anomaly=rs.AnomalyPolicy(max_consecutive=2),
+            fault_injector=rs.FaultInjector("nan_grads@2,nan_grads@3")))
+        with sup:
+            ex.run("train")                      # step 0, ckpt 0
+            ex.run("train")                      # step 1, ckpt 1
+            post1 = params_snapshot(ex)
+            ex.run("train")                      # step 2: anomaly, skip
+            assert ex.state["step"] == 3
+            ex.run("train")                      # step 3: anomaly -> rollback
+            # rolled back to checkpoint 1: next step to run is 2 again
+            assert ex.state["step"] == 2
+            for k, v in params_snapshot(ex).items():
+                np.testing.assert_array_equal(v, post1[k])
+            assert sup.anomaly.rollbacks == 1
+            assert sup.anomaly.streak == 0
+            # training continues from the restored state
+            lv, _ = ex.run("train")              # step 2 re-run, finite now
+            assert np.isfinite(float(lv.asnumpy()))
+            assert ex.state["step"] == 3
+
+
+def test_rollback_budget_stops_deterministic_nan_livelock(tmp_path):
+    """Restore is deterministic (params AND dataloader position), so a NaN
+    whose cause survives restore replays forever — the rollback budget
+    converts the livelock into an error supervise() can escalate."""
+    ex = build_job()
+    with TrainCheckpointer(tmp_path / "ck", keep=2) as ck:
+        # step 1 NaNs on EVERY replay (duplicate one-shot entries): the
+        # deterministic-divergence shape, where rollback cannot help
+        spec = ",".join(["nan_grads@1"] * 4)
+        sup = ex.attach_supervisor(rs.Supervisor(
+            ckptr=ck, ckpt_every=1,
+            anomaly=rs.AnomalyPolicy(max_consecutive=1, max_rollbacks=2),
+            fault_injector=rs.FaultInjector(spec)))
+        with sup:
+            ex.run("train")                       # step 0: finite, ckpt 0
+            for _ in range(2):
+                ex.run("train")                   # step 1 NaN -> rollback
+                assert ex.state["step"] == 1      # replayed from ckpt 0
+            with pytest.raises(RuntimeError, match="max_rollbacks"):
+                ex.run("train")
+        assert sup.anomaly.rollbacks == 3
+
+
+def test_rollback_without_checkpoint_raises():
+    ex = build_job()
+    sup = ex.attach_supervisor(rs.Supervisor(
+        anomaly=rs.AnomalyPolicy(max_consecutive=1),
+        fault_injector=rs.FaultInjector("nan_grads@0")))
+    with sup, pytest.raises(RuntimeError, match="no checkpointer"):
+        ex.run("train")
+
+
+def test_loss_scaler_backoff_and_growth():
+    s = rs.LossScaler(init_scale=8.0, backoff=0.5, growth=2.0,
+                      growth_interval=3, min_scale=1.0, max_scale=16.0)
+    s.update(False)
+    assert s.scale == 4.0
+    for _ in range(3):
+        s.update(True)
+    assert s.scale == 8.0
+    for _ in range(6):
+        s.update(True)
+    assert s.scale == 16.0                       # capped at max
+    policy = rs.AnomalyPolicy(max_consecutive=3, loss_scaler=s)
+    policy.note(False)
+    assert s.scale == 8.0                        # policy drives the scaler
+
+
+# ---------------------------------------------------------------------------
+# preemption -> emergency checkpoint -> exact resume
+# ---------------------------------------------------------------------------
+
+def run_to_completion(n_steps):
+    """Uninterrupted baseline: the exact loss trajectory a supervised run
+    (with a preemption in the middle) must reproduce."""
+    ex = build_job()
+    losses = []
+    for _ in range(n_steps):
+        lv, _ = ex.run("train")
+        losses.append(float(lv.asnumpy()))
+    return losses
+
+
+def test_sigterm_emergency_checkpoint_then_exact_resume(tmp_path):
+    N = 8
+    baseline = run_to_completion(N)
+    losses = []
+
+    def make_loop(faults):
+        def loop_fn(state, start_step):
+            ex = build_job()
+            sup = ex.attach_supervisor(rs.Supervisor(
+                ckptr=ck, preemption=rs.PreemptionHandler(),
+                fault_injector=faults))
+            with sup:
+                if state is not None:
+                    rs.load_executor_state(ex, state)
+                    assert ex.state["step"] == start_step
+                for _ in range(start_step, N):
+                    lv, _ = ex.run("train")
+                    losses.append(float(lv.asnumpy()))
+            return losses
+        return loop_fn
+
+    with TrainCheckpointer(tmp_path / "ck", keep=2) as ck:
+        # a real SIGTERM lands at step 3's boundary: emergency checkpoint,
+        # then clean exit with the distinct preemption code
+        with pytest.raises(SystemExit) as ei:
+            rs.supervise(make_loop(rs.FaultInjector("sigterm@3")), ck)
+        assert ei.value.code == rs.EXIT_PREEMPTED
+        # step 3 RAN (its state committed + checkpointed) but Preempted
+        # aborts run()'s return, so its loss value is consumed by the exit
+        assert len(losses) == 3
+        assert ck.latest_step() == 3             # emergency ckpt at step 3
+
+        # second supervise invocation (the restarted process): resumes at
+        # the exact next step and reproduces the uninterrupted trajectory
+        out = rs.supervise(make_loop(None), ck)
+    assert out is losses and len(losses) == N - 1
+    np.testing.assert_array_equal(np.float64(losses),
+                                  np.float64(baseline[:3] + baseline[4:]))
+
+
+def test_sigterm_preempts_even_when_periodic_ckpt_hits_same_step(tmp_path):
+    """Regression (found driving the real script): with ckpt_every aligned
+    so the periodic save lands on the preempted step, the emergency save
+    used to collide (orbax StepAlreadyExistsError) and the error swallowed
+    the Preempted exit."""
+    ex = build_job()
+    with TrainCheckpointer(tmp_path / "ck", keep=3) as ck:
+        sup = ex.attach_supervisor(rs.Supervisor(
+            ckptr=ck, ckpt_every=2, preemption=rs.PreemptionHandler(),
+            fault_injector=rs.FaultInjector("sigterm@5")))
+        with sup, pytest.raises(rs.Preempted):
+            for _ in range(8):
+                ex.run("train")
+        assert ck.latest_step() == 5
+
+
+def test_save_step_force_overwrites_same_step(tmp_path):
+    with TrainCheckpointer(tmp_path / "ck", keep=3) as ck:
+        ck.save_step(4, {"x": np.asarray(1.0, np.float32)})
+        ck.save_step(4, {"x": np.asarray(9.0, np.float32)}, force=True)
+        state, step = ck.restore_latest()
+        assert step == 4 and float(state["x"]) == 9.0
+
+
+def test_preemption_handler_flag_and_restore():
+    prev = signal.getsignal(signal.SIGTERM)
+    h = rs.PreemptionHandler()
+    with h:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+        assert h.should_stop()           # single-process: local flag
+        assert h.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_any_process_flag_single_process():
+    from hetu_tpu.parallel import multihost
+    assert multihost.any_process_flag(True) is True
+    assert multihost.any_process_flag(False) is False
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_beats_keep_it_quiet_then_timeout_fires():
+    fired = threading.Event()
+    buf = io.StringIO()
+    wd = rs.Watchdog(2.0, on_timeout=fired.set, stream=buf, poll_s=0.05)
+    with wd:
+        for _ in range(5):
+            wd.beat(phase="train", step=4)
+            time.sleep(0.2)
+        assert not fired.is_set()        # beats inside deadline: quiet
+        deadline = time.time() + 30
+        while not fired.is_set() and time.time() < deadline:
+            time.sleep(0.1)
+    assert fired.is_set()
+    dump = buf.getvalue()
+    assert "phase='train' step=4" in dump
+    assert "hetu-watchdog" in dump        # its own thread is in the dump
+    assert "MainThread" in dump           # ... and the hung main thread
+
+
+def test_injected_stall_trips_watchdog_with_stack_dump(tmp_path):
+    """Acceptance path: a stalled training step aborts with EXIT_WATCHDOG
+    and a stack dump on stderr instead of hanging (child process — the
+    watchdog's real abort is os._exit)."""
+    script = textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        import numpy as np
+        import hetu_tpu as ht
+        from hetu_tpu import resilience as rs
+
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        w = ht.init.random_normal((4, 2), stddev=0.5, name="w")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=0)
+        sup = ex.attach_supervisor(rs.Supervisor(
+            watchdog=rs.Watchdog(2.0, poll_s=0.1),
+            fault_injector=rs.FaultInjector("stall@2:600")))
+        rng = np.random.RandomState(0)
+        bx = rng.randn(8, 4).astype(np.float32)
+        by = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        with sup:
+            for step in range(5):
+                ex.run("train", feed_dict={x: bx, y_: by})
+                print("STEP_DONE", step, flush=True)
+        print("FINISHED", flush=True)   # must never be reached
+    """ % REPO)
+    p = tmp_path / "stall_job.py"
+    p.write_text(script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    proc = subprocess.run([sys.executable, str(p)], capture_output=True,
+                          text=True, timeout=240, env=env, cwd=str(tmp_path))
+    assert proc.returncode == rs.EXIT_WATCHDOG, (proc.stdout, proc.stderr)
+    assert "STEP_DONE 1" in proc.stdout
+    assert "FINISHED" not in proc.stdout
+    assert "hetu watchdog: no progress" in proc.stderr
+    assert "pre_step" in proc.stderr              # last-known phase
+    assert "inject_host" in proc.stderr           # the stalled frame is named
+    assert "MainThread" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# supervise(): restart with backoff
+# ---------------------------------------------------------------------------
+
+def test_supervise_restarts_with_backoff_and_resumes_state(tmp_path):
+    delays = []
+    attempts = []
+
+    with TrainCheckpointer(tmp_path / "ck", keep=3) as ck:
+        def loop_fn(state, start_step):
+            attempts.append(start_step)
+            if len(attempts) == 1:
+                assert state is None and start_step == 0
+                ck.save_step(0, {"x": np.asarray(1.0, np.float32)})
+                raise RuntimeError("boom 1")
+            if len(attempts) == 2:
+                assert float(state["x"]) == 1.0 and start_step == 1
+                ck.save_step(1, {"x": np.asarray(2.0, np.float32)})
+                raise RuntimeError("boom 2")
+            assert float(state["x"]) == 2.0 and start_step == 2
+            return "done"
+
+        out = rs.supervise(loop_fn, ck, max_restarts=3, backoff_s=0.5,
+                           sleep=delays.append)
+    assert out == "done"
+    assert attempts == [0, 1, 2]
+    assert delays == [0.5, 1.0]                   # exponential backoff
+
+
+def test_supervise_exhausts_restarts_and_reraises():
+    calls = []
+
+    def loop_fn(state, start_step):
+        calls.append(1)
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError, match="always"):
+        rs.supervise(loop_fn, None, max_restarts=2, sleep=lambda s: None)
+    assert len(calls) == 3                        # 1 attempt + 2 restarts
+
+
+def test_supervise_never_retries_preemption():
+    def loop_fn(state, start_step):
+        raise rs.Preempted(5)
+
+    with pytest.raises(SystemExit) as ei:
+        rs.supervise(loop_fn, None, max_restarts=5, sleep=lambda s: None)
+    assert ei.value.code == rs.EXIT_PREEMPTED
+    with pytest.raises(rs.Preempted):
+        rs.supervise(loop_fn, None, on_preempt="raise", sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# dataloader state round trip
+# ---------------------------------------------------------------------------
+
+def test_dataloader_state_dict_round_trip():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+
+    def fresh():
+        return ht.Dataloader(data, 4, "train", shuffle=True, seed=3)
+
+    a = fresh()
+    for _ in range(7):                 # crosses the epoch reshuffle at 5
+        a.get_arr()
+    a.peek_arr()                       # peeked-but-unconsumed batch in state
+    sd = a.state_dict()
+
+    b = fresh()
+    b.load_state_dict(sd)
+    for _ in range(12):
+        np.testing.assert_array_equal(a.get_arr(), b.get_arr())
+
+    # mismatched dataset size is rejected, not silently skewed
+    c = ht.Dataloader(np.zeros((8, 2), np.float32), 4, "train")
+    with pytest.raises(ValueError):
+        c.load_state_dict(sd)
+
+
+def test_dataloader_op_state_round_trip():
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    op = ht.dataloader_op([ht.Dataloader(data, 3, "train", shuffle=True,
+                                         seed=5)])
+    for _ in range(4):
+        op.get_batch("train")
+    sd = op.state_dict("train")
+    assert op.state_dict("nosuch") is None
+    op2 = ht.dataloader_op([ht.Dataloader(data, 3, "train", shuffle=True,
+                                          seed=5)])
+    op2.load_state_dict("train", sd)
+    for _ in range(6):
+        np.testing.assert_array_equal(op.get_batch("train"),
+                                      op2.get_batch("train"))
+
+
+def test_anomaly_guard_refuses_ps_mode():
+    x = ht.Variable(name="x", trainable=False)
+    w = ht.init.random_normal((4, 2), stddev=0.5, name="w")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    with pytest.raises(ValueError, match="anomaly_guard"):
+        ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                    comm_mode="PS", anomaly_guard=True)
