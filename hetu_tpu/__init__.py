@@ -33,6 +33,7 @@ from .cstable import CacheSparseTable
 from . import ps
 from . import optimizer as optim
 from . import resilience
+from . import analysis
 from . import lr_scheduler as lr
 from . import initializers as init
 from . import data

@@ -1,0 +1,45 @@
+"""hetulint: define-time graph validation + lowered-program static analysis.
+
+Two tiers:
+
+- **Tier A** (:mod:`graph_passes`) runs over the Op graph before the executor
+  builds: whole-graph abstract shape/dtype inference with op-level mismatch
+  localization, structure checks, comm-op placement lints, dtype-promotion
+  lints, dead-subgraph and common-subexpression reporting. Entry points:
+  :func:`analyze_graph` / :class:`GraphAnalyzer`,
+  ``Executor(..., lint="error"|"warn")``, and the ``bin/hetulint`` CLI.
+- **Tier B** (:mod:`lowered`) analyzes the lowered/compiled step program via
+  the ``SubExecutor._lowered``/``dump_hlo``/``last_cost_analysis`` hooks:
+  recompilation detection, donation/aliasing and host-transfer checks, and
+  the replicated-large-tensor lint. Entry points: :func:`analyze_executor`,
+  :class:`RecompileMonitor`.
+
+See docs/ANALYSIS.md for the lint catalogue with examples and suppression.
+"""
+from .findings import (
+    Finding, GraphValidationError, ERROR, WARN, NOTE, SEVERITIES,
+    suppress, sort_findings, count_by_severity, format_findings,
+)
+from .abstract import AbstractGraph
+from .graph_passes import (
+    TIER_A_PASSES, structure_pass, shapes_pass, comm_pass, dce_pass,
+)
+from .analyzer import (
+    AnalysisConfig, AnalysisContext, GraphAnalyzer, analyze_graph,
+    record_graph,
+)
+from .lowered import (
+    analyze_executor, recompile_findings, donation_findings,
+    host_transfer_findings, replicated_tensor_findings, cost_analysis_of,
+    RecompileMonitor,
+)
+
+__all__ = [
+    "Finding", "GraphValidationError", "ERROR", "WARN", "NOTE", "SEVERITIES",
+    "suppress", "sort_findings", "count_by_severity", "format_findings",
+    "AbstractGraph", "TIER_A_PASSES", "structure_pass", "shapes_pass",
+    "comm_pass", "dce_pass", "AnalysisConfig", "AnalysisContext",
+    "GraphAnalyzer", "analyze_graph", "record_graph", "analyze_executor",
+    "recompile_findings", "donation_findings", "host_transfer_findings",
+    "replicated_tensor_findings", "cost_analysis_of", "RecompileMonitor",
+]

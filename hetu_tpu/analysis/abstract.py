@@ -1,0 +1,126 @@
+"""Whole-graph abstract interpretation: shapes and dtypes for every node.
+
+Walks the topo order once, inferring each op's output
+``jax.ShapeDtypeStruct`` from its inputs' structs via ``Op.infer_meta`` —
+no arrays are materialized and no XLA program is built. The result is the
+substrate the Tier A passes read: shape-mismatch localization (the *op* whose
+abstract evaluation raised, not a jit traceback 40 frames deep), dtype
+promotion lints, and comm-op placement checks that need ranks.
+
+Sources of truth for leaves:
+
+- ``PlaceholderOp`` with a known shape (Variables with values/initializers):
+  ``(shape, dtype)`` as declared.
+- Dataloader nodes: ``(batch_size, *data.shape[1:])`` with the loaded data's
+  dtype (``Dataloader.get_cur_shape``).
+- Fed placeholders without a declared shape are *unknown roots*: their
+  downstream cone is skipped silently (one ``shape-unknown`` note each, so a
+  CI lint of a feed-dict graph says why coverage is partial). ``feed_meta``
+  lets callers (tests, hetulint wrappers) pin shapes for exactly this case.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+
+from ..graph.node import _as_struct
+
+
+class AbstractGraph:
+    """Abstract shapes/dtypes of one topo-sorted graph.
+
+    After ``evaluate()``:
+
+    - ``meta[id(node)]`` -> ``ShapeDtypeStruct`` | ``None`` (op yields no
+      in-graph value: optimizer, PS push) — present only for resolved nodes.
+    - ``failures[id(node)]`` -> ``(kind, message)`` with ``kind`` in
+      ``{"shape-mismatch", "abstract-eval-failed"}``.
+    - ``unknown_roots`` -> leaf nodes whose shape could not be determined.
+    """
+
+    def __init__(self, topo, config=None, target: Optional[str] = None,
+                 feed_meta: Optional[dict] = None):
+        self.topo = list(topo)
+        self.config = config
+        self.target = target
+        self.meta: Dict[int, Any] = {}
+        self.failures: Dict[int, tuple] = {}
+        self.unknown_roots: list = []
+        self._skipped: set = set()
+        if feed_meta:
+            for node, val in feed_meta.items():
+                self.meta[id(node)] = _as_struct(val)
+
+    # ------------------------------------------------------------------
+    def _leaf_meta(self, node):
+        if node.is_placeholder:
+            shape = getattr(node, "shape", None)
+            if shape is None:
+                return None
+            dtype = getattr(node, "dtype", np.float32)
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        if node.is_dataloader:
+            dls = getattr(node, "dataloaders", None)
+            if not dls:
+                return None  # GNN loaders produce host-driven shapes
+            dl = dls.get(self.target) if self.target in dls else \
+                next(iter(dls.values()))
+            try:
+                shape = dl.get_cur_shape()
+                return jax.ShapeDtypeStruct(tuple(shape), dl._data.dtype)
+            except Exception:  # noqa: BLE001 — diagnostics must not throw
+                return None
+        return None
+
+    def evaluate(self) -> "AbstractGraph":
+        for node in self.topo:
+            if id(node) in self.meta:
+                continue
+            if node.is_optimizer:
+                self.meta[id(node)] = None  # applied by the executor
+                continue
+            if node.is_placeholder or node.is_dataloader:
+                m = self._leaf_meta(node)
+                if m is None:
+                    self.unknown_roots.append(node)
+                else:
+                    self.meta[id(node)] = m
+                continue
+            if node.is_gradient:
+                # d(loss)/dx has x's shape/dtype; with multi_x (PS shared
+                # table rewiring) the op yields a host-consumed tuple
+                multi = getattr(node, "multi_x", None)
+                if multi:
+                    self.meta[id(node)] = None
+                    continue
+                xm = self.meta.get(id(node.x))
+                if xm is not None:
+                    self.meta[id(node)] = xm
+                continue
+            # unresolved or valueless input: skip the whole downstream cone
+            # silently — only its unknown root / failing op gets a finding
+            if any(self.meta.get(id(i)) is None for i in node.inputs):
+                continue
+            in_metas = [self.meta[id(i)] for i in node.inputs]
+            try:
+                # may legitimately be None (PS push yields no in-graph value)
+                self.meta[id(node)] = node.infer_meta(in_metas)
+            except TypeError as e:
+                shapes = [tuple(m.shape) for m in in_metas]
+                self.failures[id(node)] = (
+                    "shape-mismatch", f"{e} (input shapes {shapes})")
+            except Exception as e:  # noqa: BLE001 — classify, don't crash
+                self.failures[id(node)] = (
+                    "abstract-eval-failed", f"{type(e).__name__}: {e}")
+        return self
+
+    # ------------------------------------------------------------------
+    def shape_of(self, node) -> Optional[tuple]:
+        m = self.meta.get(id(node))
+        return tuple(m.shape) if m is not None and hasattr(m, "shape") else None
+
+    def dtype_of(self, node):
+        m = self.meta.get(id(node))
+        return m.dtype if m is not None and hasattr(m, "dtype") else None

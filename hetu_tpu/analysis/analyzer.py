@@ -1,0 +1,223 @@
+"""GraphAnalyzer: the Tier A pass driver.
+
+Three entry points share it:
+
+- ``Executor(..., lint="error"|"warn")`` runs it at build over the real
+  post-comm-insertion graph with the real ``HetuConfig``.
+- ``bin/hetulint`` imports a graph-builder callable, records the op universe
+  while building, and analyzes with a lightweight :class:`AnalysisConfig`
+  (no devices touched, no PS servers spawned).
+- ``graphboard.render(..., lint=True)`` annotates the topology drawing.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..graph.node import Op, _graph_recorders
+
+
+def _tolerant_topo(node_list) -> list:
+    """``find_topo_sort`` that survives malformed graphs: non-Op inputs are
+    skipped (the structure pass reports them) and cycles terminate (the
+    visited set breaks them; the structure pass reports those too). On a
+    valid graph the order is identical to ``find_topo_sort``."""
+    visited: set = set()
+    order: list = []
+
+    def children(n):
+        return iter([c for c in getattr(n, "inputs", [])
+                     if isinstance(c, Op)])
+
+    for root in node_list:
+        if not isinstance(root, Op) or id(root) in visited:
+            continue
+        visited.add(id(root))
+        stack = [(root, children(root))]
+        while stack:
+            cur, it = stack[-1]
+            advanced = False
+            for child in it:
+                if id(child) not in visited:
+                    visited.add(id(child))
+                    stack.append((child, children(child)))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(cur)
+                stack.pop()
+    return order
+from .abstract import AbstractGraph
+from .findings import (
+    Finding, is_suppressed, sort_findings, ERROR, WARN, NOTE,
+)
+from .graph_passes import TIER_A_PASSES
+
+
+@contextlib.contextmanager
+def record_graph():
+    """Record every Op constructed inside the block.
+
+    The recorded list is the *universe* for dead-subgraph reporting: ops a
+    builder constructed that ended up unreachable from its eval targets.
+    ``hetulint`` wraps each builder call in one of these.
+    """
+    rec: list[Op] = []
+    _graph_recorders.append(rec)
+    try:
+        yield rec
+    finally:
+        _graph_recorders.remove(rec)
+
+
+class AnalysisConfig:
+    """Duck-typed stand-in for ``HetuConfig`` carrying only what the passes
+    read — lets ``hetulint`` lint a PS/AllReduce graph without spawning
+    servers or touching devices."""
+
+    def __init__(self, comm_mode=None, mesh=None, dp_size=None,
+                 dp_axis="dp", mp_axis="tp", compute_dtype=np.float32,
+                 gpipe=False):
+        self.comm_mode = comm_mode
+        self.mesh = mesh
+        self._dp_size = dp_size
+        self.dp_axis = dp_axis
+        self.mp_axis = mp_axis
+        self.compute_dtype = np.dtype(compute_dtype)
+        self.gpipe = gpipe
+
+    @property
+    def dp_size(self) -> int:
+        if self._dp_size is not None:
+            return int(self._dp_size)
+        if self.mesh is not None and self.dp_axis in self.mesh.axis_names:
+            return self.mesh.shape[self.dp_axis]
+        return 1
+
+
+class AnalysisContext:
+    """What a pass sees: topo, eval targets, config, options, and the lazily
+    computed abstract shape/dtype map."""
+
+    def __init__(self, eval_nodes, topo, config=None, universe=None,
+                 options=None, target=None, feed_meta=None,
+                 ps_embed_ids=frozenset()):
+        self.eval_nodes = list(eval_nodes)
+        self.topo = list(topo)
+        self.config = config
+        self.universe = list(universe) if universe else None
+        self.options = dict(options or {})
+        self.target = target
+        # tables the PS runtime WOULD classify as sparse-resident: the union
+        # of explicitly marked is_embed vars and those the comm-insertion
+        # replay inferred (the replay's attribute marks are rolled back so
+        # the graph stays pristine — the inference survives here)
+        self.ps_embed_ids = frozenset(ps_embed_ids)
+        self._feed_meta = feed_meta
+        self._abstract: Optional[AbstractGraph] = None
+
+    @property
+    def abstract(self) -> AbstractGraph:
+        if self._abstract is None:
+            self._abstract = AbstractGraph(
+                self.topo, config=self.config, target=self.target,
+                feed_meta=self._feed_meta).evaluate()
+        return self._abstract
+
+
+def _flatten_graph(graph) -> tuple[list, Optional[str]]:
+    """Accept an Op, a list of Ops, or a ``{target: [Op, ...]}`` dict (the
+    Executor's eval_node_dict form). Returns (eval nodes, first target)."""
+    if isinstance(graph, Op):
+        return [graph], None
+    if isinstance(graph, dict):
+        nodes = [n for ns in graph.values() for n in ns]
+        first = next(iter(graph), None)
+        return nodes, first
+    return list(graph), None
+
+
+class GraphAnalyzer:
+    """Run Tier A passes over a graph: ``GraphAnalyzer(graph).run()``.
+
+    ``graph``: an Op, list of Ops, or ``{target: [ops]}`` dict.
+    ``config``: a ``HetuConfig`` or :class:`AnalysisConfig` (optional — comm
+    placement lints that need a declared strategy are skipped without one).
+    ``universe``: ops recorded by :func:`record_graph` for dead-subgraph
+    reporting. ``suppress``: lint ids silenced analyzer-wide. ``options``:
+    per-pass knobs. ``insert_comm=True`` replays the executor's comm-op
+    insertion (AllReduce/PS markers on optimizer gradients) against
+    ``config.comm_mode`` so a define-time lint sees the graph the executor
+    would actually build — hetulint's default when a comm_mode is declared.
+    """
+
+    def __init__(self, graph, config=None, universe=None,
+                 suppress: Sequence[str] = (), options: Optional[dict] = None,
+                 target: Optional[str] = None, feed_meta: Optional[dict] = None,
+                 insert_comm: bool = False):
+        self.eval_nodes, first_target = _flatten_graph(graph)
+        self.config = config
+        self.suppress = tuple(suppress)
+        self.options = dict(options or {})
+        self.universe = universe
+        self.target = target if target is not None else first_target
+        self.feed_meta = feed_meta
+        self._undo: list = []
+        self.ps_embed_ids: set = set()
+        if insert_comm and getattr(config, "comm_mode", None) is not None:
+            self._insert_comm_ops()
+        self.topo = _tolerant_topo(self.eval_nodes)
+        # the topo snapshot keeps the inserted comm ops alive for the passes;
+        # the *graph* must come back untouched — a later real Executor on the
+        # same nodes has to run its own insertion against its own config.
+        # (Inferred is_embed marks live on in ps_embed_ids for the passes.)
+        self._restore_graph()
+
+    def _insert_comm_ops(self):
+        """Replay Executor.__init__'s strategy rewrite (executor.py): mark
+        lookup-read embeddings, then let each optimizer wrap its gradient
+        inputs in AllReduce/PS comm ops. Every mutation is recorded and
+        undone by ``_restore_graph`` once the topo snapshot is taken."""
+        topo = _tolerant_topo(self.eval_nodes)
+        if self.config.comm_mode in ("PS", "Hybrid"):
+            for node in topo:
+                embed = getattr(node, "embed_node", None)
+                if embed is not None and getattr(embed, "trainable", False):
+                    self.ps_embed_ids.add(id(embed))
+                    if not getattr(embed, "is_embed", False):
+                        embed.is_embed = True
+                        self._undo.append(("embed", embed))
+        for node in topo:
+            if node.is_optimizer:
+                self._undo.append(("opt", node, list(node.inputs),
+                                   node._comm_inserted))
+                node.insert_comm_ops(self.config)
+
+    def _restore_graph(self):
+        for entry in reversed(self._undo):
+            if entry[0] == "embed":
+                entry[1].is_embed = False
+            else:
+                _, node, inputs, flag = entry
+                node.inputs = inputs
+                node._comm_inserted = flag
+        self._undo = []
+
+    def run(self, passes: Optional[Iterable] = None) -> list[Finding]:
+        ctx = AnalysisContext(self.eval_nodes, self.topo, config=self.config,
+                              universe=self.universe, options=self.options,
+                              target=self.target, feed_meta=self.feed_meta,
+                              ps_embed_ids=self.ps_embed_ids)
+        findings: list[Finding] = []
+        for p in (TIER_A_PASSES if passes is None else passes):
+            findings.extend(p(ctx))
+        findings = [f for f in findings
+                    if not is_suppressed(f, self.suppress)]
+        return sort_findings(findings)
+
+
+def analyze_graph(graph, config=None, **kwargs) -> list[Finding]:
+    """One-call Tier A analysis: ``analyze_graph(eval_nodes) -> findings``."""
+    return GraphAnalyzer(graph, config=config, **kwargs).run()

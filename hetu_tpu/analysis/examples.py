@@ -1,0 +1,146 @@
+"""Bundled graph builders for ``bin/hetulint`` — CI smoke targets.
+
+Each builder returns ``(graph, config_kwargs)`` where ``graph`` is an
+Executor-style ``{target: [eval nodes]}`` dict and ``config_kwargs`` feed
+:class:`~hetu_tpu.analysis.analyzer.AnalysisConfig` (declared comm strategy —
+no devices are touched and no PS servers are spawned by linting).
+
+They intentionally mirror the repo's three main workload shapes: the
+examples/cnn MLP, the examples/nlp graph-API transformer block, and the
+examples/ctr Wide&Deep-style PS embedding model.
+
+    bin/hetulint --json hetu_tpu.analysis.examples:build_mlp \\
+        hetu_tpu.analysis.examples:build_transformer \\
+        hetu_tpu.analysis.examples:build_ctr_ps
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, *shape).astype(np.float32)
+    y = rng.randint(0, num_classes, size=(n,))
+    onehot = np.zeros((n, num_classes), np.float32)
+    onehot[np.arange(n), y] = 1.0
+    return x, onehot, y
+
+
+def build_mlp():
+    """3-layer MLP over dataloaders (the tests/test_mlp.py pattern)."""
+    import hetu_tpu as ht
+    from hetu_tpu import init
+
+    train_x, train_y, _ = _synthetic(256, (32,), 10, seed=0)
+    x = ht.dataloader_op([ht.Dataloader(train_x, 64, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(train_y, 64, "train")])
+
+    h = x
+    for i, (fan_in, fan_out) in enumerate([(32, 64), (64, 64), (64, 10)]):
+        w = init.random_normal((fan_in, fan_out), stddev=0.1, name=f"w{i}")
+        b = init.zeros((fan_out,), name=f"b{i}")
+        mm = ht.matmul_op(h, w)
+        h = mm + ht.broadcastto_op(b, mm)
+        if i < 2:
+            h = ht.relu_op(h)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(h, y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return {"train": [loss, train_op]}, {}
+
+
+def build_transformer():
+    """One causal self-attention block + FFN on the graph API (the
+    examples/nlp/hetu_transformer.py pattern, miniaturized)."""
+    import hetu_tpu as ht
+    from hetu_tpu import init
+
+    batch, seq_len, d_model, n_heads, vocab = 4, 8, 16, 2, 32
+    hd = d_model // n_heads
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(0, vocab, size=(64, seq_len)).astype(np.int32)
+    targets = np.zeros((64, seq_len, vocab), np.float32)
+    targets[np.arange(64)[:, None], np.arange(seq_len)[None, :],
+            rng.randint(0, vocab, size=(64, seq_len))] = 1.0
+
+    tok = ht.dataloader_op([ht.Dataloader(tokens, batch, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(targets, batch, "train")])
+
+    table = init.xavier_normal((vocab, d_model), name="tok_embed")
+    h = ht.embedding_lookup_op(table, tok)          # (B, T, D)
+
+    def dense(x, fan_in, fan_out, name):
+        w = init.xavier_normal((fan_in, fan_out), name=name + "_w")
+        b = init.zeros((fan_out,), name=name + "_b")
+        y = ht.matmul_op(ht.array_reshape_op(x, (-1, fan_in)), w)
+        return y + ht.broadcastto_op(b, y)
+
+    def split_heads(t):
+        t = ht.array_reshape_op(t, (batch, seq_len, n_heads, hd))
+        return ht.transpose_op(t, (0, 2, 1, 3))
+
+    q, k, v = (split_heads(ht.array_reshape_op(
+        dense(h, d_model, d_model, nm), (batch, seq_len, d_model)))
+        for nm in ("q", "k", "v"))
+    scores = ht.mul_byconst_op(ht.batch_matmul_op(q, k, trans_B=True),
+                               1.0 / np.sqrt(hd))
+    causal = np.triu(np.full((seq_len, seq_len), -1e9, np.float32), k=1)
+    mask = ht.Variable(name="causal_mask", value=causal, trainable=False,
+                       batch=False)
+    scores = scores + ht.broadcastto_op(mask, scores)
+    attn = ht.softmax_op(scores)
+    ctxv = ht.transpose_op(ht.batch_matmul_op(attn, v), (0, 2, 1, 3))
+    ctxv = ht.array_reshape_op(ctxv, (batch, seq_len, d_model))
+    h = layer = ht.layer_normalization_op(
+        h + ht.array_reshape_op(dense(ctxv, d_model, d_model, "proj"),
+                                (batch, seq_len, d_model)),
+        init.ones((d_model,), name="ln1_s"),
+        init.zeros((d_model,), name="ln1_b"))
+    ffn = dense(ht.gelu_op(dense(layer, d_model, 4 * d_model, "ffn1")),
+                4 * d_model, d_model, "ffn2")
+    h = ht.layer_normalization_op(
+        layer + ht.array_reshape_op(ffn, (batch, seq_len, d_model)),
+        init.ones((d_model,), name="ln2_s"),
+        init.zeros((d_model,), name="ln2_b"))
+
+    logits = ht.array_reshape_op(dense(h, d_model, vocab, "lm_head"),
+                                 (batch, seq_len, vocab))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(
+            ht.array_reshape_op(logits, (-1, vocab)),
+            ht.array_reshape_op(y_, (-1, vocab))), [0])
+    train_op = ht.optim.AdamOptimizer(1e-3).minimize(loss)
+    return {"train": [loss, train_op]}, {}
+
+
+def build_ctr_ps():
+    """Wide&Deep-style CTR model with PS-hosted embedding tables (the
+    examples/ctr/models/wdl_adult.py pattern, miniaturized). Declares
+    ``comm_mode='PS'`` so the analyzer replays the executor's PS comm-op
+    insertion and checks the staging contract."""
+    import hetu_tpu as ht
+    from hetu_tpu import init
+
+    n_cat, embed_rows, embed_dim, n_num = 4, 50, 8, 3
+    rng = np.random.RandomState(2)
+    cat = rng.randint(0, embed_rows, size=(128, n_cat)).astype(np.int64)
+    num = rng.randn(128, n_num).astype(np.float32)
+    _, y1h, _ = _synthetic(128, (1,), 2, seed=3)
+
+    cat_dl = ht.dataloader_op([ht.Dataloader(cat, 32, "train")])
+    num_dl = ht.dataloader_op([ht.Dataloader(num, 32, "train")])
+    y_ = ht.dataloader_op([ht.Dataloader(y1h, 32, "train")])
+
+    table = init.random_normal((embed_rows, embed_dim), stddev=0.1,
+                               name="ctr_embed", is_embed=True)
+    emb = ht.array_reshape_op(ht.embedding_lookup_op(table, cat_dl),
+                              (-1, n_cat * embed_dim))
+    deep = ht.concat_op(emb, num_dl, 1)
+    w1 = init.random_normal((n_cat * embed_dim + n_num, 16), stddev=0.1,
+                            name="ctr_w1")
+    h = ht.relu_op(ht.matmul_op(deep, w1))
+    w2 = init.random_normal((16, 2), stddev=0.1, name="ctr_w2")
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+    return {"train": [loss, train_op]}, {"comm_mode": "PS"}

@@ -1,0 +1,119 @@
+"""Findings: the unit of output of every analysis pass.
+
+A :class:`Finding` pins one diagnosed condition to one graph node (op-level
+provenance via ``node.name``/``node.id``) or, for lowered-program (Tier B)
+checks, to a subexecutor. Severities:
+
+- ``error`` — the graph/program is wrong: it will crash at trace time or
+  silently train incorrectly (e.g. a PS push op without a PS runtime).
+- ``warn``  — a correctness or performance hazard that deserves a human
+  decision (silent f64 downcast, per-step recompilation, missing donation).
+- ``note``  — informational (common subexpressions, degenerate collectives).
+
+Suppression: per-op via ``suppress(node, "lint-id", ...)`` (or a
+``lint_suppress`` iterable attribute on the node), or analyzer-wide via
+``GraphAnalyzer(..., suppress=["lint-id"])``. ``hetulint --suppress`` maps to
+the latter.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+ERROR = "error"
+WARN = "warn"
+NOTE = "note"
+
+SEVERITIES = (ERROR, WARN, NOTE)
+_SEVERITY_RANK = {ERROR: 0, WARN: 1, NOTE: 2}
+
+
+def severity_rank(sev: str) -> int:
+    """0 = most severe. Unknown severities sort last."""
+    return _SEVERITY_RANK.get(sev, len(SEVERITIES))
+
+
+@dataclass
+class Finding:
+    """One diagnosed condition with op-level provenance."""
+
+    lint: str                       # stable id, e.g. "shape-mismatch"
+    severity: str                   # "error" | "warn" | "note"
+    message: str
+    op_name: Optional[str] = None   # node.name (or subexecutor name, Tier B)
+    op_id: Optional[int] = None     # node.id
+    op_type: Optional[str] = None   # type(node).__name__
+    pass_name: Optional[str] = None
+    # live node handle for suppression filtering; never serialized
+    op: Any = field(default=None, repr=False, compare=False)
+
+    @classmethod
+    def at(cls, node, lint: str, severity: str, message: str,
+           pass_name: Optional[str] = None) -> "Finding":
+        """Finding pinned to a graph node."""
+        return cls(lint=lint, severity=severity, message=message,
+                   op_name=getattr(node, "name", None),
+                   op_id=getattr(node, "id", None),
+                   op_type=type(node).__name__ if node is not None else None,
+                   pass_name=pass_name, op=node)
+
+    def as_dict(self) -> dict:
+        return {"lint": self.lint, "severity": self.severity,
+                "message": self.message, "op": self.op_name,
+                "op_id": self.op_id, "op_type": self.op_type,
+                "pass": self.pass_name}
+
+    def __str__(self) -> str:
+        where = ""
+        if self.op_name is not None:
+            where = (f" {self.op_name}"
+                     + (f" ({self.op_type})" if self.op_type else "")) + ":"
+        return f"{self.severity}[{self.lint}]{where} {self.message}"
+
+
+def suppress(node, *lints: str):
+    """Mark ``node`` so the listed lint ids are not reported against it
+    (``"*"`` suppresses everything). Returns ``node`` for chaining."""
+    cur = set(getattr(node, "lint_suppress", ()) or ())
+    cur.update(lints)
+    node.lint_suppress = cur
+    return node
+
+
+def is_suppressed(finding: Finding, global_suppress=()) -> bool:
+    if finding.lint in global_suppress or "*" in global_suppress:
+        return True
+    node_sup = getattr(finding.op, "lint_suppress", None)
+    if node_sup and (finding.lint in node_sup or "*" in node_sup):
+        return True
+    return False
+
+
+def sort_findings(findings) -> list:
+    """Stable order: severity first, then graph position (op id)."""
+    return sorted(findings, key=lambda f: (severity_rank(f.severity),
+                                           f.op_id if f.op_id is not None
+                                           else 1 << 30))
+
+
+def count_by_severity(findings) -> dict:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def format_findings(findings, indent: str = "  ") -> str:
+    return "\n".join(indent + str(f) for f in sort_findings(findings))
+
+
+class GraphValidationError(ValueError):
+    """Raised by ``Executor(..., lint="error")`` when the graph has
+    error-severity findings. Carries the full finding list."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        errors = [f for f in self.findings if f.severity == ERROR]
+        super().__init__(
+            f"graph validation failed with {len(errors)} error(s):\n"
+            + format_findings(errors))

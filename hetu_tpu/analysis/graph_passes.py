@@ -1,0 +1,364 @@
+"""Tier A passes: define-time lints over the Op graph.
+
+Each pass is a function ``(ctx: AnalysisContext) -> list[Finding]``. The
+default pipeline is :data:`TIER_A_PASSES`; ``GraphAnalyzer.run(passes=...)``
+accepts any subset or user-written passes with the same signature.
+
+Lint catalogue (see docs/ANALYSIS.md for examples and suppression):
+
+structure  : graph-cycle(E) bad-input(E) duplicate-name(W/N)
+shapes     : shape-mismatch(E) abstract-eval-failed(N) shape-unknown(N)
+             f64-value(W) f64-upcast(W) int-float-mix(N)
+comm       : ps-op-without-ps-mode(E) ps-push-ignored(W)
+             ps-lookup-index-not-fed(E) allreduce-without-comm-mode(W)
+             allreduce-degenerate(N) dispatch-rank-mismatch(E)
+             dispatch-no-mp-axis(E) dispatch-grad-unpaired(W)
+             pipeline-send-unconsumed(W) pipeline-recv-source(N)
+             pipeline-stage-loop(W)
+dce        : dead-subgraph(W) common-subexpression(N)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..graph.node import Op, PlaceholderOp, FunctionalOp
+from ..graph.gradients import GradientOp
+from ..graph.ops.comm import (
+    AllReduceCommunicateOp, DispatchOp, DispatchGradientOp,
+    PipelineSendOp, PipelineReceiveOp,
+)
+from ..graph.ops.ps import (
+    ParameterServerCommunicateOp, ParameterServerSparsePullOp,
+)
+from .findings import Finding, ERROR, WARN, NOTE
+
+
+# ---------------------------------------------------------------------------
+# structure
+# ---------------------------------------------------------------------------
+
+def structure_pass(ctx) -> list:
+    """Cycles, malformed inputs, duplicate names."""
+    out = []
+    # -- malformed inputs ---------------------------------------------------
+    for node in ctx.topo:
+        for i, inp in enumerate(node.inputs):
+            if not isinstance(inp, Op):
+                out.append(Finding.at(
+                    node, "bad-input", ERROR,
+                    f"input {i} is {type(inp).__name__!s} ({inp!r}), not an "
+                    "Op — the graph cannot be traced", "structure"))
+    # -- cycle detection (iterative white/gray/black DFS) -------------------
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict[int, int] = {}
+    reported: set[int] = set()
+    for root in ctx.eval_nodes:
+        stack = [(root, iter(getattr(root, "inputs", [])))]
+        color.setdefault(id(root), WHITE)
+        color[id(root)] = GRAY
+        while stack:
+            cur, it = stack[-1]
+            advanced = False
+            for child in it:
+                if not isinstance(child, Op):
+                    continue
+                c = color.get(id(child), WHITE)
+                if c == GRAY:
+                    if id(child) not in reported:
+                        reported.add(id(child))
+                        out.append(Finding.at(
+                            child, "graph-cycle", ERROR,
+                            f"participates in a dependency cycle via "
+                            f"{cur.name!r} — topological evaluation is "
+                            "impossible", "structure"))
+                elif c == WHITE:
+                    color[id(child)] = GRAY
+                    stack.append((child, iter(child.inputs)))
+                    advanced = True
+                    break
+            if not advanced:
+                color[id(cur)] = BLACK
+                stack.pop()
+    # -- duplicate names ----------------------------------------------------
+    by_name: dict[str, list] = {}
+    for node in ctx.topo:
+        by_name.setdefault(node.name, []).append(node)
+    for name, nodes in by_name.items():
+        if len(nodes) < 2:
+            continue
+        trainable = [n for n in nodes
+                     if isinstance(n, PlaceholderOp) and n.trainable]
+        sev = WARN if len(trainable) >= 2 else NOTE
+        what = ("trainable parameters share" if sev == WARN
+                else "ops share")
+        extra = (" — checkpoints disambiguate with __<k> suffixes tied to "
+                 "construction order, which silently breaks reloading into a "
+                 "reordered graph" if sev == WARN else "")
+        out.append(Finding.at(
+            nodes[1], "duplicate-name", sev,
+            f"{len(nodes)} {what} the name {name!r}{extra}", "structure"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shapes / dtypes
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE_MIX_OPS = {"AddElewise", "MultiplyElewise", "Division",
+                        "MatrixDot"}
+
+
+def shapes_pass(ctx) -> list:
+    """Whole-graph abstract shape/dtype inference with mismatch localization
+    plus dtype-promotion lints."""
+    out = []
+    ag = ctx.abstract
+    by_id = {id(n): n for n in ctx.topo}
+    for nid, (kind, msg) in ag.failures.items():
+        node = by_id.get(nid)
+        sev = ERROR if kind == "shape-mismatch" else NOTE
+        out.append(Finding.at(node, kind, sev, msg, "shapes"))
+    for node in ag.unknown_roots:
+        out.append(Finding.at(
+            node, "shape-unknown", NOTE,
+            "shape is not known at define time (fed placeholder / dynamic "
+            "loader) — downstream shape checks are skipped; declare shapes "
+            "via an initializer, a Dataloader, or feed_meta", "shapes"))
+    # -- dtype lints --------------------------------------------------------
+    for node in ctx.topo:
+        m = ag.meta.get(id(node))
+        dt = getattr(m, "dtype", None) if m is not None else None
+        if node.is_placeholder:
+            declared = getattr(node, "dtype", None)
+            if declared is not None and np.dtype(declared) == np.float64:
+                out.append(Finding.at(
+                    node, "f64-value", WARN,
+                    "declared float64 — the executor silently casts feeds to "
+                    "f32 and x64-disabled jax truncates parameters; declare "
+                    "f32 (or enable x64 deliberately)", "shapes"))
+            continue
+        if dt is not None and np.dtype(dt) == np.float64:
+            in_dts = [ag.dtype_of(i) for i in node.inputs]
+            if not any(d is not None and np.dtype(d) == np.float64
+                       for d in in_dts):
+                out.append(Finding.at(
+                    node, "f64-upcast", WARN,
+                    f"output silently widens to float64 from inputs "
+                    f"{[str(d) for d in in_dts]} — doubles memory and "
+                    "falls off the TPU fast path", "shapes"))
+        if isinstance(node, FunctionalOp) \
+                and node.opname in _ELEMENTWISE_MIX_OPS:
+            in_dts = [ag.dtype_of(i) for i in node.inputs]
+            if len(in_dts) >= 2 and all(d is not None for d in in_dts):
+                has_int = any(jnp.issubdtype(d, jnp.integer) for d in in_dts)
+                has_flt = any(jnp.issubdtype(d, jnp.floating) for d in in_dts)
+                if has_int and has_flt:
+                    out.append(Finding.at(
+                        node, "int-float-mix", NOTE,
+                        f"{node.opname} mixes integer and float inputs "
+                        f"({[str(d) for d in in_dts]}) — the integer side is "
+                        "silently promoted; cast explicitly if intended",
+                        "shapes"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# comm placement
+# ---------------------------------------------------------------------------
+
+def _is_fed(node) -> bool:
+    return (node.is_dataloader
+            or (node.is_placeholder and getattr(node, "is_feed", False)))
+
+
+def comm_pass(ctx) -> list:
+    """Comm-op placement: AllReduce vs DP context, PS ops vs comm_mode,
+    dispatch pairing/rank, pipeline send/recv consistency."""
+    out = []
+    cfg = ctx.config
+    comm_mode = getattr(cfg, "comm_mode", None) if cfg is not None else None
+    mesh = getattr(cfg, "mesh", None) if cfg is not None else None
+    dp_size = getattr(cfg, "dp_size", 1) if cfg is not None else 1
+    mp_axis = getattr(cfg, "mp_axis", "tp") if cfg is not None else "tp"
+    ag = ctx.abstract
+
+    consumers: dict[int, list] = {}
+    for node in ctx.topo:
+        for i in node.inputs:
+            consumers.setdefault(id(i), []).append(node)
+
+    has_dispatch = any(isinstance(n, DispatchOp) for n in ctx.topo)
+
+    for node in ctx.topo:
+        # -- AllReduce ------------------------------------------------------
+        if isinstance(node, AllReduceCommunicateOp):
+            if cfg is not None and comm_mode is None:
+                out.append(Finding.at(
+                    node, "allreduce-without-comm-mode", WARN,
+                    "AllReduce marker in a graph built without comm_mode — "
+                    "it lowers to an identity and gradients are NOT reduced "
+                    "across replicas", "comm"))
+            elif cfg is not None and (mesh is None or dp_size <= 1):
+                out.append(Finding.at(
+                    node, "allreduce-degenerate", NOTE,
+                    f"AllReduce over a degenerate data-parallel context "
+                    f"(dp={dp_size}) lowers to an identity", "comm"))
+        # -- PS ops ---------------------------------------------------------
+        if getattr(node, "is_ps", False):
+            if cfg is not None and comm_mode not in ("PS", "Hybrid"):
+                out.append(Finding.at(
+                    node, "ps-op-without-ps-mode", ERROR,
+                    f"{type(node).__name__} requires comm_mode 'PS' or "
+                    f"'Hybrid' (got {comm_mode!r}) — without a PS runtime "
+                    "the push yields None and the optimizer silently skips "
+                    "the parameter forever", "comm"))
+            if isinstance(node, ParameterServerCommunicateOp):
+                grad_in = node.inputs[0]
+                if not getattr(grad_in, "is_gradient", False):
+                    out.append(Finding.at(
+                        node, "ps-push-ignored", WARN,
+                        f"push input {grad_in.name!r} is not a gradient "
+                        "node — the executor only wires gradient pushes, "
+                        "this op's traffic is silently dropped", "comm"))
+            if isinstance(node, ParameterServerSparsePullOp) \
+                    and comm_mode in ("PS", "Hybrid") \
+                    and not _is_fed(node.inputs[1]):
+                out.append(Finding.at(
+                    node, "ps-lookup-index-not-fed", ERROR,
+                    f"index input {node.inputs[1].name!r} is not a feed or "
+                    "dataloader node — PS row staging needs the indices "
+                    "host-side before the step runs", "comm"))
+        # PS-resident embedding lookups have the same staging contract
+        # (is_embed may be declared, or inferred by the comm-insertion
+        # replay and carried in ctx.ps_embed_ids — the replay's attribute
+        # marks are rolled back to keep the graph pristine)
+        embed = getattr(node, "embed_node", None)
+        if embed is not None and comm_mode in ("PS", "Hybrid") \
+                and (getattr(embed, "is_embed", False)
+                     or id(embed) in getattr(ctx, "ps_embed_ids", ())) \
+                and getattr(embed, "trainable", False) \
+                and len(node.inputs) > 1 and not _is_fed(node.inputs[1]):
+            out.append(Finding.at(
+                node, "ps-lookup-index-not-fed", ERROR,
+                f"index input {node.inputs[1].name!r} of this PS-hosted "
+                "lookup is not a feed or dataloader node — the executor "
+                "will reject the graph at build", "comm"))
+        # -- dispatch -------------------------------------------------------
+        if isinstance(node, DispatchOp):
+            if cfg is not None and (
+                    mesh is None
+                    or mp_axis not in getattr(mesh, "axis_names", ())):
+                out.append(Finding.at(
+                    node, "dispatch-no-mp-axis", ERROR,
+                    f"dispatch marker but no {mp_axis!r} mesh axis exists — "
+                    "place the subgraph in a tuple DeviceGroup or pass a "
+                    "mesh with a model-parallel axis", "comm"))
+            in_shape = ag.shape_of(node.inputs[0])
+            if in_shape is not None and len(node.parts) != len(in_shape):
+                out.append(Finding.at(
+                    node, "dispatch-rank-mismatch", ERROR,
+                    f"parts {node.parts} has rank {len(node.parts)} but the "
+                    f"input {node.inputs[0].name!r} has rank "
+                    f"{len(in_shape)} (shape {in_shape})", "comm"))
+        if isinstance(node, DispatchGradientOp) and not has_dispatch:
+            out.append(Finding.at(
+                node, "dispatch-grad-unpaired", WARN,
+                "DispatchGradient without any forward Dispatch marker in "
+                "the graph — the gradient passes through unconstrained",
+                "comm"))
+        # -- pipeline -------------------------------------------------------
+        if isinstance(node, PipelineSendOp):
+            # consumers in this topo, plus registered receivers living
+            # outside it (a validate-target recv still pairs the send —
+            # the backlink avoids a false unconsumed warning)
+            recvs = [c for c in consumers.get(id(node), [])
+                     if isinstance(c, PipelineReceiveOp)]
+            recvs += [r for r in getattr(node, "receivers", [])
+                      if r not in recvs]
+            if not recvs:
+                out.append(Finding.at(
+                    node, "pipeline-send-unconsumed", WARN,
+                    "no paired pipeline_receive_op consumes this send — the "
+                    "stage boundary is declared but never crossed", "comm"))
+            for r in recvs:
+                s_ctx, r_ctx = node.raw_ctx, r.raw_ctx
+                # DeviceGroup defines value equality — two `ht.cpu(0)` ctx
+                # literals wrap into distinct but equal groups
+                if s_ctx is not None and r_ctx is not None \
+                        and s_ctx == r_ctx:
+                    out.append(Finding.at(
+                        r, "pipeline-stage-loop", WARN,
+                        f"receive shares the sending stage's device context "
+                        f"with {node.name!r} — a stage boundary to the same "
+                        "stage is a no-op and usually a mis-scoped "
+                        "ht.context block", "comm"))
+        if isinstance(node, PipelineReceiveOp) \
+                and not isinstance(node.source, PipelineSendOp):
+            out.append(Finding.at(
+                node, "pipeline-recv-source", NOTE,
+                f"source {node.source.name!r} is not a pipeline_send_op — "
+                "pairing by producer works, but an explicit send marker "
+                "makes the stage cut visible to the partitioner", "comm"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dead subgraphs + common subexpressions
+# ---------------------------------------------------------------------------
+
+def dce_pass(ctx) -> list:
+    """Dead-subgraph reporting (needs a recorded universe) and
+    common-subexpression notes."""
+    out = []
+    live = {id(n) for n in ctx.topo}
+    if ctx.universe:
+        dead = [n for n in ctx.universe
+                if id(n) not in live and not n.is_placeholder
+                and not n.is_dataloader]
+        # report only the FRONTIER of each dead cone (dead ops none of whose
+        # consumers are also dead) so one abandoned tower = one finding
+        consumed_by_dead: set = set()
+        for n in dead:
+            for i in n.inputs:
+                if isinstance(i, Op):
+                    consumed_by_dead.add(id(i))
+        for n in dead:
+            if id(n) not in consumed_by_dead:
+                out.append(Finding.at(
+                    n, "dead-subgraph", WARN,
+                    "constructed but unreachable from every eval target — "
+                    f"it will never execute ({len(dead)} dead op(s) total "
+                    "in this graph)", "dce"))
+    # -- CSE ----------------------------------------------------------------
+    seen: dict[tuple, Op] = {}
+    for node in ctx.topo:
+        if not isinstance(node, FunctionalOp) or node.needs_rng \
+                or node.stateful:
+            continue
+        key = (node.opname, tuple(id(i) for i in node.inputs),
+               tuple(sorted((k, repr(v))
+                            for k, v in node.export_attrs.items())))
+        first = seen.get(key)
+        if first is None:
+            seen[key] = node
+        elif node.export_attrs or not _has_closure_params(node):
+            out.append(Finding.at(
+                node, "common-subexpression", NOTE,
+                f"computes the same value as {first.name!r} (same op, "
+                "inputs and attributes) — XLA CSE dedupes it in-program, "
+                "but the duplicate build code is usually unintended", "dce"))
+    return out
+
+
+def _has_closure_params(node) -> bool:
+    """Ops whose constructors close over parameters we cannot compare
+    (no export_attrs): only flag CSE when the fn carries no free variables
+    beyond the module globals."""
+    fn = getattr(node, "fn", None)
+    closure = getattr(fn, "__closure__", None)
+    defaults = getattr(fn, "__defaults__", None)
+    return bool(closure) or bool(defaults)
+
+
+TIER_A_PASSES = (structure_pass, shapes_pass, comm_pass, dce_pass)

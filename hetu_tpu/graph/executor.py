@@ -626,12 +626,17 @@ class SubExecutor:
 
     def last_cost_analysis(self):
         """XLA cost analysis (flops etc.) of the latest executed step, for
-        MFU reporting (reaches the compilation cache — no recompile)."""
+        MFU reporting and the Tier B lints (reaches the compilation cache —
+        no recompile). Normalized to a dict or None: jax 0.4.x returns a
+        single-element LIST wrapping the dict, newer jax the dict itself."""
         try:
             low = self._lowered()
-            return None if low is None else low.compile().cost_analysis()
+            ca = None if low is None else low.compile().cost_analysis()
         except Exception:  # noqa: BLE001 — diagnostics only
             return None
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        return ca if isinstance(ca, dict) else None
 
     def dump_hlo(self, path=None, stage="stablehlo"):
         """The compiled program of the latest executed step as text — the
@@ -866,7 +871,7 @@ class Executor:
     """User-facing executor (reference executor.py:301)."""
 
     def __init__(self, eval_node_dict, ctx=None, seed=None, comm_mode=None,
-                 config=None, **kwargs):
+                 config=None, lint=None, **kwargs):
         if isinstance(eval_node_dict, (list, tuple)):
             eval_node_dict = {"default": list(eval_node_dict)}
         self.eval_node_dict = {k: list(v) for k, v in eval_node_dict.items()}
@@ -892,6 +897,13 @@ class Executor:
             if node.is_optimizer:
                 node.insert_comm_ops(config)
         full_topo = find_topo_sort(all_nodes)
+
+        # -- define-time validation (hetulint Tier A, docs/ANALYSIS.md) -----
+        # Runs over the post-comm-insertion graph — the graph that will
+        # actually trace — and BEFORE any PS server spawns or parameter
+        # materializes, so an invalid graph fails fast with op-level
+        # provenance instead of a deep jit traceback at run time.
+        self._lint(lint)
 
         # -- PS/Hybrid runtime (reference ParameterServerCommunicate.py) ----
         self.ps_runtime = None
@@ -964,6 +976,32 @@ class Executor:
                 self.subexecutors[name] = SubExecutor(name, nodes, self)
 
     # ------------------------------------------------------------------
+    def _lint(self, lint):
+        """Tier A graph validation at build: ``lint`` is "error" (raise
+        ``GraphValidationError`` on error-severity findings), "warn" (report
+        everything as warnings, build anyway) or "off". Defaults to the
+        ``HETU_LINT`` env var, else off."""
+        if lint is None:
+            lint = os.environ.get("HETU_LINT", "off") or "off"
+        if lint == "off":
+            return
+        if lint not in ("error", "warn"):
+            raise ValueError(
+                f"lint must be 'error', 'warn' or 'off', got {lint!r}")
+        from ..analysis import (GraphAnalyzer, GraphValidationError,
+                                format_findings, ERROR)
+        findings = GraphAnalyzer(self.eval_node_dict,
+                                 config=self.config).run()
+        if not findings:
+            return
+        errors = [f for f in findings if f.severity == ERROR]
+        if errors and lint == "error":
+            raise GraphValidationError(findings)
+        import warnings
+        warnings.warn(
+            f"hetulint: {len(findings)} finding(s) on this graph:\n"
+            + format_findings(findings), stacklevel=3)
+
     def _rewire_ps_gradients(self, topo):
         """Point each PS comm op's gradient at the lookup OUTPUT rather than
         the table variable, so the traced grad is (batch_rows, width) instead

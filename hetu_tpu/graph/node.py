@@ -21,10 +21,30 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..context import get_current_context, DeviceGroup
 
 _id_counter = itertools.count()
+
+# Active graph recorders (see hetu_tpu.analysis.record_graph): every Op
+# constructed while a recorder is on the stack is appended to it, giving the
+# analyzer a *universe* of constructed nodes so it can report subgraphs that
+# are dead w.r.t. the eval targets. Empty in normal operation — the per-Op
+# cost is iterating an empty list.
+_graph_recorders: list[list] = []
+
+
+def _as_struct(x) -> jax.ShapeDtypeStruct:
+    """Normalize a shape tuple / array / ShapeDtypeStruct into a struct.
+
+    Bare shape tuples keep the historical ``infer_shape`` contract of
+    assuming float32 inputs (reference Node.py:95 is shape-only)."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return jax.ShapeDtypeStruct(tuple(int(s) for s in x), np.float32)
 
 
 class Op:
@@ -46,6 +66,8 @@ class Op:
         self.raw_ctx = ctx if (ctx is None or isinstance(ctx, DeviceGroup)) else DeviceGroup(ctx)
         self.name = name or f"{type(self).__name__}_{self.id}"
         self.desc = self.name
+        for rec in _graph_recorders:
+            rec.append(self)
 
     # ------------------------------------------------------------------
     def compute(self, input_vals, tc):
@@ -60,16 +82,40 @@ class Op:
         """Initial state pytree for stateful ops."""
         raise NotImplementedError(type(self).__name__)
 
+    def infer_meta(self, inputs, training: bool = False):
+        """Abstract-evaluate this op: input shapes/dtypes -> output
+        ``jax.ShapeDtypeStruct`` without running any computation.
+
+        ``inputs`` items may be bare shape tuples (assumed float32, the
+        historical ``infer_shape`` contract), ``jax.ShapeDtypeStruct``\\ s, or
+        arrays — so integer-indexed ops (embedding lookup, one-hot, sparse
+        pulls) infer correctly when given real dtypes. Works for stateful ops
+        (BatchNorm) by abstract-evaluating ``compute_stateful`` over a fresh
+        ``state_init``. Comm/PS ops evaluate through the abstract trace
+        context's collective identities.
+        """
+        structs = [_as_struct(s) for s in inputs]
+        tc = _AbstractTraceContext(training=training)
+        if self.stateful:
+            state = jax.tree.map(np.asarray, self.state_init())
+
+            def fn(*xs):
+                out, _ = self.compute_stateful(list(xs), state, tc)
+                return out
+        else:
+            def fn(*xs):
+                return self.compute(list(xs), tc)
+        return jax.eval_shape(fn, *structs)
+
     def infer_shape(self, input_shapes):
         """Shape inference via abstract evaluation (reference Node.py:95).
 
         The executor does not need this (XLA infers shapes); it exists for
-        user introspection and tests.
+        user introspection, the analysis passes, and tests. Accepts shape
+        tuples (float32 assumed, API parity) or ``ShapeDtypeStruct``\\ s.
         """
-        structs = [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in input_shapes]
-        tc = _AbstractTraceContext()
-        out = jax.eval_shape(lambda *xs: self.compute(list(xs), tc), *structs)
-        return tuple(out.shape)
+        out = self.infer_meta(input_shapes)
+        return tuple(out.shape) if hasattr(out, "shape") else None
 
     # -- operator overloads (reference Node.py:33-71) -------------------
     def __add__(self, other):
@@ -120,12 +166,42 @@ class Op:
 
 
 class _AbstractTraceContext:
-    """Minimal trace context for ``infer_shape`` abstract evaluation."""
+    """Trace context for abstract evaluation (``infer_shape``/``infer_meta``
+    and the analysis subsystem's whole-graph shape pass).
+
+    Comm and PS ops call collective/RPC hooks on the trace context; during
+    abstract evaluation these reduce to their shape-level identities, so a
+    graph containing AllReduce/Dispatch/pipeline/PS nodes abstract-evaluates
+    end to end instead of crashing on the missing executor services:
+
+    - ``allreduce``/``apply_dispatch``: sharding constraints — value identity.
+    - ``ps_push_pull``: the real hook captures the gradient host-side and the
+      op yields no in-graph value — abstractly ``None``.
+    - ``ps_sparse_pull``: staged row pull — abstractly a gather, giving the
+      (batch..., width) row block the executor would stage.
+    """
 
     training = False
+    config = None
+
+    def __init__(self, training: bool = False):
+        self.training = bool(training)
 
     def next_rng(self, node):
         return jax.random.PRNGKey(0)
+
+    def allreduce(self, x, param_node=None):
+        return x
+
+    def apply_dispatch(self, op, x):
+        return x
+
+    def ps_push_pull(self, op, grad):
+        return None
+
+    def ps_sparse_pull(self, op, vals):
+        table, idx = vals
+        return jnp.take(table, idx.astype(jnp.int32), axis=0)
 
 
 class FunctionalOp(Op):

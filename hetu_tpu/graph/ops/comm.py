@@ -77,6 +77,10 @@ class PipelineSendOp(Op):
     def __init__(self, node, destination=None, comm=None, stream=None, ctx=None):
         super().__init__([node], ctx)
         self.destination = destination
+        # paired PipelineReceiveOps register themselves here; hetulint's
+        # pairing lint consults it so a receiver on another eval target
+        # (outside the analyzed topo) still counts as consuming this send
+        self.receivers: list["PipelineReceiveOp"] = []
 
     def compute(self, input_vals, tc):
         return input_vals[0]
@@ -105,6 +109,8 @@ class PipelineReceiveOp(Op):
                 "XLA equivalent")
         super().__init__([source], ctx)
         self.source = source
+        if isinstance(source, PipelineSendOp):
+            source.receivers.append(self)
 
     def compute(self, input_vals, tc):
         return input_vals[0]
@@ -173,6 +179,9 @@ def dispatch(node, parts, duplicate=1):
 
 
 class DispatchGradientOp(Op):
+    """Gradient-side partition marker paired with a forward DispatchOp
+    (``inputs[1]`` is the paired forward op or its input)."""
+
     def __init__(self, node, forward_input, ctx=None):
         super().__init__([node, forward_input], ctx)
 

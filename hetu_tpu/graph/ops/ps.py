@@ -22,6 +22,12 @@ class ParameterServerCommunicateOp(Op):
         super().__init__([node], ctx)
         self.ps_id = ps_id
         self.optimizer = optimizer
+        # filled by the executor's PS wiring (declared here so graph-level
+        # introspection — hetulint, graphboard — sees stable attributes):
+        # the PS-hosted parameter this push serves, and for sparse tables
+        # the lookup op(s) whose row gradients are concatenated host-side
+        self.ps_param_node = None
+        self.staged_lookups = None
 
     def compute(self, input_vals, tc):
         return tc.ps_push_pull(self, input_vals[0])

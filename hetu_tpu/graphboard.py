@@ -27,6 +27,12 @@ _KIND_COLORS = {
     "GradientOp": "#ffe8a1",
 }
 
+# finding annotation: severity -> (fill, stroke); errors outrank warns
+_SEV_COLORS = {"error": ("#f8d7da", "#c0392b"),
+               "warn": ("#ffe5b4", "#d68910"),
+               "note": (None, "#888888")}
+_SEV_ORDER = ("error", "warn", "note")
+
 
 def _topo_of(executor, name=None):
     subs = getattr(executor, "subexecutors", None)
@@ -37,16 +43,58 @@ def _topo_of(executor, name=None):
     return executor.topo  # a bare SubExecutor
 
 
-def make_dot(executor, name=None) -> str:
-    """DOT source of the topo (the reference's Digraph, sans dependency)."""
+def _findings_by_op(findings):
+    """{op_id: [Finding, ...]} for the node-level findings."""
+    by_op: dict[int, list] = {}
+    for f in findings or ():
+        if f.op_id is not None:
+            by_op.setdefault(f.op_id, []).append(f)
+    return by_op
+
+
+def _worst_severity(fs):
+    for sev in _SEV_ORDER:
+        if any(f.severity == sev for f in fs):
+            return sev
+    return "note"
+
+
+def lint_findings(executor, name=None):
+    """Tier A findings for the executor's graph (used by ``render(...,
+    lint=True)``); Tier B findings are appended when a step has run."""
+    from . import analysis
+    topo = _topo_of(executor, name)
+    eval_nodes = getattr(executor, "eval_node_dict", None)
+    graph = eval_nodes if eval_nodes is not None else list(topo)
+    findings = analysis.GraphAnalyzer(
+        graph, config=getattr(executor, "config", None), target=name).run()
+    if hasattr(executor, "subexecutors"):
+        findings += analysis.analyze_executor(executor)
+    return findings
+
+
+def make_dot(executor, name=None, findings=None) -> str:
+    """DOT source of the topo (the reference's Digraph, sans dependency).
+    ``findings`` (hetulint output) annotate nodes with severity colors and
+    tooltips."""
     lines = ["digraph hetu {", "  rankdir=TB;",
              '  node [shape=box, style="rounded,filled", '
              'fillcolor="#eeeeee", fontname="Helvetica"];']
     topo = _topo_of(executor, name)
+    by_op = _findings_by_op(findings)
     for node in topo:
         color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
         label = node.name.replace('"', "'")
-        lines.append(f'  n{node.id} [label="{label}", fillcolor="{color}"];')
+        extra = ""
+        fs = by_op.get(node.id)
+        if fs:
+            sev = _worst_severity(fs)
+            fill, stroke = _SEV_COLORS[sev]
+            color = fill or color
+            tip = "\\n".join(str(f).replace('"', "'") for f in fs)
+            extra = f', color="{stroke}", penwidth=2, tooltip="{tip}"'
+        lines.append(
+            f'  n{node.id} [label="{label}", fillcolor="{color}"{extra}];')
     for node in topo:
         for src in node.inputs:
             lines.append(f"  n{src.id} -> n{node.id};")
@@ -73,8 +121,9 @@ def _layout(topo):
 NODE_W, NODE_H, GAP_X, GAP_Y = 150, 34, 30, 46
 
 
-def make_svg(executor, name=None) -> str:
+def make_svg(executor, name=None, findings=None) -> str:
     topo = _topo_of(executor, name)
+    by_op = _findings_by_op(findings)
     pos, n_ranks, width = _layout(topo)
     W = width * (NODE_W + GAP_X) + GAP_X
     H = n_ranks * (NODE_H + GAP_Y) + GAP_Y
@@ -102,12 +151,23 @@ def make_svg(executor, name=None) -> str:
     for node in topo:
         x, y = xy(node)
         color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
+        stroke, swidth, tip = "#888", 1, ""
+        fs = by_op.get(node.id)
+        if fs:
+            sev = _worst_severity(fs)
+            fill, stroke = _SEV_COLORS[sev]
+            color = fill or color
+            swidth = 2
+            tip = ("<title>"
+                   + html.escape("\n".join(str(f) for f in fs))
+                   + "</title>")
         label = node.name if len(node.name) <= 22 else node.name[:20] + "…"
         label = html.escape(label)  # escape AFTER truncating: cutting inside
         # an entity would emit a bare '&' and break the XML
         parts.append(
-            f'<g><rect x="{x}" y="{y}" width="{NODE_W}" height="{NODE_H}" '
-            f'rx="6" fill="{color}" stroke="#888"/>'
+            f'<g>{tip}<rect x="{x}" y="{y}" width="{NODE_W}" height="{NODE_H}" '
+            f'rx="6" fill="{color}" stroke="{stroke}" '
+            f'stroke-width="{swidth}"/>'
             f'<text x="{x + NODE_W / 2}" y="{y + NODE_H / 2 + 4}" '
             'font-family="Helvetica" font-size="11" text-anchor="middle">'
             f'{label}</text></g>')
@@ -115,24 +175,40 @@ def make_svg(executor, name=None) -> str:
     return "\n".join(parts)
 
 
-def render(executor, name=None, out_dir="graphboard_out"):
-    """Write output.dot / output.svg / index.html; returns out_dir."""
+def render(executor, name=None, out_dir="graphboard_out", findings=None,
+           lint=False):
+    """Write output.dot / output.svg / index.html; returns out_dir.
+
+    ``lint=True`` runs the hetulint analyzer over the graph (plus Tier B if
+    a step has executed) and annotates offending nodes — severity-colored
+    with hover tooltips — and appends the finding list to index.html.
+    Explicit ``findings`` skip the analyzer run."""
     os.makedirs(out_dir, exist_ok=True)
+    if lint and findings is None:
+        findings = lint_findings(executor, name)
     with open(os.path.join(out_dir, "output.dot"), "w") as f:
-        f.write(make_dot(executor, name))
-    svg = make_svg(executor, name)
+        f.write(make_dot(executor, name, findings=findings))
+    svg = make_svg(executor, name, findings=findings)
     with open(os.path.join(out_dir, "output.svg"), "w") as f:
         f.write(svg)
+    body = "<!doctype html><title>hetu_tpu graphboard</title>" \
+           "<h3>Executor graph</h3>" + svg
+    if findings:
+        items = "".join(
+            f"<li><code>{html.escape(str(f))}</code></li>"
+            for f in findings)
+        body += (f"<h3>hetulint findings ({len(findings)})</h3>"
+                 f"<ul>{items}</ul>")
     with open(os.path.join(out_dir, "index.html"), "w") as f:
-        f.write("<!doctype html><title>hetu_tpu graphboard</title>"
-                "<h3>Executor graph</h3>" + svg)
+        f.write(body)
     return out_dir
 
 
-def show(executor, port=9997, name=None, out_dir="graphboard_out"):
+def show(executor, port=9997, name=None, out_dir="graphboard_out",
+         findings=None, lint=False):
     """Render + serve on a background thread (reference show :11)."""
     global _server, _thread
-    render(executor, name, out_dir)
+    render(executor, name, out_dir, findings=findings, lint=lint)
     close()
 
     def _make(*a, **k):

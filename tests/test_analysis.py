@@ -1,0 +1,536 @@
+"""hetulint: seeded-defect tests (one per lint, asserting severity and
+op-level provenance), the `bin/hetulint --json` CI smoke over the bundled
+example graphs, Tier B lowered-program checks, and the executor/graphboard
+integration. ISSUE 3 acceptance: every shipped lint fires on its seeded
+defect; the recompilation detector flags a signature-churning loop that a
+fixed-shape loop does not trigger."""
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import analysis
+from hetu_tpu.graph.node import FunctionalOp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lints_of(findings, lint):
+    return [f for f in findings if f.lint == lint]
+
+
+def feed(name, shape, dtype=np.float32):
+    return ht.Variable(name=name, value=np.ones(shape, dtype),
+                       dtype=dtype, trainable=False)
+
+
+# ---------------------------------------------------------------------------
+# Tier A seeded defects — one per lint
+# ---------------------------------------------------------------------------
+
+def test_shape_mismatch_localized():
+    x = feed("x", (4, 3))
+    w = feed("w", (4, 5))
+    bad = ht.matmul_op(x, w)
+    good_in = ht.relu_op(bad)  # downstream cone must NOT double-report
+    fs = analysis.analyze_graph([good_in])
+    errs = lints_of(fs, "shape-mismatch")
+    assert len(errs) == 1
+    assert errs[0].severity == "error"
+    assert errs[0].op_name == bad.name          # op-level provenance
+    assert "(4, 3)" in errs[0].message and "(4, 5)" in errs[0].message
+
+
+def test_graph_cycle():
+    x = feed("x", (4,))
+    a = ht.relu_op(x)
+    b = ht.relu_op(a)
+    a.inputs.append(b)  # seed the cycle
+    fs = analysis.analyze_graph([b])
+    errs = lints_of(fs, "graph-cycle")
+    assert errs and errs[0].severity == "error"
+
+
+def test_bad_input():
+    x = feed("x", (4,))
+    a = ht.relu_op(x)
+    a.inputs.append("not-an-op")
+    fs = analysis.analyze_graph([a], options=None)
+    errs = lints_of(fs, "bad-input")
+    assert errs and errs[0].severity == "error" and errs[0].op_name == a.name
+
+
+def test_duplicate_name():
+    w1 = ht.Variable(name="dup_w", value=np.ones((2, 2), np.float32))
+    w2 = ht.Variable(name="dup_w", value=np.ones((2, 2), np.float32))
+    out = ht.matmul_op(w1, w2)
+    fs = analysis.analyze_graph([out])
+    dups = lints_of(fs, "duplicate-name")
+    assert dups and dups[0].severity == "warn"
+    assert "dup_w" in dups[0].message
+
+
+def test_shape_unknown_note_and_skipped_cone():
+    x = ht.Variable(name="x", trainable=False)  # fed at run time, no shape
+    y = ht.relu_op(ht.matmul_op(x, x))
+    fs = analysis.analyze_graph([y])
+    notes = lints_of(fs, "shape-unknown")
+    assert len(notes) == 1 and notes[0].op_name == "x"
+    assert not lints_of(fs, "shape-mismatch")  # cone skipped, not misreported
+
+
+def test_f64_value():
+    w = ht.Variable(name="w64", value=np.ones((2, 2)), dtype=np.float64)
+    fs = analysis.analyze_graph([ht.relu_op(w)])
+    warns = lints_of(fs, "f64-value")
+    assert warns and warns[0].severity == "warn" and warns[0].op_name == "w64"
+
+
+def test_int_float_mix():
+    i = feed("idx", (4,), np.int32)
+    f = feed("valf", (4,), np.float32)
+    mixed = ht.add_op(i, f)
+    fs = analysis.analyze_graph([mixed])
+    notes = lints_of(fs, "int-float-mix")
+    assert notes and notes[0].op_name == mixed.name
+
+
+def test_ps_op_without_ps_mode():
+    g = feed("g", (4, 2))
+    push = ht.parameterServerCommunicate_op(g)
+    cfg = analysis.AnalysisConfig(comm_mode=None)
+    fs = analysis.analyze_graph([push], config=cfg)
+    errs = lints_of(fs, "ps-op-without-ps-mode")
+    assert errs and errs[0].severity == "error" and errs[0].op_name == push.name
+    # and the push input not being a gradient is its own warn
+    assert lints_of(fs, "ps-push-ignored")
+
+
+def test_ps_lookup_index_not_fed():
+    table = ht.init.random_normal((10, 4), stddev=0.1, name="tbl",
+                                  is_embed=True)
+    raw = feed("rawidx", (6,), np.float32)
+    derived = ht.relu_op(raw)  # NOT a feed/dataloader node
+    lk = ht.embedding_lookup_op(table, derived)
+    cfg = analysis.AnalysisConfig(comm_mode="PS")
+    fs = analysis.analyze_graph([lk], config=cfg)
+    errs = lints_of(fs, "ps-lookup-index-not-fed")
+    assert errs and errs[0].severity == "error" and errs[0].op_name == lk.name
+
+
+def test_allreduce_without_comm_mode():
+    g = feed("g2", (4, 2))
+    ar = ht.allreduceCommunicate_op(g)
+    fs = analysis.analyze_graph([ar], config=analysis.AnalysisConfig())
+    warns = lints_of(fs, "allreduce-without-comm-mode")
+    assert warns and warns[0].severity == "warn" and warns[0].op_name == ar.name
+
+
+def test_allreduce_degenerate():
+    g = feed("g3", (4, 2))
+    ar = ht.allreduceCommunicate_op(g)
+    cfg = analysis.AnalysisConfig(comm_mode="AllReduce", dp_size=1)
+    fs = analysis.analyze_graph([ar], config=cfg)
+    assert lints_of(fs, "allreduce-degenerate")
+
+
+def test_dispatch_rank_mismatch():
+    w = ht.Variable(name="wd", value=np.ones((4, 4), np.float32))
+    d = ht.dispatch(w, (1, 2, 1))  # rank 3 parts on a rank 2 input
+    fs = analysis.analyze_graph([d])
+    errs = lints_of(fs, "dispatch-rank-mismatch")
+    assert errs and errs[0].severity == "error" and errs[0].op_name == d.name
+
+
+def test_dispatch_no_mp_axis():
+    w = ht.Variable(name="wd2", value=np.ones((4, 4), np.float32))
+    d = ht.dispatch(w, (1, 2))
+    cfg = analysis.AnalysisConfig(comm_mode="AllReduce", mesh=None)
+    fs = analysis.analyze_graph([d], config=cfg)
+    assert lints_of(fs, "dispatch-no-mp-axis")
+
+
+def test_dispatch_grad_unpaired():
+    g = feed("g4", (4, 2))
+    dg = ht.dispatch_gradient(g, g)
+    fs = analysis.analyze_graph([dg])
+    warns = lints_of(fs, "dispatch-grad-unpaired")
+    assert warns and warns[0].op_name == dg.name
+
+
+def test_pipeline_send_unconsumed_and_stage_loop():
+    x = feed("px", (4, 2))
+    send = ht.pipeline_send_op(x, ctx=ht.cpu(0))
+    fs = analysis.analyze_graph([send])
+    assert lints_of(fs, "pipeline-send-unconsumed")
+
+    # equal-but-distinct ctx literals (DeviceGroup value equality) — the
+    # natural API usage for the seeded same-stage loop
+    send2 = ht.pipeline_send_op(x, ctx=ht.cpu(0))
+    recv2 = ht.pipeline_receive_op(send2, ctx=ht.cpu(0))
+    assert recv2.raw_ctx is not send2.raw_ctx
+    fs2 = analysis.analyze_graph([recv2])
+    assert lints_of(fs2, "pipeline-stage-loop")
+    assert not lints_of(fs2, "pipeline-send-unconsumed")
+    # the receiver back-link registered on construction
+    assert recv2 in send2.receivers
+
+
+def test_pipeline_send_paired_outside_topo_not_flagged():
+    """A receiver on another eval target (outside the analyzed topo) still
+    consumes the send — the registered-receiver backlink prevents a false
+    unconsumed warning."""
+    x = feed("px3", (4, 2))
+    send = ht.pipeline_send_op(x, ctx=ht.cpu(0))
+    ht.pipeline_receive_op(send, ctx=ht.cpu(1))  # lives on another target
+    fs = analysis.analyze_graph([send])          # recv NOT in this topo
+    assert not lints_of(fs, "pipeline-send-unconsumed")
+
+
+def test_pipeline_recv_source_note():
+    x = feed("px2", (4, 2))
+    plain = ht.relu_op(x)
+    recv = ht.pipeline_receive_op(plain)
+    fs = analysis.analyze_graph([recv])
+    assert lints_of(fs, "pipeline-recv-source")
+
+
+def test_dead_subgraph_needs_universe():
+    with analysis.record_graph() as universe:
+        x = feed("live_x", (4, 2))
+        live = ht.relu_op(x)
+        dead_tower = ht.sigmoid_op(ht.relu_op(x))  # built, never returned
+    fs = analysis.GraphAnalyzer([live], universe=universe).run()
+    dead = lints_of(fs, "dead-subgraph")
+    assert len(dead) == 1                       # frontier only, not the cone
+    assert dead[0].op_name == dead_tower.name
+    # without a universe the check cannot run
+    assert not lints_of(analysis.analyze_graph([live]), "dead-subgraph")
+
+
+def test_common_subexpression():
+    x = feed("cse_x", (4, 3))
+    w = feed("cse_w", (3, 5))
+    a = ht.matmul_op(x, w)
+    b = ht.matmul_op(x, w)
+    out = ht.add_op(a, b)
+    fs = analysis.analyze_graph([out])
+    notes = lints_of(fs, "common-subexpression")
+    assert notes and a.name in notes[0].message
+
+
+def test_insert_comm_leaves_graph_untouched():
+    """Linting with insert_comm (hetulint's PS replay) must not mutate the
+    builder's graph: a real Executor built afterwards with its OWN config
+    has to insert its own comm ops and actually train."""
+    x = ht.Variable(name="ic_x", trainable=False)
+    w = ht.init.random_normal((8, 4), stddev=0.1, name="ic_w")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    opt_node = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    inputs_before = list(opt_node.inputs)
+
+    fs = analysis.GraphAnalyzer(
+        [loss, opt_node], config=analysis.AnalysisConfig(comm_mode="PS"),
+        insert_comm=True).run()
+    assert not any(f.severity == "error" for f in fs), fs
+    assert opt_node.inputs == inputs_before          # graph restored
+    assert opt_node._comm_inserted is False
+
+    ex = ht.Executor([loss, opt_node], ctx=ht.cpu(0))  # no comm_mode
+    before = np.asarray(ex.state["params"][id(w)]).copy()
+    ex.run("default", feed_dict={x: np.ones((4, 8), np.float32)})
+    after = np.asarray(ex.state["params"][id(w)])
+    assert not np.array_equal(before, after), \
+        "parameter did not train after linting — lint mutated the graph"
+
+
+def test_insert_comm_infers_ps_tables_without_mutation():
+    """The comm-insertion replay infers lookup-read tables as PS-resident:
+    the staging-contract lint must fire even though the table never declared
+    is_embed — and the inference must not leak onto the graph."""
+    table = ht.init.random_normal((10, 4), stddev=0.1, name="inf_tbl")
+    raw = feed("inf_raw", (6,), np.float32)
+    lk = ht.embedding_lookup_op(table, ht.relu_op(raw))  # computed index
+    loss = ht.reduce_mean_op(lk, [0, 1])
+    opt_node = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    fs = analysis.GraphAnalyzer(
+        [loss, opt_node], config=analysis.AnalysisConfig(comm_mode="PS"),
+        insert_comm=True).run()
+    errs = lints_of(fs, "ps-lookup-index-not-fed")
+    assert errs and errs[0].op_name == lk.name
+    assert getattr(table, "is_embed", False) is False  # graph pristine
+
+
+def test_recompile_budget_zero_single_signature():
+    ex, x = _train_executor("b0")
+    ex.run("default", feed_dict={x: np.ones((4, 8), np.float32)})
+    fs = analysis.recompile_findings(ex.subexecutors["default"], budget=0)
+    assert len(fs) == 1  # one signature over a zero budget — no crash
+    assert "1 distinct step programs" in fs[0].message
+
+
+def test_suppression_node_and_analyzer_level():
+    x = feed("sx", (4, 3))
+    w = feed("sw", (4, 5))
+    bad = ht.matmul_op(x, w)
+    # node-level
+    analysis.suppress(bad, "shape-mismatch")
+    assert not lints_of(analysis.analyze_graph([bad]), "shape-mismatch")
+    # analyzer-level
+    bad2 = ht.matmul_op(x, w)
+    fs = analysis.GraphAnalyzer([bad2], suppress=["shape-mismatch"]).run()
+    assert not lints_of(fs, "shape-mismatch")
+    assert lints_of(analysis.analyze_graph([bad2]), "shape-mismatch")
+
+
+# ---------------------------------------------------------------------------
+# Executor integration
+# ---------------------------------------------------------------------------
+
+def test_executor_lint_error_raises():
+    bad = ht.matmul_op(feed("ex", (4, 3)), feed("ew", (4, 5)))
+    with pytest.raises(analysis.GraphValidationError) as ei:
+        ht.Executor([bad], ctx=ht.cpu(0), lint="error")
+    assert any(f.lint == "shape-mismatch" for f in ei.value.findings)
+    assert bad.name in str(ei.value)
+
+
+def test_executor_lint_warn_builds():
+    bad = ht.matmul_op(feed("ex2", (4, 3)), feed("ew2", (4, 5)))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        ex = ht.Executor([bad], ctx=ht.cpu(0), lint="warn")
+    assert ex is not None
+    assert any("shape-mismatch" in str(w.message) for w in rec)
+
+
+def test_executor_lint_error_clean_graph_runs():
+    a = feed("ca", (4, 3))
+    b = feed("cb", (3, 5))
+    out = ht.matmul_op(a, b)
+    ex = ht.Executor([out], ctx=ht.cpu(0), lint="error")
+    assert ex.run("default")[0].asnumpy().shape == (4, 5)
+
+
+def test_executor_lint_env_var(monkeypatch):
+    monkeypatch.setenv("HETU_LINT", "error")
+    bad = ht.matmul_op(feed("vx", (4, 3)), feed("vw", (4, 5)))
+    with pytest.raises(analysis.GraphValidationError):
+        ht.Executor([bad], ctx=ht.cpu(0))
+
+
+# ---------------------------------------------------------------------------
+# Tier B: lowered-program checks
+# ---------------------------------------------------------------------------
+
+def _train_executor(name, ctx=None, **kwargs):
+    x = ht.Variable(name=f"{name}_x", trainable=False)
+    w = ht.init.random_normal((8, 4), stddev=0.1, name=f"{name}_w")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor([loss, train_op], ctx=ctx or ht.cpu(0), **kwargs)
+    return ex, x
+
+
+def test_recompile_detector_churn_vs_fixed():
+    # signature-churning loop: a new batch size every step
+    ex, x = _train_executor("churn")
+    sub = ex.subexecutors["default"]
+    for n in (2, 3, 4, 5, 6):
+        ex.run("default", feed_dict={x: np.ones((n, 8), np.float32)})
+    fs = analysis.recompile_findings(sub, budget=3)
+    assert len(fs) == 1 and fs[0].severity == "warn"
+    assert "5 distinct step programs" in fs[0].message
+    assert "feed signature" in fs[0].message  # churn component identified
+
+    # fixed-shape loop: same budget, no finding
+    ex2, x2 = _train_executor("fixed")
+    for _ in range(5):
+        ex2.run("default", feed_dict={x2: np.ones((4, 8), np.float32)})
+    assert not analysis.recompile_findings(ex2.subexecutors["default"],
+                                           budget=3)
+
+
+def test_recompile_monitor_reports_growth_once():
+    ex, x = _train_executor("mon")
+    mon = analysis.RecompileMonitor(ex, budget=2)
+    for n in (2, 3, 4, 5):
+        ex.run("default", feed_dict={x: np.ones((n, 8), np.float32)})
+    assert len(mon.check()) == 1
+    assert len(mon.check()) == 0        # no growth since last check
+    ex.run("default", feed_dict={x: np.ones((9, 8), np.float32)})
+    assert len(mon.check()) == 1        # re-reported on growth
+
+
+def test_donation_present_and_missing(monkeypatch):
+    ex, x = _train_executor("don")
+    ex.run("default", feed_dict={x: np.ones((4, 8), np.float32)})
+    assert not analysis.donation_findings(ex.subexecutors["default"])
+
+    monkeypatch.setenv("HETU_NO_DONATE", "1")
+    ex2, x2 = _train_executor("nodon")
+    ex2.run("default", feed_dict={x2: np.ones((4, 8), np.float32)})
+    fs = analysis.donation_findings(ex2.subexecutors["default"])
+    assert len(fs) == 1 and fs[0].lint == "donation-missing"
+
+
+def test_host_transfer_detected():
+    import jax
+
+    def noisy(v):
+        jax.debug.print("v {}", v[0, 0])
+        return v
+
+    x = feed("ht_x", (2, 2))
+    op = FunctionalOp("Noisy", noisy, [x])
+    ex = ht.Executor([op], ctx=ht.cpu(0))
+    ex.run("default", feed_dict={x: np.ones((2, 2), np.float32)})
+    fs = analysis.host_transfer_findings(ex.subexecutors["default"])
+    assert fs and fs[0].lint == "host-transfer"
+
+    # clean program: no finding
+    y = feed("ht_y", (2, 2))
+    ex2 = ht.Executor([ht.relu_op(y)], ctx=ht.cpu(0))
+    ex2.run("default", feed_dict={y: np.ones((2, 2), np.float32)})
+    assert not analysis.host_transfer_findings(ex2.subexecutors["default"])
+
+
+def test_replicated_large_tensor():
+    x = ht.Variable(name="rep_x", trainable=False)
+    w = ht.init.random_normal((64, 32), stddev=0.1, name="rep_w")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor([loss, train_op], ctx=[ht.cpu(0), ht.cpu(1)],
+                     comm_mode="AllReduce")
+    ex.run("default", feed_dict={x: np.ones((4, 64), np.float32)})
+    sub = ex.subexecutors["default"]
+    fs = analysis.replicated_tensor_findings(sub, threshold_bytes=1024)
+    assert len(fs) == 1 and fs[0].op_name == "rep_w"
+    assert "2-way dp axis" in fs[0].message
+    # above the real size: silent
+    assert not analysis.replicated_tensor_findings(sub,
+                                                   threshold_bytes=1 << 30)
+    # cost analysis is normalized to a dict on this jax
+    assert isinstance(analysis.cost_analysis_of(sub), dict)
+
+
+def test_analyze_executor_aggregates():
+    ex, x = _train_executor("agg")
+    for n in (2, 3, 4, 5, 6):
+        ex.run("default", feed_dict={x: np.ones((n, 8), np.float32)})
+    fs = analysis.analyze_executor(ex, budget=3)
+    assert any(f.lint == "recompile-budget" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (tier-1 fast): bundled example graphs lint clean
+# ---------------------------------------------------------------------------
+
+def test_hetulint_cli_json_smoke():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetulint"), "--json",
+         "hetu_tpu.analysis.examples:build_mlp",
+         "hetu_tpu.analysis.examples:build_transformer",
+         "hetu_tpu.analysis.examples:build_ctr_ps"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"]
+    assert len(report["results"]) == 3
+    for res in report["results"]:
+        assert res["ok"], res
+        assert res["counts"]["error"] == 0
+        for f in res["findings"]:  # any finding still carries provenance
+            assert f["lint"] and f["severity"] and f["op"]
+
+
+def test_hetulint_cli_catches_seeded_defect(tmp_path):
+    bad = tmp_path / "badgraph.py"
+    bad.write_text(
+        "import numpy as np\nimport hetu_tpu as ht\n"
+        "def build():\n"
+        "    a = ht.Variable(name='a', value=np.ones((4, 3), np.float32))\n"
+        "    b = ht.Variable(name='b', value=np.ones((4, 5), np.float32))\n"
+        "    return [ht.matmul_op(a, b)]\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetulint"), "--json",
+         f"{bad}:build"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    assert not report["ok"]
+    finding = report["results"][0]["findings"][0]
+    assert finding["lint"] == "shape-mismatch"
+    assert finding["op"].startswith("MatMul")
+
+
+def test_hetulint_cli_per_target_ok_respects_fail_on(tmp_path):
+    """--fail-on warn: a warn-only target must report ok=false in the JSON,
+    matching the exit status."""
+    warn_only = tmp_path / "warn_only.py"
+    warn_only.write_text(
+        "import numpy as np\nimport hetu_tpu as ht\n"
+        "def build():\n"
+        "    w = ht.Variable(name='w64', value=np.ones((2, 2)),\n"
+        "                    dtype=np.float64)\n"           # f64-value warn
+        "    return [ht.relu_op(w)]\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    base = [sys.executable, os.path.join(REPO, "bin", "hetulint"), "--json",
+            f"{warn_only}:build"]
+    strict = subprocess.run(base + ["--fail-on", "warn"],
+                            capture_output=True, text=True, env=env,
+                            cwd=REPO, timeout=300)
+    assert strict.returncode == 1
+    rep = json.loads(strict.stdout)
+    assert not rep["ok"] and not rep["results"][0]["ok"]
+    lax = subprocess.run(base, capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=300)  # default --fail-on error
+    assert lax.returncode == 0
+    rep = json.loads(lax.stdout)
+    assert rep["ok"] and rep["results"][0]["ok"]
+
+
+def test_hetulint_cli_json_survives_broken_builder(tmp_path):
+    """A failing builder must still emit a well-formed --json report (with
+    the partial results) on stdout, exit 2."""
+    broken = tmp_path / "broken.py"
+    broken.write_text("def build():\n    raise RuntimeError('boom')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetulint"), "--json",
+         "hetu_tpu.analysis.examples:build_mlp", f"{broken}:build"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 2
+    report = json.loads(proc.stdout)     # stdout stays machine-readable
+    assert not report["ok"]
+    assert report["results"][0]["ok"]    # the good target's result kept
+    assert "boom" in report["results"][1]["error"]
+    assert "boom" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# graphboard annotation
+# ---------------------------------------------------------------------------
+
+def test_graphboard_lint_annotation(tmp_path):
+    bad = ht.matmul_op(feed("gx", (4, 3)), feed("gw", (4, 5)))
+    ex = ht.Executor([bad], ctx=ht.cpu(0), lint="off")
+    out = ht.graphboard.render(ex, out_dir=str(tmp_path), lint=True)
+    html_text = open(os.path.join(out, "index.html")).read()
+    assert "hetulint findings" in html_text
+    assert "shape-mismatch" in html_text
+    svg = open(os.path.join(out, "output.svg")).read()
+    assert "<title>" in svg          # tooltip on the offending node
+    dot = open(os.path.join(out, "output.dot")).read()
+    assert "tooltip=" in dot

@@ -1,0 +1,214 @@
+"""Abstract-eval <-> executor parity: for every op family under
+``graph/ops/``, the shapes and dtypes the analysis subsystem infers
+statically must match what the real executor produces at run time.
+
+One executor per family (all of the family's case nodes evaluated in a
+single jitted program) keeps the suite tier-1 fast."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import analysis
+
+
+def _feed(name, arr):
+    node = ht.Variable(name=name, trainable=False,
+                       dtype=arr.dtype, batch=False)
+    return node, arr
+
+
+def _rand(shape, seed, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+def _cases_arith():
+    x, xv = _feed("a_x", _rand((4, 3), 0))
+    y, yv = _feed("a_y", _rand((4, 3), 1))
+    c, cv = _feed("a_c", np.asarray(
+        np.random.RandomState(2).rand(4, 3) > 0.5, np.float32))
+    feeds = {x: xv, y: yv, c: cv}
+    nodes = [
+        ht.add_op(x, y), ht.addbyconst_op(x, 1.5), ht.mul_op(x, y),
+        ht.mul_byconst_op(x, 2.0), ht.div_op(x, y), ht.div_const_op(3.0, y),
+        ht.opposite_op(x), ht.sqrt_op(ht.mul_op(x, x)),
+        ht.rsqrt_op(ht.addbyconst_op(ht.mul_op(x, x), 1.0)),
+        ht.oneslike_op(x), ht.zeroslike_op(x), ht.where_op(c, x, y),
+        ht.relu_op(x), ht.relu_gradient_op(x, y),
+        ht.leaky_relu_op(x, 0.1), ht.leaky_relu_gradient_op(x, y, 0.1),
+        ht.sigmoid_op(x), ht.tanh_op(x), ht.gelu_op(x), ht.exp_op(x),
+        ht.log_op(ht.exp_op(x)), ht.softmax_op(x),
+        ht.softmax_gradient_op(ht.softmax_op(x), y),
+    ]
+    return nodes, feeds
+
+
+def _cases_shape():
+    x, xv = _feed("s_x", _rand((4, 6), 3))
+    b, bv = _feed("s_b", _rand((6,), 4))
+    feeds = {x: xv, b: bv}
+    nodes = [
+        ht.array_reshape_op(x, (2, 12)), ht.array_reshape_gradient_op(x, x),
+        ht.transpose_op(x, (1, 0)), ht.slice_op(x, (1, 2), (2, 3)),
+        ht.slice_gradient_op(ht.slice_op(x, (0, 0), (2, 3)), (0, 0), (4, 6)),
+        ht.split_op(x, 1, 0, 2), ht.split_gradient_op(
+            ht.split_op(x, 1, 0, 2), 1, 0, 2),
+        ht.concat_op(x, x, 1), ht.concat_gradient_op(
+            ht.concat_op(x, x, 1), x, 1, 0),
+        ht.pad_op(x, [(1, 1), (2, 2)]),
+        ht.pad_gradient_op(ht.pad_op(x, [(1, 1), (2, 2)]), [(1, 1), (2, 2)]),
+        ht.broadcastto_op(b, x), ht.broadcast_shape_op(b, (4, 6)),
+        ht.reduce_sum_op(x, [0]), ht.reduce_mean_op(x, [1], keepdims=True),
+        ht.reducesumaxiszero_op(x),
+    ]
+    return nodes, feeds
+
+
+def _cases_matmul():
+    x, xv = _feed("m_x", _rand((4, 3), 5))
+    w, wv = _feed("m_w", _rand((3, 5), 6))
+    bx, bxv = _feed("m_bx", _rand((2, 4, 3), 7))
+    bw, bwv = _feed("m_bw", _rand((2, 3, 5), 8))
+    feeds = {x: xv, w: wv, bx: bxv, bw: bwv}
+    nodes = [
+        ht.matmul_op(x, w), ht.matmul_op(x, x, trans_B=True),
+        ht.batch_matmul_op(bx, bw),
+        ht.batch_matmul_op(bx, bx, trans_B=True),
+        ht.matrix_dot_op(x, x),
+    ]
+    return nodes, feeds
+
+
+def _cases_conv():
+    x, xv = _feed("c_x", _rand((2, 3, 8, 8), 9))
+    f, fv = _feed("c_f", _rand((4, 3, 3, 3), 10))
+    feeds = {x: xv, f: fv}
+    nodes = [
+        ht.conv2d_op(x, f, padding=1, stride=1),
+        ht.max_pool2d_op(x, 2, 2, padding=0, stride=2),
+        ht.avg_pool2d_op(x, 2, 2, padding=0, stride=2),
+    ]
+    return nodes, feeds
+
+
+def _cases_norm():
+    x, xv = _feed("n_x", _rand((4, 3, 6, 6), 11))
+    h, hv = _feed("n_h", _rand((4, 10), 12))
+    feeds = {x: xv, h: hv}
+    bn_s = ht.init.ones((3,), name="pn_bn_s")
+    bn_b = ht.init.zeros((3,), name="pn_bn_b")
+    ln_s = ht.init.ones((10,), name="pn_ln_s")
+    ln_b = ht.init.zeros((10,), name="pn_ln_b")
+    nodes = [
+        ht.batch_normalization_op(x, bn_s, bn_b),
+        ht.layer_normalization_op(h, ln_s, ln_b),
+        ht.instance_normalization2d_op(x),
+    ]
+    return nodes, feeds
+
+
+def _cases_dropout():
+    x, xv = _feed("d_x", _rand((4, 6), 13))
+    feeds = {x: xv}
+    nodes = [ht.dropout_op(x, 0.5),
+             ht.dropout_gradient_op(x, 0.5, ht.dropout_op(x, 0.5))]
+    return nodes, feeds
+
+
+def _cases_losses():
+    logits, lv = _feed("l_logits", _rand((8, 5), 14))
+    labels_np = np.zeros((8, 5), np.float32)
+    labels_np[np.arange(8), np.arange(8) % 5] = 1.0
+    labels, labv = _feed("l_labels", labels_np)
+    pred, pv = _feed("l_pred", np.random.RandomState(15)
+                     .rand(8, 5).astype(np.float32))
+    dl, dlv = _feed("l_dl", _rand((8,), 16))
+    feeds = {logits: lv, labels: labv, pred: pv, dl: dlv}
+    nodes = [
+        ht.softmaxcrossentropy_op(logits, labels),
+        ht.softmaxcrossentropy_gradient_op(logits, labels, dl),
+        ht.binarycrossentropy_op(pred, labels),
+        ht.binarycrossentropy_gradient_op(pred, labels, pred),
+    ]
+    return nodes, feeds
+
+
+def _cases_embedding():
+    idx, idxv = _feed("e_idx", np.random.RandomState(17)
+                      .randint(0, 10, size=(4, 6)).astype(np.int32))
+    vec, vecv = _feed("e_vec", _rand((4, 6, 8), 18))
+    feeds = {idx: idxv, vec: vecv}
+    table = ht.init.random_normal((10, 8), stddev=0.1, name="pn_table")
+    nodes = [
+        ht.embedding_lookup_op(table, idx),
+        ht.one_hot_op(idx, 12),
+        ht.embedding_lookup_gradient_op(vec, idx, (10, 8)),
+    ]
+    return nodes, feeds
+
+
+def _cases_comm():
+    x, xv = _feed("cm_x", _rand((4, 3), 19))
+    feeds = {x: xv}
+    send = ht.pipeline_send_op(ht.relu_op(x))
+    nodes = [
+        ht.allreduceCommunicate_op(x),
+        ht.datah2d_op(x), ht.datad2h_op(x),
+        send, ht.pipeline_receive_op(send),
+    ]
+    return nodes, feeds
+
+
+def _cases_gradients():
+    x, xv = _feed("gr_x", _rand((4, 3), 20))
+    feeds = {x: xv}
+    w = ht.init.random_normal((3, 5), stddev=0.1, name="pn_gw")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    (grad,) = ht.gradients(loss, [w])
+    return [loss, grad], feeds
+
+
+FAMILIES = {
+    "arith": _cases_arith,
+    "shape": _cases_shape,
+    "matmul": _cases_matmul,
+    "conv": _cases_conv,
+    "norm": _cases_norm,
+    "dropout": _cases_dropout,
+    "losses": _cases_losses,
+    "embedding": _cases_embedding,
+    "comm": _cases_comm,
+    "gradients": _cases_gradients,
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_abstract_eval_matches_executor(family):
+    nodes, feeds = FAMILIES[family]()
+
+    ex = ht.Executor(list(nodes), ctx=ht.cpu(0))
+    results = ex.run("default", feed_dict=feeds,
+                     convert_to_numpy_ret_vals=True)
+
+    topo = ht.find_topo_sort(nodes)
+    ag = analysis.AbstractGraph(topo, feed_meta=feeds).evaluate()
+    assert not ag.failures, ag.failures
+    assert not ag.unknown_roots, ag.unknown_roots
+
+    for node, real in zip(nodes, results):
+        meta = ag.meta.get(id(node))
+        assert meta is not None, f"{family}: no abstract meta for {node.name}"
+        assert tuple(meta.shape) == tuple(real.shape), \
+            f"{family}/{node.name}: abstract {tuple(meta.shape)} " \
+            f"!= executor {tuple(real.shape)}"
+        assert np.dtype(meta.dtype) == real.dtype, \
+            f"{family}/{node.name}: abstract dtype {meta.dtype} " \
+            f"!= executor {real.dtype}"
+
+
+def test_infer_shape_shape_only_signature_parity():
+    """The historical shape-only ``infer_shape`` contract keeps working."""
+    x = ht.Variable(name="iso_x", trainable=False)
+    w = ht.Variable(name="iso_w", trainable=False)
+    assert ht.matmul_op(x, w).infer_shape([(7, 3), (3, 2)]) == (7, 2)
+    assert ht.relu_op(x).infer_shape([(5, 5)]) == (5, 5)
+    assert ht.reduce_sum_op(x, [0]).infer_shape([(4, 6)]) == (6,)
