@@ -173,6 +173,9 @@ void Finalize() {
       if (g_server_sched_conn) {
         hetups::Message bye;
         bye.head.type = static_cast<int32_t>(hetups::PsfType::kShutdown);
+        // identity-tagged checkout (scheduler wait() diagnostics)
+        int32_t who[2] = {0, g_server->rank()};
+        bye.args.push_back(hetups::Arg::i32(who, 2));
         try {
           g_server_sched_conn->send(bye);
         } catch (...) {
@@ -184,7 +187,14 @@ void Finalize() {
       g_server.reset();
     }
     if (g_scheduler) {
-      g_scheduler->wait();
+      // a timed-out SchedulerWait() already gave up (wait() returns
+      // immediately then); a first-time timeout here must still tear down
+      try {
+        g_scheduler->wait();
+      } catch (const std::exception& e) {
+        g_last_error = e.what();
+        std::fprintf(stderr, "[hetups] %s\n", e.what());
+      }
       g_scheduler->stop();
       g_scheduler.reset();
     }
@@ -325,6 +335,16 @@ void startRecord(const char* dir) {
 const char* getLoads() {
   guard([] { g_loads = worker().get_loads(); });
   return g_loads.c_str();
+}
+
+// Per-server HA counters: fills up to n of [updates, snapshot_updates,
+// restored_updates (-1 = fresh), snapshot_version, n_params].
+void QueryServerStats(int server, long long* out, int n) {
+  guard([&] {
+    auto v = worker().server_stats(static_cast<size_t>(server));
+    for (int i = 0; i < n && i < static_cast<int>(v.size()); ++i)
+      out[i] = static_cast<long long>(v[i]);
+  });
 }
 
 int rank() { return g_worker ? worker().rank() : 0; }

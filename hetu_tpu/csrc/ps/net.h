@@ -42,6 +42,7 @@ enum class PsfType : int32_t {
   kAck = 4,
   kHeartbeat = 5,      // server -> scheduler keepalive (reference van.cc:27,569)
   kQueryServers = 6,   // any -> scheduler: current address book + liveness
+  kServerStats = 7,    // worker -> server: update/snapshot/restore counters
   // dense
   kDensePush = 10,
   kDensePull = 11,

@@ -12,6 +12,7 @@
 #include <condition_variable>
 #include <mutex>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include "net.h"
@@ -46,12 +47,53 @@ class Scheduler {
     conn_threads_.join_all();
   }
 
-  // Blocks until every node has sent kShutdown (clean cluster teardown).
+  // Blocks until every node has sent kShutdown (clean cluster teardown) —
+  // bounded by DMLC_PS_SCHED_WAIT_TIMEOUT_MS (default 5 min; <= 0 waits
+  // forever). The clock arms when teardown BEGINS (the first kShutdown
+  // arrives) and re-arms on every further checkout: wait() is entered at
+  // cluster STARTUP, so a timeout measured from entry would kill any
+  // healthy run longer than the knob mid-training. A rank that died before
+  // checkout shows up as no progress within one window once the others
+  // check out, and the timeout throws a diagnostic naming it. (A cluster
+  // where NOBODY checks out is the launcher's reap path — workers send no
+  // heartbeats, so the scheduler cannot tell that from a long quiet run.)
+  // A second call after a timeout returns immediately so Finalize() can
+  // still tear the scheduler down.
   void wait() {
     std::unique_lock<std::mutex> g(mu_);
-    done_cv_.wait(g, [this] {
-      return shutdowns_ >= num_servers_ + num_workers_;
-    });
+    if (gave_up_) return;
+    auto pred = [this] { return shutdowns_ >= num_servers_ + num_workers_; };
+    if (wait_timeout_ms_ <= 0) {
+      done_cv_.wait(g, pred);
+      return;
+    }
+    done_cv_.wait(g, [this] { return shutdowns_ > 0; });
+    int last = shutdowns_;
+    while (!pred()) {
+      done_cv_.wait_for(g, std::chrono::milliseconds(wait_timeout_ms_),
+                        [&] { return pred() || shutdowns_ != last; });
+      if (shutdowns_ == last && !pred()) break;  // window expired, no progress
+      last = shutdowns_;
+    }
+    if (pred()) return;
+    gave_up_ = true;
+    auto seen = [this](int role, int id) {
+      for (auto& p : checked_out_)
+        if (p.first == role && p.second == id) return true;
+      return false;
+    };
+    std::string sv, wk;
+    for (int i = 0; i < num_servers_; ++i)
+      if (!seen(0, i)) sv += (sv.empty() ? "" : ",") + std::to_string(i);
+    for (int i = 0; i < num_workers_; ++i)
+      if (!seen(1, i)) wk += (wk.empty() ? "" : ",") + std::to_string(i);
+    throw std::runtime_error(
+        "hetups scheduler: teardown wait timed out after " +
+        std::to_string(wait_timeout_ms_) + " ms (" +
+        std::to_string(shutdowns_) + "/" +
+        std::to_string(num_servers_ + num_workers_) +
+        " shutdowns received); never checked out: servers [" + sv +
+        "] workers [" + wk + "] — those ranks likely died before teardown");
   }
 
  private:
@@ -76,6 +118,7 @@ class Scheduler {
           const int32_t* meta = req.args[0].as_i32();
           std::string host = req.args[1].as_str();
           std::unique_lock<std::mutex> g(mu_);
+          int32_t epoch = 0;
           if (meta[0] == 0) {
             if (meta[1] < 0 || meta[1] >= num_servers_) {
               std::fprintf(stderr,
@@ -117,6 +160,19 @@ class Scheduler {
             ++servers_seen_;
           } else {
             ++workers_seen_;
+            // per-rank incarnation epoch: a RESTARTED worker reuses its
+            // rank's client_id, and the servers' dedup slots (live or
+            // snapshot-restored) outlive it. The scheduler is the one
+            // party that observes every incarnation in order, so its
+            // counter — not the worker's wall clock, which NTP can step
+            // backwards — is what guarantees each incarnation's req_ids
+            // start above the previous one's.
+            if (meta[1] >= 0 && meta[1] < num_workers_) {
+              if (worker_incarnations_.size() <
+                  static_cast<size_t>(num_workers_))
+                worker_incarnations_.resize(num_workers_, 0);
+              epoch = ++worker_incarnations_[meta[1]];
+            }
           }
           reg_cv_.notify_all();
           reg_cv_.wait(g, [this] {
@@ -128,6 +184,7 @@ class Scheduler {
           rsp.head.type = static_cast<int32_t>(PsfType::kAddressBook);
           rsp.head.req_id = req.head.req_id;
           rsp.args.push_back(Arg::str(book));
+          rsp.args.push_back(Arg::i32(&epoch, 1));  // 0 for servers
           g.unlock();
           try {
             send_msg(fd, rsp);
@@ -196,8 +253,14 @@ class Scheduler {
           break;
         }
         case PsfType::kShutdown: {
+          // optional args: i32[role, id] — who is checking out (lets the
+          // bounded wait() name the ranks that never did)
           std::unique_lock<std::mutex> g(mu_);
           ++shutdowns_;
+          if (!req.args.empty() && req.args[0].size() >= 8) {
+            const int32_t* m = req.args[0].as_i32();
+            checked_out_.push_back({m[0], m[1]});
+          }
           done_cv_.notify_all();
           goto out;
         }
@@ -233,9 +296,13 @@ class Scheduler {
   // kQueryServers clients (reference heartbeat_timeout, van.cc:27)
   int hb_timeout_ms_ = env_int_or("DMLC_PS_HEARTBEAT_TIMEOUT_MS", 10000);
   int servers_seen_ = 0, workers_seen_ = 0;
+  std::vector<uint32_t> worker_incarnations_;  // per-rank kRegister count
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
   int shutdowns_ = 0;
+  std::vector<std::pair<int, int>> checked_out_;  // (role, id) per kShutdown
+  int wait_timeout_ms_ = env_int_or("DMLC_PS_SCHED_WAIT_TIMEOUT_MS", 300000);
+  bool gave_up_ = false;
 };
 
 }  // namespace hetups

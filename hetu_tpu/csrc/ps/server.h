@@ -10,9 +10,13 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
+#include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <filesystem>
 #include <memory>
 #include <mutex>
@@ -25,12 +29,39 @@
 
 namespace hetups {
 
+// The single truthy-env convention shared with the Python side
+// (resilience.env_truthy): destructive test hooks are inert without it.
+inline bool env_test_mode() {
+  const char* v = std::getenv("HETU_TEST_MODE");
+  if (!v) return false;
+  std::string s(v);
+  for (auto& c : s) c = static_cast<char>(std::tolower(c));
+  return s == "1" || s == "true" || s == "yes" || s == "on";
+}
+
 class PsServer {
  public:
   PsServer(int rank, const std::string& host, int port)
       : rank_(rank), host_(host), port_(port) {
     const char* v = std::getenv("DMLC_PS_VALIDATE");
     validate_ = v && *v && *v != '0';
+    const char* sd = std::getenv("DMLC_PS_SNAPSHOT_DIR");
+    if (sd && *sd) snapshot_dir_ = sd;
+    snapshot_ms_ = env_int_or("DMLC_PS_SNAPSHOT_MS", 5000);
+    // deterministic fault hook for the dedup-proof tests: _Exit right after
+    // the Nth optimizer update completes but BEFORE its response is sent
+    // (the applied-but-unacked window resend dedup exists for). Optional
+    // ":snap" takes a final synchronous snapshot first, so the apply AND its
+    // dedup-ledger entry are on disk for the replacement. Inert without
+    // HETU_TEST_MODE (same gate as resolve_test_kill_index).
+    const char* tx = std::getenv("HETU_PS_TEST_EXIT_AFTER_UPDATES");
+    if (tx && *tx && env_test_mode()) {
+      std::string spec(tx);
+      auto colon = spec.find(':');
+      test_exit_snap_ = colon != std::string::npos &&
+                        spec.substr(colon + 1) == "snap";
+      test_exit_after_updates_ = std::atol(spec.c_str());
+    }
   }
 
   ~PsServer() { stop(); }
@@ -49,12 +80,20 @@ class PsServer {
     }
     running_ = true;
     accept_thread_ = std::thread([this] { accept_loop(); });
+    if (!snapshot_dir_.empty() && snapshot_ms_ > 0)
+      snapshot_thread_ = std::thread([this] { snapshot_loop(); });
   }
 
   int port() const { return port_; }
 
   void stop() {
     running_ = false;
+    {
+      std::lock_guard<std::mutex> g(snap_mu_);
+      snap_stop_ = true;
+    }
+    snap_cv_.notify_all();
+    if (snapshot_thread_.joinable()) snapshot_thread_.join();
     if (listen_fd_ >= 0) {
       ::shutdown(listen_fd_, SHUT_RDWR);
       ::close(listen_fd_);
@@ -91,6 +130,19 @@ class PsServer {
     std::mutex mu;
     uint64_t last_id = 0;
     Message rsp;
+    // false when last_id was restored from a snapshot's dedup ledger: the
+    // request already APPLIED (it is inside the restored state) but the
+    // response payload was never persisted — a resend re-executes with
+    // skip_apply so reads are answered without double-applying the write.
+    bool has_rsp = false;
+    // provenance of the last request's applied write (0 = read-only or
+    // restored-from-snapshot): take_snapshot's ledger filter compares
+    // write_seq against the seq its target param's file was saved at, so
+    // a write that landed AFTER the file was written is left out of the
+    // ledger (re-issue re-applies it) instead of being silently acked as
+    // a skip_apply duplicate — see the kManifestMagic comment
+    uint64_t write_seq = 0;
+    int32_t write_key = -1;
   };
 
   ClientSlot* client_slot(int32_t client_id) {
@@ -113,16 +165,22 @@ class PsServer {
               ? client_slot(req.head.client_id)
               : nullptr;
       std::unique_lock<std::mutex> slot_g;
+      bool skip_apply = false;
       if (slot) {
         slot_g = std::unique_lock<std::mutex>(slot->mu);
-        if (req.head.req_id == slot->last_id) {
-          // duplicate of the last executed request: replay the response
-          try {
-            send_msg(fd, slot->rsp);
-          } catch (...) {
-            break;
+        if (req.head.req_id == slot->last_id && slot->last_id > 0) {
+          if (slot->has_rsp) {
+            // duplicate of the last executed request: replay the response
+            try {
+              send_msg(fd, slot->rsp);
+            } catch (...) {
+              break;
+            }
+            continue;
           }
-          continue;
+          // restored-ledger duplicate: the write already landed before the
+          // snapshot — re-execute read-only (fall through with skip_apply)
+          skip_apply = true;
         }
         if (req.head.req_id < slot->last_id) {
           // stale straggler from a pre-reconnect stream (a newer request
@@ -135,8 +193,9 @@ class PsServer {
       rsp.head.type = static_cast<int32_t>(PsfType::kAck);
       rsp.head.tensor_id = req.head.tensor_id;
       rsp.head.req_id = req.head.req_id;
+      uint64_t wseq = 0;
       try {
-        handle(req, &rsp);
+        handle(req, &rsp, skip_apply, &wseq);
       } catch (const std::exception& e) {
         std::fprintf(stderr, "[hetups server %d] error on psf %d tensor %d: %s\n",
                      rank_, req.head.type, req.head.tensor_id, e.what());
@@ -147,6 +206,28 @@ class PsServer {
       if (slot) {
         slot->last_id = req.head.req_id;
         slot->rsp = std::move(rsp);  // no payload copy; slot mutex still held
+        slot->has_rsp = true;
+        slot->write_seq = wseq;
+        slot->write_key = req.head.tensor_id;
+      }
+      if (test_exit_after_updates_ >= 0 &&
+          update_count_.load() >=
+              static_cast<uint64_t>(test_exit_after_updates_)) {
+        // fault hook: die applied-but-unacked (see constructor). The slot
+        // lock must drop first — the final snapshot reads the dedup ledger.
+        if (slot_g.owns_lock()) slot_g.unlock();
+        if (test_exit_snap_) {
+          try {
+            take_snapshot();
+          } catch (...) {
+          }
+        }
+        std::fprintf(stderr,
+                     "[hetups server %d] TEST exit after %ld updates "
+                     "(response for req %llu never sent)\n",
+                     rank_, test_exit_after_updates_,
+                     (unsigned long long)req.head.req_id);
+        std::_Exit(137);
       }
       try {
         send_msg(fd, slot ? slot->rsp : rsp);
@@ -181,11 +262,38 @@ class PsServer {
     return uo;
   }
 
-  void handle(Message& req, Message* rsp) {
+  // One logical optimizer update is ONE counter tick (a sparse push of N
+  // rows is one update, matching begin_update's Adam-step contract). The
+  // counter is what snapshot manifests stamp — recovery reports exactly how
+  // many updates the restored state is behind.
+  void begin_req(Param& p) {
+    begin_update(p);
+    update_count_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // `skip_apply`: re-execution of a request whose write already landed in
+  // the restored snapshot (dedup-ledger duplicate) — perform reads, answer
+  // normally, but never mutate. `write_seq` (when non-null) receives the
+  // seq stamped on this request's applied write, 0 for read-only requests.
+  void handle(Message& req, Message* rsp, bool skip_apply = false,
+              uint64_t* write_seq = nullptr) {
     const auto type = static_cast<PsfType>(req.head.type);
     const int32_t key = req.head.tensor_id;
+    // stamp an applied write while the param's exclusive lock is held —
+    // the lock is what orders the stamp against save_param_file's read of
+    // last_write_seq, making the snapshot's ledger filter race-free
+    auto mark = [&](Param& pm) {
+      pm.last_write_seq =
+          write_seq_gen_.fetch_add(1, std::memory_order_relaxed) + 1;
+      if (write_seq) *write_seq = pm.last_write_seq;
+    };
     switch (type) {
       case PsfType::kParamInit: {
+        // deliberately NOT skip_apply-gated: init is idempotent (re-init of
+        // a sized param is a no-op below), and a param created between the
+        // snapshot's key scan and its ledger capture exists in the ledger
+        // but not on disk — suppressing the re-issued init would make that
+        // key permanently uninitializable on the replacement
         // args: i64[kind, len, width, init_type, otype, n_lr],
         //       f64[a, b], u64[seed], f32 lrs
         const int64_t* meta = req.args[0].as_i64();
@@ -216,15 +324,18 @@ class PsServer {
         alloc_slots(*p);
         if (p->kind == ParamKind::kCacheTable)
           p->versions.assign(p->rows, 0);
+        mark(*p);
         break;
       }
       case PsfType::kDensePush: {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
+        if (skip_apply) break;
+        begin_req(*p);
         apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
                      parse_opts(req, 1));
+        mark(*p);
         break;
       }
       case PsfType::kDensePull: {
@@ -238,9 +349,12 @@ class PsServer {
         Param* p = store_.get(key);
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
-        begin_update(*p);
-        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
-                     parse_opts(req, 1));
+        if (!skip_apply) {
+          begin_req(*p);
+          apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
+                       parse_opts(req, 1));
+          mark(*p);
+        }
         rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
         break;
       }
@@ -252,12 +366,14 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
-        begin_update(*p);
+        if (skip_apply) break;
+        begin_req(*p);
         const UpdateOpts uo = parse_opts(req, 2);
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
                        vals + i * p->width, p->width, uo);
+        mark(*p);
         break;
       }
       case PsfType::kSparsePull: {
@@ -283,12 +399,15 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
-        begin_update(*p);
-        const UpdateOpts uo = parse_opts(req, 2);
-        const float* vals = req.args[1].as_f32();
-        for (size_t i = 0; i < nidx; ++i)
-          apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
-                       vals + i * p->width, p->width, uo);
+        if (!skip_apply) {
+          begin_req(*p);
+          const UpdateOpts uo = parse_opts(req, 2);
+          const float* vals = req.args[1].as_f32();
+          for (size_t i = 0; i < nidx; ++i)
+            apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
+                         vals + i * p->width, p->width, uo);
+          mark(*p);
+        }
         rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
         break;
       }
@@ -305,12 +424,15 @@ class PsServer {
         // leave the param untouched or a client retry double-applies
         check_rows(*p, idx, nidx);
         check_rows(*p, oidx, no);
-        begin_update(*p);
-        const UpdateOpts uo = parse_opts(req, 3);
-        const float* vals = req.args[1].as_f32();
-        for (size_t i = 0; i < nidx; ++i)
-          apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
-                       vals + i * p->width, p->width, uo);
+        if (!skip_apply) {
+          begin_req(*p);
+          const UpdateOpts uo = parse_opts(req, 3);
+          const float* vals = req.args[1].as_f32();
+          for (size_t i = 0; i < nidx; ++i)
+            apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
+                         vals + i * p->width, p->width, uo);
+          mark(*p);
+        }
         std::vector<float> out(no * p->width);
         for (size_t i = 0; i < no; ++i)
           std::memcpy(out.data() + i * p->width,
@@ -327,8 +449,10 @@ class PsServer {
         std::unique_lock<std::shared_mutex> g(p->mu);
         if (req.args[0].n_f32() != p->data.size())
           throw std::runtime_error("ParamAssign size mismatch");
+        if (skip_apply) break;
         std::memcpy(p->data.data(), req.args[0].as_f32(),
                     p->data.size() * 4);
+        mark(*p);
         break;
       }
       case PsfType::kParamAssignRows: {
@@ -338,21 +462,24 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);
+        if (skip_apply) break;
         const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           std::memcpy(p->data.data() + static_cast<size_t>(idx[i]) * p->width,
                       vals + i * p->width, p->width * 4);
+        mark(*p);
         break;
       }
       case PsfType::kParamClear: {
         Param* p = store_.get(key);
-        if (!p) break;
+        if (!p || skip_apply) break;
         std::unique_lock<std::shared_mutex> g(p->mu);
         std::fill(p->data.begin(), p->data.end(), 0.0f);
         std::fill(p->accum.begin(), p->accum.end(), 0.0f);
         std::fill(p->accum2.begin(), p->accum2.end(), 0.0f);
         p->step = 0;
         if (!p->versions.empty()) std::fill(p->versions.begin(), p->versions.end(), 0);
+        mark(*p);
         break;
       }
       case PsfType::kParamSave: {
@@ -366,6 +493,10 @@ class PsServer {
         // the shard file carries full meta (+optimizer slots), so a blank
         // replacement server restores state without any worker-side re-init
         load_param_file(key, shard_path(req.args[0].as_str(), key));
+        if (Param* lp = store_.get(key)) {
+          std::unique_lock<std::shared_mutex> g(lp->mu);
+          mark(*lp);
+        }
         break;
       }
       case PsfType::kSyncEmbedding: {
@@ -415,7 +546,8 @@ class PsServer {
               std::to_string(req.args[2].n_i64()) + " ups for " +
               std::to_string(nidx) + " rows x width " +
               std::to_string(p->width));
-        begin_update(*p);
+        if (skip_apply) break;
+        begin_req(*p);
         const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
@@ -432,6 +564,7 @@ class PsServer {
           apply_update(*p, r * p->width, grads + i * p->width, p->width);
           p->versions[r] += ups[i];
         }
+        mark(*p);
         break;
       }
       case PsfType::kPushSyncEmbedding: {
@@ -457,22 +590,25 @@ class PsServer {
               std::to_string(req.args[2].n_i64()) + " ups for " +
               std::to_string(nidx) + " rows x width " +
               std::to_string(p->width));
-        begin_update(*p);
-        const float* grads = req.args[1].as_f32();
-        const int64_t* ups = req.args[2].as_i64();
-        for (size_t i = 0; i < nidx; ++i) {
-          size_t r = static_cast<size_t>(idx[i]);
-          if (validate_)
-            for (size_t j = 0; j < p->width; ++j)
-              if (!(std::fabs(grads[i * p->width + j]) < 1e3f))
-                std::fprintf(stderr,
-                             "[hetups VALIDATE] push_sync tensor %d row "
-                             "%lld grad[%zu]=%g nidx=%zu ups=%lld\n",
-                             key, (long long)idx[i], j,
-                             (double)grads[i * p->width + j], nidx,
-                             (long long)ups[i]);
-          apply_update(*p, r * p->width, grads + i * p->width, p->width);
-          p->versions[r] += ups[i];
+        if (!skip_apply) {
+          begin_req(*p);
+          const float* grads = req.args[1].as_f32();
+          const int64_t* ups = req.args[2].as_i64();
+          for (size_t i = 0; i < nidx; ++i) {
+            size_t r = static_cast<size_t>(idx[i]);
+            if (validate_)
+              for (size_t j = 0; j < p->width; ++j)
+                if (!(std::fabs(grads[i * p->width + j]) < 1e3f))
+                  std::fprintf(stderr,
+                               "[hetups VALIDATE] push_sync tensor %d row "
+                               "%lld grad[%zu]=%g nidx=%zu ups=%lld\n",
+                               key, (long long)idx[i], j,
+                               (double)grads[i * p->width + j], nidx,
+                               (long long)ups[i]);
+            apply_update(*p, r * p->width, grads + i * p->width, p->width);
+            p->versions[r] += ups[i];
+          }
+          mark(*p);
         }
         std::vector<int32_t> sel;
         std::vector<float> rows;
@@ -494,6 +630,7 @@ class PsServer {
       case PsfType::kDataPush: {
         // arbitrary-length blob rows keyed by u64 (reference PushData — used
         // for GNN graph data). args: u64 keys, i64 lens, f32 concat values
+        if (skip_apply) break;
         std::unique_lock<std::shared_mutex> g(data_mu_);
         const uint64_t* keys = req.args[0].as_u64();
         size_t nk = req.args[0].n_i64();
@@ -505,6 +642,9 @@ class PsServer {
           blob.assign(vals + off, vals + off + lens[i]);
           off += static_cast<size_t>(lens[i]);
         }
+        // data blobs are never snapshotted: flag the write as absent from
+        // every snapshot so a failover re-issue re-applies it
+        if (write_seq) *write_seq = ~0ull;
         break;
       }
       case PsfType::kDataPull: {
@@ -519,6 +659,23 @@ class PsServer {
           out.insert(out.end(), it->second.begin(), it->second.end());
         }
         rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        break;
+      }
+      case PsfType::kServerStats: {
+        // reply: i64[updates applied, updates covered by latest snapshot,
+        // update counter restored from (-1 = fresh start), snapshot version,
+        // live param count] — the lost-update accounting surface: after a
+        // recovery, `acked updates before death - restored counter` is
+        // exactly how many applied updates the replacement is missing.
+        int64_t n_params = 0;
+        store_.for_each([&](int32_t, Param&) { ++n_params; });
+        int64_t stats[5] = {
+            static_cast<int64_t>(update_count_.load()),
+            static_cast<int64_t>(last_snapshot_counter_.load()),
+            restored_counter_.load(),
+            static_cast<int64_t>(snapshot_version_.load()),
+            n_params};
+        rsp->args.push_back(Arg::i64(stats, 5));
         break;
       }
       default:
@@ -556,7 +713,10 @@ class PsServer {
   // f32 accum[], f32 accum2[], i64 versions[].
   static constexpr int64_t kShardMagicV2 = -2;
 
-  void save_param_file(Param& p, const std::string& path) {
+  // Returns the param's last_write_seq as of the save (read under the same
+  // shared lock as the data): every write stamped <= that seq is inside the
+  // file, every later one is not — take_snapshot's ledger filter key.
+  uint64_t save_param_file(Param& p, const std::string& path) {
     std::shared_lock<std::shared_mutex> g(p.mu);
     // tmp + rename: a crash mid-save (the very fault this recovers from)
     // must not destroy the previous good checkpoint
@@ -580,6 +740,7 @@ class PsServer {
     std::fclose(f);
     if (std::rename(tmp.c_str(), path.c_str()) != 0)
       throw std::runtime_error("cannot rename " + tmp + " -> " + path);
+    return p.last_write_seq;
   }
 
   void load_param_file(int32_t key, const std::string& path) {
@@ -657,9 +818,51 @@ class PsServer {
   }
 
  public:
-  // Scan `dir` for this rank's shard files and restore every param found
-  // (invoked at startup when DMLC_PS_RESTORE_DIR is set).
+  // Restore this rank's state from `dir` (invoked at startup when
+  // DMLC_PS_RESTORE_DIR is set). Two layouts:
+  //  - a continuous-snapshot root (this server's LATEST_s<rank> pointer
+  //    exists): follow it to the freshest COMPLETE snapshot — params +
+  //    optimizer slots + row versions + the update-counter stamp + the
+  //    per-client dedup ledger (so an in-flight resend of an already-
+  //    snapshotted request is not double-applied);
+  //  - a plain ParamSave directory: scan for shard files (legacy path).
   int restore_from(const std::string& dir) {
+    namespace fs = std::filesystem;
+    const fs::path ptr = fs::path(dir) / ("LATEST_s" + std::to_string(rank_));
+    std::error_code ec;
+    if (!fs::exists(ptr, ec)) return restore_scan_dir(dir);
+    std::string name;
+    {
+      FILE* f = std::fopen(ptr.string().c_str(), "rb");
+      if (!f) return restore_scan_dir(dir);
+      char buf[256] = {0};
+      size_t k = std::fread(buf, 1, sizeof(buf) - 1, f);
+      std::fclose(f);
+      name.assign(buf, k);
+      while (!name.empty() && (name.back() == '\n' || name.back() == ' '))
+        name.pop_back();
+    }
+    const fs::path snap = fs::path(dir) / name;
+    if (!fs::exists(snap, ec)) {
+      std::fprintf(stderr,
+                   "[hetups] server %d: LATEST pointer names missing "
+                   "snapshot %s; falling back to directory scan\n",
+                   rank_, name.c_str());
+      return restore_scan_dir(dir);
+    }
+    int n = restore_scan_dir(snap.string());
+    load_manifest((snap / "manifest.bin").string());
+    std::fprintf(stderr,
+                 "[hetups] server %d restored %d param shard(s) from "
+                 "snapshot %s (version %llu, update counter %lld)\n",
+                 rank_, n, name.c_str(),
+                 (unsigned long long)snapshot_version_.load(),
+                 (long long)restored_counter_.load());
+    return n;
+  }
+
+ private:
+  int restore_scan_dir(const std::string& dir) {
     namespace fs = std::filesystem;
     const std::string suffix = "_shard" + std::to_string(rank_) + ".bin";
     int n = 0;
@@ -688,7 +891,222 @@ class PsServer {
     return n;
   }
 
- private:
+  // Snapshot manifest (binary): i64 magic, u64 version, u64 update counter,
+  // u64 n_params, u64 n_clients, then {i64 client_id, u64 last_req_id} per
+  // client. The counter stamp is the lost-update ledger; the client map is
+  // the resend-dedup ledger, captured AFTER the param files and filtered by
+  // write provenance: a client whose last applied write is provably absent
+  // from the saved shard files (its ClientSlot::write_seq is newer than the
+  // seq its param's file was saved at) is left OUT, so a failover re-issue
+  // re-applies the write; every entry that IS present implies its write is
+  // inside the files, so a re-issue can skip_apply safely. Net: never a
+  // double-apply, and never a silently-acked lost write.
+  static constexpr int64_t kManifestMagic = -7001;
+
+  void load_manifest(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) {
+      std::fprintf(stderr, "[hetups] server %d: snapshot has no manifest %s"
+                   " (counters start at 0)\n", rank_, path.c_str());
+      return;
+    }
+    struct Closer { FILE* f; ~Closer() { std::fclose(f); } } closer{f};
+    int64_t magic;
+    uint64_t head[4];
+    if (std::fread(&magic, 8, 1, f) != 1 || magic != kManifestMagic ||
+        std::fread(head, sizeof(head), 1, f) != 1) {
+      std::fprintf(stderr, "[hetups] server %d: bad manifest %s\n", rank_,
+                   path.c_str());
+      return;
+    }
+    snapshot_version_.store(head[0]);
+    update_count_.store(head[1]);
+    last_snapshot_counter_.store(head[1]);
+    restored_counter_.store(static_cast<int64_t>(head[1]));
+    for (uint64_t i = 0; i < head[3]; ++i) {
+      int64_t cid;
+      uint64_t last_id;
+      if (std::fread(&cid, 8, 1, f) != 1 || std::fread(&last_id, 8, 1, f) != 1)
+        break;
+      ClientSlot* slot = client_slot(static_cast<int32_t>(cid));
+      std::lock_guard<std::mutex> g(slot->mu);
+      slot->last_id = last_id;
+      slot->has_rsp = false;  // payload not persisted; resend => skip_apply
+      slot->write_seq = 0;    // the write is inside the restored params
+    }
+  }
+
+  void snapshot_loop() {
+    using Clock = std::chrono::steady_clock;
+    // wake faster than the snapshot cadence: a param-SET change (Executor
+    // init, late sparse-table registration) must reach disk promptly —
+    // with a plain snapshot_ms_ wait, a server killed inside the first
+    // interval after init would hand its replacement a snapshot with
+    // whole tensors missing (or none at all), an unrecoverable
+    // unknown-tensor failover instead of interval-bounded lost updates
+    const auto poll = std::chrono::milliseconds(std::min(snapshot_ms_, 250));
+    auto last_tick = Clock::now();
+    std::unique_lock<std::mutex> g(snap_mu_);
+    while (!snap_cv_.wait_for(g, poll, [this] { return snap_stop_; })) {
+      g.unlock();
+      const auto now = Clock::now();
+      const bool interval_elapsed =
+          now - last_tick >= std::chrono::milliseconds(snapshot_ms_);
+      if (interval_elapsed) last_tick = now;
+      try {
+        maybe_snapshot(interval_elapsed);
+      } catch (const std::exception& e) {
+        // snapshotting must never take the serving path down with it
+        std::fprintf(stderr, "[hetups] server %d: snapshot failed: %s\n",
+                     rank_, e.what());
+      }
+      g.lock();
+    }
+  }
+
+  void maybe_snapshot(bool interval_elapsed) {
+    uint64_t counter = update_count_.load();
+    size_t n_params = 0;
+    store_.for_each([&](int32_t, Param&) { ++n_params; });
+    // a changed param set snapshots NOW (between interval ticks); pure
+    // update traffic keeps the configured DMLC_PS_SNAPSHOT_MS cadence
+    const bool params_changed =
+        n_params != last_snapshot_params_.load() ||
+        (snapshot_version_.load() == 0 && n_params > 0);
+    if (!params_changed && !interval_elapsed)
+      return;
+    // idle skip: nothing new since the last complete snapshot. The write
+    // generation is what catches mutations that do NOT tick the update
+    // counter (ParamAssign/AssignRows/Clear/Load) — keying on the counter
+    // alone would leave an acked assign unsnapshotted forever, a silently
+    // lost write on failover. Param-count change alone (init-only, zero
+    // updates) still snapshots, so a replacement never comes up without
+    // the tables' init state.
+    if (!params_changed &&
+        counter == last_snapshot_counter_.load() &&
+        write_seq_gen_.load() == last_snapshot_write_seq_ &&
+        snapshot_version_.load() > 0)
+      return;
+    take_snapshot();
+  }
+
+  // One atomic, versioned snapshot: write everything into a hidden tmp dir,
+  // rename it into place, then flip the LATEST pointer (tmp+rename as well).
+  // A crash at ANY point leaves either the previous complete snapshot or a
+  // garbage .tmp dir that restore never looks at. Runs entirely under the
+  // per-param shared locks — the serving path is never paused.
+  void take_snapshot() {
+    namespace fs = std::filesystem;
+    // serializes the periodic thread against the test hook's final snapshot
+    std::lock_guard<std::mutex> take_g(snap_take_mu_);
+    const uint64_t counter = update_count_.load();  // BEFORE params: the
+    // stamp may under-claim coverage (updates landing mid-snapshot) but
+    // never over-claim — reported lost-update counts never understate.
+    const uint64_t wseq_at_start = write_seq_gen_.load();  // same logic:
+    // a write landing mid-snapshot bumps the gen past this sample, so the
+    // next idle check sees it and snapshots again
+    const uint64_t version = snapshot_version_.fetch_add(1) + 1;
+    const std::string name = "snap_s" + std::to_string(rank_) + "_v" +
+                             std::to_string(version);
+    const fs::path root(snapshot_dir_);
+    const fs::path tmp = root / ("." + name + ".tmp");
+    std::error_code ec;
+    // a predecessor that died mid-cycle may have left this very tmp dir
+    // (it restored from the same LATEST and picked the same next version);
+    // stale shard files mixed into the fresh dump would corrupt it
+    fs::remove_all(tmp, ec);
+    fs::create_directories(tmp, ec);
+    if (ec)
+      throw std::runtime_error("cannot create snapshot dir " + tmp.string());
+    std::vector<int32_t> keys;
+    store_.for_each([&](int32_t k, Param&) { keys.push_back(k); });
+    std::unordered_map<int32_t, uint64_t> file_seq;  // key -> seq-at-save
+    for (int32_t k : keys) {
+      Param* p = store_.get(k);
+      if (p && !p->data.empty())
+        file_seq[k] = save_param_file(
+            *p, (tmp / ("param_" + std::to_string(k) + "_shard" +
+                        std::to_string(rank_) + ".bin"))
+                    .string());
+    }
+    // dedup ledger AFTER params (see kManifestMagic comment for why)
+    std::vector<std::pair<int64_t, uint64_t>> ledger;
+    {
+      std::vector<std::pair<int32_t, ClientSlot*>> slots;
+      {
+        std::lock_guard<std::mutex> g(clients_mu_);
+        for (auto& kv : clients_) slots.push_back({kv.first, kv.second.get()});
+      }
+      for (auto& [cid, slot] : slots) {
+        std::lock_guard<std::mutex> g(slot->mu);
+        if (slot->last_id == 0) continue;
+        if (slot->write_seq > 0) {
+          // provenance filter: the client's last write landed AFTER its
+          // param's file was saved (or the param was never saved) — it is
+          // provably NOT in this snapshot, so leave the client out of the
+          // ledger and let a failover re-issue RE-APPLY it. Including it
+          // would make the re-issue a skip_apply duplicate: a silently
+          // acked lost update.
+          auto it = file_seq.find(slot->write_key);
+          if (it == file_seq.end() || slot->write_seq > it->second) continue;
+        }
+        ledger.push_back({cid, slot->last_id});
+      }
+    }
+    {
+      FILE* f = std::fopen((tmp / "manifest.bin").string().c_str(), "wb");
+      if (!f) throw std::runtime_error("cannot write snapshot manifest");
+      int64_t magic = kManifestMagic;
+      uint64_t head[4] = {version, counter, keys.size(), ledger.size()};
+      std::fwrite(&magic, 8, 1, f);
+      std::fwrite(head, sizeof(head), 1, f);
+      for (auto& [cid, last_id] : ledger) {
+        std::fwrite(&cid, 8, 1, f);
+        std::fwrite(&last_id, 8, 1, f);
+      }
+      std::fclose(f);
+    }
+    // a predecessor may have published this version but died before
+    // flipping LATEST — no reader ever saw it, and renaming onto a
+    // non-empty directory fails
+    fs::remove_all(root / name, ec);
+    fs::rename(tmp, root / name, ec);
+    if (ec) throw std::runtime_error("cannot publish snapshot " + name);
+    // flip the pointer
+    const fs::path ptr_tmp = root / (".LATEST_s" + std::to_string(rank_) +
+                                     ".tmp");
+    {
+      FILE* f = std::fopen(ptr_tmp.string().c_str(), "wb");
+      if (!f) throw std::runtime_error("cannot write snapshot pointer");
+      std::fwrite(name.data(), 1, name.size(), f);
+      std::fclose(f);
+    }
+    fs::rename(ptr_tmp, root / ("LATEST_s" + std::to_string(rank_)), ec);
+    if (ec) throw std::runtime_error("cannot flip snapshot pointer");
+    last_snapshot_counter_.store(counter);
+    last_snapshot_params_ = keys.size();
+    last_snapshot_write_seq_ = wseq_at_start;
+    // prune: keep this snapshot and its predecessor (the pointer flip and a
+    // racing reader of the old snapshot both stay safe); also sweep stale
+    // .tmp dirs a crashed predecessor abandoned — each holds a full copy of
+    // PS state and nothing else ever cleans them
+    const std::string prefix = "snap_s" + std::to_string(rank_) + "_v";
+    const std::string tprefix = "." + prefix;
+    for (const auto& ent : fs::directory_iterator(root, ec)) {
+      const std::string n = ent.path().filename().string();
+      const bool is_tmp = n.size() > tprefix.size() + 4 &&
+                          n.rfind(tprefix, 0) == 0 &&
+                          n.compare(n.size() - 4, 4, ".tmp") == 0;
+      const std::string v =
+          is_tmp ? n.substr(tprefix.size(), n.size() - tprefix.size() - 4)
+          : n.rfind(prefix, 0) == 0 ? n.substr(prefix.size())
+                                    : std::string();
+      if (v.empty() || v.find_first_not_of("0123456789") != std::string::npos)
+        continue;
+      if (is_tmp ? std::stoull(v) < version : std::stoull(v) + 1 < version)
+        fs::remove_all(ent.path(), ec);
+    }
+  }
 
   struct PairHash {
     size_t operator()(const std::pair<int32_t, uint64_t>& p) const {
@@ -704,6 +1122,27 @@ class PsServer {
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
+
+  // -- continuous snapshots / HA bookkeeping ------------------------------
+  std::string snapshot_dir_;             // DMLC_PS_SNAPSHOT_DIR ("" = off)
+  int snapshot_ms_ = 5000;               // DMLC_PS_SNAPSHOT_MS
+  std::thread snapshot_thread_;
+  std::mutex snap_mu_;
+  std::condition_variable snap_cv_;
+  std::mutex snap_take_mu_;
+  bool snap_stop_ = false;
+  std::atomic<uint64_t> update_count_{0};          // optimizer updates applied
+  std::atomic<uint64_t> last_snapshot_counter_{0}; // covered by latest snap
+  std::atomic<uint64_t> snapshot_version_{0};
+  std::atomic<uint64_t> write_seq_gen_{0};         // write-provenance stamps
+  std::atomic<int64_t> restored_counter_{-1};      // -1 = fresh start
+  // atomics, not snapshot-thread-private: the HETU_PS_TEST_EXIT hook runs
+  // take_snapshot on a serve thread concurrently with maybe_snapshot's
+  // idle-check reads (take_snapshot itself serializes via snap_take_mu_)
+  std::atomic<size_t> last_snapshot_params_{0};
+  std::atomic<uint64_t> last_snapshot_write_seq_{0};
+  long test_exit_after_updates_ = -1;              // test hook (gated)
+  bool test_exit_snap_ = false;
   ConnThreads conn_threads_;
   std::mutex fds_mu_;
   std::vector<int> live_fds_;

@@ -49,6 +49,12 @@ struct Param {
   // (reference PSFhandle_embedding.cc:49); server rows start at 0
   std::vector<int64_t> versions;
 
+  // seq of the last applied write (guarded by mu, stamped by server.h's
+  // mark lambda): take_snapshot compares it against the seq each shard
+  // file was saved at to decide whether a client's last write made it
+  // into the snapshot — the dedup-ledger provenance filter
+  uint64_t last_write_seq = 0;
+
   mutable std::shared_mutex mu;
 };
 

@@ -11,12 +11,15 @@
 #include <algorithm>
 #include <array>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <deque>
 #include <functional>
 #include <memory>
 #include <mutex>
 #include <sstream>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -128,6 +131,10 @@ class PsWorker {
         sched_port_(sched_port), pool_(n_threads) {
     recv_timeout_ms_ = env_int_or("DMLC_PS_RECV_TIMEOUT_MS", 15000);
     max_retry_ = env_int_or("DMLC_PS_MAX_RETRY", 3);
+    // opt-in failover: after the fast retries exhaust, block-with-deadline
+    // for a replacement server to register instead of throwing (0 = off)
+    failover_ms_ = env_int_or("DMLC_PS_FAILOVER_DEADLINE_MS", 0);
+    failover_poll_ms_ = env_int_or("DMLC_PS_FAILOVER_POLL_MS", 500);
     sched_ = std::make_unique<Conn>(connect_to(sched_host, sched_port));
     // register with the scheduler, receive the server address book
     Message reg;
@@ -139,6 +146,15 @@ class PsWorker {
     Message book;
     if (!sched_->recv(&book))
       throw std::runtime_error("scheduler closed during registration");
+    if (book.args.size() > 1 && book.args[1].as_i32()[0] > 0) {
+      // scheduler-issued incarnation epoch in the high bits: strictly
+      // increasing per rank across worker restarts regardless of clock
+      // steps, and (epoch >= 1) always above the pure-wall-clock ids a
+      // pre-epoch snapshot's ledger may hold (wall-µs stays < 2^51
+      // until ~2041)
+      next_req_id_ = boot_req_id() +
+                     (static_cast<uint64_t>(book.args[1].as_i32()[0]) << 51);
+    }
     std::istringstream ss(book.args[0].as_str());
     std::string line;
     while (std::getline(ss, line)) {
@@ -175,6 +191,10 @@ class PsWorker {
         s->close();
       }
     }
+    // identity-tagged checkout: the scheduler's bounded teardown wait can
+    // then name the ranks that never made it here
+    int32_t who[2] = {1, rank_};
+    bye.args.push_back(Arg::i32(who, 2));
     try {
       sched_->send(bye);
     } catch (...) {
@@ -688,6 +708,22 @@ class PsWorker {
   // -- control -----------------------------------------------------------
   void wait(int32_t key) { pending_.wait(key); }
 
+  // Per-server HA counters (kServerStats; rides the fast channel):
+  // [updates, snapshot_updates, restored_updates(-1 fresh), snapshot_version,
+  // n_params]. After a recovery, `updates acked before death -
+  // restored_updates` is the exact lost-update count for that shard.
+  std::vector<int64_t> server_stats(size_t server) {
+    if (server >= servers_.size())
+      throw std::runtime_error("server_stats: server index " +
+                               std::to_string(server) + " out of range");
+    Message req;
+    req.head.type = static_cast<int32_t>(PsfType::kServerStats);
+    req.head.tensor_id = -1;
+    Message rsp = rpc(server, req);
+    const int64_t* s = rsp.args[0].as_i64();
+    return std::vector<int64_t>(s, s + rsp.args[0].n_i64());
+  }
+
   void barrier() {
     std::lock_guard<std::mutex> g(sched_mu_);
     Message req;
@@ -839,6 +875,29 @@ class PsWorker {
     }
   }
 
+  // One send/recv over the current connection. Returns true with *rsp
+  // filled on success; false (error recorded, connection closed) on a
+  // transport failure; rethrows app-level server errors (no retry).
+  bool try_roundtrip(std::vector<std::unique_ptr<Conn>>& conns, size_t server,
+                     Message& req, Message* rsp, std::string* last_err) {
+    try {
+      auto& conn = *conns[server];
+      conn.send(req);
+      if (!conn.recv(rsp))
+        throw std::runtime_error("server " + std::to_string(server) +
+                                 " timed out or closed");
+      if (rsp->head.flags == -1)
+        throw std::runtime_error("server error: " + rsp->args[0].as_str());
+      return true;
+    } catch (const std::exception& e) {
+      std::string what = e.what();
+      if (what.rfind("server error:", 0) == 0) throw;  // app-level: no retry
+      *last_err = what;
+      conns[server]->close();
+      return false;
+    }
+  }
+
   Message rpc(size_t server, Message& req) {
     // serialize the whole round trip per (server, channel) connection:
     // concurrency comes from the pool issuing to different servers — and
@@ -852,6 +911,8 @@ class PsWorker {
     // the two interleaved channels
     req.head.client_id = rank_ * 2 + ch;
     std::string last_err;
+    Message rsp;
+    // phase 1: bounded fast retries (the pre-failover semantics)
     for (int attempt = 0; attempt <= max_retry_; ++attempt) {
       if (attempt > 0) {
         auto st = query_server_status(server);
@@ -870,22 +931,52 @@ class PsWorker {
           continue;
         }
       }
-      try {
-        auto& conn = *conns[server];
-        conn.send(req);
-        Message rsp;
-        if (!conn.recv(&rsp))
-          throw std::runtime_error("server " + std::to_string(server) +
-                                   " timed out or closed");
-        if (rsp.head.flags == -1)
-          throw std::runtime_error("server error: " + rsp.args[0].as_str());
-        return rsp;
-      } catch (const std::exception& e) {
-        std::string what = e.what();
-        if (what.rfind("server error:", 0) == 0) throw;  // app-level: no retry
-        last_err = what;
-        conns[server]->close();
+      if (try_roundtrip(conns, server, req, &rsp, &last_err)) return rsp;
+    }
+    // phase 2 (opt-in): the server is gone — block-with-deadline until the
+    // supervisor's replacement registers with the scheduler, then re-issue
+    // the SAME request (unchanged req_id: the server's (client_id, req_id)
+    // dedup — live slot or snapshot-restored ledger — makes re-issue safe).
+    // On deadline, fall through to the same error the non-failover path
+    // raises, so supervise() still catches the unrecoverable case.
+    if (failover_ms_ > 0) {
+      using Clock = std::chrono::steady_clock;
+      const auto deadline =
+          Clock::now() + std::chrono::milliseconds(failover_ms_);
+      std::fprintf(stderr,
+                   "[hetups worker %d] server %zu unreachable (%s); failover:"
+                   " waiting up to %d ms for a replacement\n",
+                   rank_, server, last_err.c_str(), failover_ms_);
+      while (Clock::now() < deadline) {
+        auto st = query_server_status(server);
+        {
+          std::lock_guard<std::mutex> ag(addr_mu_);
+          server_addrs_[server] = st.first;
+        }
+        if (st.second) {  // heartbeat fresh again: a replacement registered
+          bool connected = false;
+          try {
+            conns[server] = std::make_unique<Conn>(
+                connect_addr(st.first, /*retries=*/5, /*wait_ms=*/100));
+            connected = true;
+          } catch (const std::exception& e) {
+            last_err = e.what();
+          }
+          if (connected && try_roundtrip(conns, server, req, &rsp, &last_err)) {
+            std::fprintf(stderr,
+                         "[hetups worker %d] server %zu recovered at %s; "
+                         "request re-issued\n",
+                         rank_, server, st.first.c_str());
+            return rsp;
+          }
+        }
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(failover_poll_ms_));
       }
+      throw std::runtime_error(
+          "PS server " + std::to_string(server) +
+          " unreachable: no replacement within the failover deadline (" +
+          std::to_string(failover_ms_) + " ms; " + last_err + ")");
     }
     throw std::runtime_error(
         "PS server " + std::to_string(server) + " unreachable after " +
@@ -965,7 +1056,24 @@ class PsWorker {
   int sched_port_ = 0;
   int recv_timeout_ms_ = 15000;
   int max_retry_ = 3;
-  std::atomic<uint64_t> next_req_id_{1};
+  int failover_ms_ = 0;        // DMLC_PS_FAILOVER_DEADLINE_MS (0 = off)
+  int failover_poll_ms_ = 500;
+  // Seeded from the wall clock, not 1: servers keep a per-client_id dedup
+  // slot (live, and persisted across server restarts in the snapshot
+  // ledger), and a RESTARTED worker process reuses its rank's client_id.
+  // If its ids restarted at 1 they would sit below the slot's last_id and
+  // every request would be dropped as a pre-reconnect straggler. The wall
+  // clock alone is NOT monotonic across incarnations (NTP step-back), so
+  // registration folds the scheduler's per-rank incarnation epoch into
+  // bits 51+ — the scheduler observes every incarnation in order, making
+  // the seed strictly increasing per rank no matter what the clock does.
+  static uint64_t boot_req_id() {
+    return static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::system_clock::now().time_since_epoch())
+            .count());
+  }
+  std::atomic<uint64_t> next_req_id_{boot_req_id()};
   std::unique_ptr<Conn> sched_;
   std::mutex sched_mu_;
   std::mutex addr_mu_;   // guards server_addrs_ (both channels' retries)

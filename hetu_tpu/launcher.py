@@ -39,7 +39,15 @@ def start_sched(env=None):
     os.environ["DMLC_ROLE"] = "scheduler"
     from hetu_tpu.ps import server as srv
     srv.start_scheduler_from_env()
-    srv.scheduler_wait()
+    try:
+        srv.scheduler_wait()
+    except RuntimeError as e:
+        # bounded teardown wait timed out: print the diagnostic naming the
+        # ranks that never checked out, still Finalize, and exit nonzero
+        # (same contract as ps/_light_main.py's scheduler body)
+        print(f"[hetu ps scheduler] {e}", file=sys.stderr)
+        srv.stop_scheduler()
+        sys.exit(1)
     srv.stop_scheduler()
 
 
@@ -74,17 +82,35 @@ def start_worker(target, args, worker_id=0, env=None):
 
 def launch(target, args):
     """Launch the yaml-described local cluster and run ``target(args)`` in
-    every worker process (reference launcher.py:18-38)."""
+    every worker process (reference launcher.py:18-38).
+
+    PS high availability: a ``ps_max_respawns`` count in the yaml's
+    ``launch`` section (or env ``HETU_PS_MAX_RESPAWNS``) turns on continuous
+    server snapshots + supervised auto-respawn + worker failover, with the
+    same env knobs as ``heturun --ps-max-respawns`` (docs/FAULT_TOLERANCE.md).
+    """
     settings = yaml.safe_load(open(args.config).read())
     _apply_shared_env(settings)
+    n_servers = int(settings["launch"]["server"])
+    max_respawns = int(settings["launch"].get(
+        "ps_max_respawns", os.environ.get("HETU_PS_MAX_RESPAWNS", 0)))
+    ps_ha = n_servers > 0 and max_respawns > 0
     env = dict(os.environ)
+    ps_snap_created = None
+    if ps_ha:
+        # defaults land in the CHILD env only — the launcher parent's
+        # environment is left alone
+        from hetu_tpu.ps.supervisor import apply_ha_env_defaults
+        ps_snap_created = apply_ha_env_defaults(env)
     ctx = multiprocessing.get_context("spawn")
     n_workers = int(settings["launch"]["worker"])
     args.num_local_worker = n_workers
     if settings["launch"].get("scheduler", 0):
         _procs.append(ctx.Process(target=start_sched, args=(env,)))
-    for i in range(int(settings["launch"]["server"])):
-        _procs.append(ctx.Process(target=start_server, args=(i, env)))
+    server_procs = {}
+    for i in range(n_servers):
+        server_procs[i] = ctx.Process(target=start_server, args=(i, env))
+        _procs.append(server_procs[i])
     workers = []
     for i in range(n_workers):
         p = ctx.Process(target=start_worker, args=(target, args, i, env))
@@ -93,13 +119,40 @@ def launch(target, args):
     signal.signal(signal.SIGINT, _signal_handler)
     for proc in _procs:
         proc.start()
+    sup = None
+    if ps_ha:
+        from hetu_tpu.ps.supervisor import start_mp_supervisor
+        sup = start_mp_supervisor(ctx, start_server, env, server_procs,
+                                  _procs.append, max_respawns=max_respawns)
+    fatal_reported = False
     for proc in workers:
-        proc.join()
+        while True:
+            proc.join(timeout=0.5 if sup is not None else None)
+            if not proc.is_alive():
+                break
+            if sup is not None and sup.fatal and not fatal_reported:
+                # PS tier permanently down: fail fast instead of letting
+                # every worker grind through its failover deadline
+                fatal_reported = True
+                print(f"# hetu launcher: PS supervisor fatal: {sup.fatal}; "
+                      "terminating workers", file=sys.stderr)
+                for w in workers:
+                    if w.is_alive():
+                        w.terminate()
     # workers done: tear down PS roles
+    if sup is not None:
+        sup.stop()  # before terminate(): teardown is not a death
     for proc in _procs:
         if proc not in workers:
             proc.terminate()
             proc.join(timeout=10)
+    if ps_snap_created:
+        from hetu_tpu.ps.supervisor import cleanup_snapshot_root
+        cleanup_snapshot_root(ps_snap_created)
+    if fatal_reported:
+        # workers were killed because the PS tier was permanently down —
+        # a caller (or CI) must not see this run as a success
+        raise RuntimeError(f"PS supervisor fatal: {sup.fatal}")
 
 
 def main():

@@ -65,8 +65,13 @@ def worker_finish():
         except Exception:  # noqa: BLE001 — teardown must not throw
             pass
     if _worker is not None:
-        _worker.close()
-        _worker = None
+        # clear the global FIRST: a raise must not leave a half-finalized
+        # agent registered where a retrying caller would re-Finalize it
+        w, _worker = _worker, None
+        # log-and-continue: this is the launcher's `finally:` path — a
+        # teardown-window socket error must not turn a successful worker
+        # run into a nonzero exit (and a restart-budget hit)
+        w.close(raise_on_error=False)
 
 
 def get_worker_communicate():

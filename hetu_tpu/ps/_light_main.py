@@ -37,7 +37,14 @@ def main():
     role = os.environ["DMLC_ROLE"]
     if role == "scheduler":
         lib.SchedulerWait()
+        # bounded teardown wait (DMLC_PS_SCHED_WAIT_TIMEOUT_MS): on timeout
+        # the native side reports which ranks never checked out — exit
+        # nonzero with that diagnostic instead of hanging forever
+        err = lib.LastError()
         lib.Finalize()
+        if err:
+            print(f"[hetu ps scheduler] {err.decode()}", file=sys.stderr)
+            return 1
     elif role == "server":
         lib.StartServer()
         err = lib.LastError()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import sys
 
 import numpy as np
 
@@ -80,8 +81,28 @@ class PSClient:
         self._staging.setdefault(node, []).extend(arrays)
 
     # -- lifecycle ----------------------------------------------------------
-    def close(self):
+    def close(self, *, raise_on_error: bool | None = None):
+        """Finalize the native agent, SURFACING teardown errors: Finalize's
+        guard stashes them in LastError, and silently swallowing them hid
+        real socket/teardown bugs. Raising is wrong in three situations —
+        interpreter shutdown (atexit / GC-driven closes, where it would
+        mask the process's real exit), an exception already propagating
+        (``finally:``-driven closes, where it would replace the real
+        failure), and the ``worker_finish`` teardown path (where a benign
+        teardown-window socket error would convert a successful worker run
+        into a nonzero exit and burn the supervisor's restart budget) —
+        there the error is logged instead. ``raise_on_error`` forces the
+        choice; ``None`` auto-detects the first two."""
         self._lib.Finalize()
+        err = self._lib.LastError()
+        if err:
+            msg = f"PS Finalize failed: {err.decode()}"
+            if raise_on_error is None:
+                raise_on_error = (not sys.is_finalizing()
+                                  and sys.exc_info()[0] is None)
+            if raise_on_error:
+                raise RuntimeError(msg)
+            print(msg, file=sys.stderr)
 
     Finalize = close
 
@@ -96,6 +117,22 @@ class PSClient:
     @property
     def num_servers(self) -> int:
         return self._lib.num_servers()
+
+    def ServerStats(self, server: int) -> dict:
+        """Per-server HA counters (rides the fast channel): ``updates``
+        applied since start/restore, ``snapshot_updates`` covered by the
+        latest complete snapshot, ``restored_updates`` the counter the
+        server restored from (-1 = fresh start), ``snapshot_version`` and
+        ``n_params``. After a recovery, ``acked-before-death updates -
+        restored_updates`` is exactly how many updates that shard lost."""
+        out = np.zeros(5, np.int64)
+        self._lib.QueryServerStats(ctypes.c_int(int(server)),
+                                   out.ctypes.data_as(_i64p),
+                                   ctypes.c_int(5))
+        self._check()
+        return {"updates": int(out[0]), "snapshot_updates": int(out[1]),
+                "restored_updates": int(out[2]),
+                "snapshot_version": int(out[3]), "n_params": int(out[4])}
 
     # -- tensor init (reference InitTensor binding) -------------------------
     def InitTensor(self, node, sparse, length, width, init_type, init_a,
@@ -250,6 +287,7 @@ class PSClient:
 
     def Clear(self, node):
         self._lib.Clear(ctypes.c_int(node))
+        self._check()
 
     def ClearOnServer(self, node):
         self._lib.ClearOnServer(ctypes.c_int(node))
@@ -267,7 +305,13 @@ class PSClient:
     def startRecord(self, directory):
         os.makedirs(directory, exist_ok=True)
         self._lib.startRecord(str(directory).encode())
+        # every guard()-wrapped entry point must be checked at its call
+        # site: an unchecked stashed error would otherwise surface as a
+        # bogus "Finalize failed" when close() reads LastError at teardown
+        self._check()
 
     def getLoads(self):
         import json
-        return json.loads(self._lib.getLoads().decode())
+        raw = self._lib.getLoads().decode()
+        self._check()
+        return json.loads(raw)

@@ -17,9 +17,15 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import time
 
 _LIGHT_MAIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "_light_main.py")
+
+# The live cluster registry: the `ps_kill` fault-injection kind
+# (resilience.FaultInjector) and tests resolve the CURRENT server process
+# for a given id here. Only one local_cluster is live per process.
+_LIVE: dict = {}
 
 
 def _ps_env(port: int, n_workers: int, n_servers: int) -> dict:
@@ -56,10 +62,15 @@ def spawn_light_server(idx: int, base_env: dict, stopfile: str,
 
 def reap_light_procs(procs, timeout: float = 15.0):
     """Wait for light children; SIGKILL stragglers AND reap them (a kill
-    without a wait leaves a zombie for the rest of the session)."""
+    without a wait leaves a zombie for the rest of the session).
+
+    ``timeout`` is ONE shared deadline across all children, not a per-child
+    budget: a wedged cluster of N processes tears down in bounded total
+    time instead of N x timeout."""
+    deadline = time.monotonic() + timeout
     for p in procs:
         try:
-            p.wait(timeout=timeout)
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             p.kill()
             p.wait()
@@ -86,10 +97,45 @@ def resolve_test_kill_index(n_servers: int):
     return idx
 
 
+def kill_live_server(idx: int):
+    """SIGKILL the CURRENT process serving server id ``idx`` of the live
+    ``local_cluster`` — the executor of the ``ps_kill@step[:idx]`` fault
+    kind. Test-gating lives in the FaultInjector (HETU_TEST_MODE); here the
+    index is bounds-checked like ``resolve_test_kill_index`` so the fault
+    can never land on the scheduler or a random child."""
+    if not _LIVE:
+        raise RuntimeError("ps_kill: no live local_cluster in this process")
+    n = _LIVE["n_servers"]
+    if not 0 <= idx < n:
+        raise ValueError(f"ps_kill server index {idx} out of range for "
+                         f"{n} servers")
+    victim = _LIVE["servers"][idx]
+    victim.kill()
+    victim.wait()
+
+
+def get_live_cluster() -> dict:
+    """The live cluster registry (empty when none): n_servers, servers
+    (id -> current Popen), supervisor (PSSupervisor or None), snapshot_dir,
+    port."""
+    return _LIVE
+
+
 @contextlib.contextmanager
-def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
+def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None,
+                  *, ha: bool = False, snapshot_ms: int = 1000,
+                  max_respawns: int = 3, snapshot_dir: str = None,
+                  failover_ms: int = 30000):
     """Spawn scheduler + servers, set THIS process up as worker 0, yield.
-    On exit, signal the servers to stop and reap every process."""
+    On exit, signal the servers to stop and reap every process.
+
+    ``ha=True`` turns on the full high-availability stack: servers write
+    continuous shard snapshots (``snapshot_ms``), a :class:`PSSupervisor`
+    respawns dead servers from the freshest snapshot (at most
+    ``max_respawns`` times), and this worker blocks-with-deadline through a
+    server death instead of raising (``failover_ms`` →
+    DMLC_PS_FAILOVER_DEADLINE_MS, set only if not already in the env).
+    """
     if port is None:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
@@ -99,13 +145,30 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
     stopdir = tempfile.mkdtemp(prefix="hetu_ps_stop_")
     stopfile = os.path.join(stopdir, "stop")
     base = _ps_env(port, n_workers, n_servers)
+    snapdir = None
+    saved_env: dict = {}
+    if ha:
+        snapdir = snapshot_dir or tempfile.mkdtemp(prefix="hetu_ps_snap_")
+        base.update({"DMLC_PS_SNAPSHOT_DIR": snapdir,
+                     "DMLC_PS_SNAPSHOT_MS": str(int(snapshot_ms))})
+        # these ride into os.environ below (os.environ.update(base)); a
+        # leaked snapshot knob would make a LATER non-HA cluster's servers
+        # snapshot into a deleted tempdir forever — remember the caller's
+        # values (or their absence) to undo on exit
+        saved_env = {k: os.environ.get(k)
+                     for k in ("DMLC_PS_SNAPSHOT_DIR",
+                               "DMLC_PS_SNAPSHOT_MS")}
     procs = []
+    servers_by_id: dict = {}
+    sup = None
+    failover_env_set = False
     try:
         # spawn INSIDE the try: if a later spawn fails, the finally still
         # signals and reaps the children already running
         procs.append(spawn_light_role("scheduler", base))
-        procs += [spawn_light_server(i, base, stopfile)
-                  for i in range(n_servers)]
+        for i in range(n_servers):
+            servers_by_id[i] = spawn_light_server(i, base, stopfile)
+            procs.append(servers_by_id[i])
         # fault-injection hook (bench hang-proofing tests): SIGKILL server
         # <idx> right after spawn, so the caller's RPCs face a cluster
         # that can never complete registration. The section-subprocess
@@ -114,14 +177,53 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None):
         # Gated on HETU_TEST_MODE + bounds-checked (resolve_test_kill_index).
         kill_idx = resolve_test_kill_index(n_servers)
         if kill_idx is not None:
-            victim = procs[1 + kill_idx]
+            victim = servers_by_id[kill_idx]
             victim.kill()
             victim.wait()
+        if ha:
+            from .supervisor import PSSupervisor
+
+            def _respawn(i):
+                p = spawn_light_server(
+                    i, {**base, "DMLC_PS_RESTORE_DIR": snapdir}, stopfile)
+                servers_by_id[i] = p
+                procs.append(p)  # teardown reaps replacements too
+                return p
+
+            # procs is held by reference: ps_kill's victim and the
+            # supervisor's wedged-process check stay in sync
+            sup = PSSupervisor("127.0.0.1", port, n_servers, _respawn,
+                               procs=servers_by_id,
+                               max_respawns=max_respawns)
+            sup.start()
+            if "DMLC_PS_FAILOVER_DEADLINE_MS" not in os.environ:
+                # this worker opts into failover for THIS cluster only — a
+                # leaked deadline would turn a later non-HA cluster's fast
+                # server-death error into a silent block-with-deadline
+                os.environ["DMLC_PS_FAILOVER_DEADLINE_MS"] = \
+                    str(int(failover_ms))
+                failover_env_set = True
         os.environ.update(base)
         os.environ.update({"DMLC_ROLE": "worker", "WORKER_ID": "0"})
+        _LIVE.clear()
+        _LIVE.update({"n_servers": n_servers, "servers": servers_by_id,
+                      "supervisor": sup, "snapshot_dir": snapdir,
+                      "port": port})
         yield port
     finally:
+        _LIVE.clear()
+        if failover_env_set:
+            os.environ.pop("DMLC_PS_FAILOVER_DEADLINE_MS", None)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if sup is not None:
+            sup.stop()  # before the stopfile: clean exits are not "deaths"
         with open(stopfile, "w") as f:
             f.write("stop")
         reap_light_procs(procs)
         shutil.rmtree(stopdir, ignore_errors=True)
+        if ha and snapshot_dir is None and snapdir:
+            shutil.rmtree(snapdir, ignore_errors=True)

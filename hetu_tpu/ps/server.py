@@ -35,9 +35,15 @@ def start_scheduler_from_env():
 
 
 def scheduler_wait():
-    """Block until every node has checked out (clean teardown)."""
+    """Block until every node has checked out (clean teardown) — bounded by
+    DMLC_PS_SCHED_WAIT_TIMEOUT_MS (default 5 min), armed at the FIRST
+    checkout and re-armed on each further one (training itself may run
+    arbitrarily long): a node that died before checkout used to hang this
+    forever; now a progress-free window raises with a diagnostic naming
+    the ranks that never checked out."""
     lib = _load()
     lib.SchedulerWait()
+    _check(lib)
 
 
 def stop_scheduler():

@@ -1,0 +1,293 @@
+"""PS high availability: supervised server auto-respawn.
+
+``PSSupervisor`` polls the scheduler's liveness ledger (the ``kQueryServers``
+wire message — implemented here over a raw socket so the supervisor needs
+neither the native lib nor jax; it typically runs inside the launcher
+parent) and, when a server's heartbeat lapses, respawns a replacement under
+the SAME server id with ``DMLC_PS_RESTORE_DIR`` pointed at the snapshot
+root. The replacement re-registers (the scheduler's recovery re-add path),
+rebuilds its store from the freshest complete snapshot (params + optimizer
+slots + row versions + resend-dedup ledger, see ``csrc/ps/server.h``), and
+workers running with ``DMLC_PS_FAILOVER_DEADLINE_MS`` reconnect and re-issue
+their in-flight requests — a server SIGKILL costs seconds and a bounded,
+reported number of updates instead of the whole run.
+
+Respawns are bounded (``max_respawns``, the ``heturun --ps-max-respawns``
+knob); exhausting the budget records a ``fatal`` diagnostic instead of
+looping, so the launcher can preserve the first real failure's exit code
+exactly like the PR 1 worker-restart conventions.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import sys
+import threading
+import time
+
+# Wire format mirror of csrc/ps/net.h (host byte order, same-arch cluster —
+# the same assumption the native van makes). MsgHeader is 32 bytes with no
+# implicit padding; ArgHeader is 16.
+_MSG_HDR = struct.Struct("<iiQiiii")  # type, tensor_id, req_id, n_args,
+#                                       flags, client_id, pad
+_ARG_HDR = struct.Struct("<iiQ")      # dtype, pad, nbytes
+_K_QUERY_SERVERS = 6
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("scheduler closed mid-message")
+        buf += chunk
+    return buf
+
+
+def query_servers(host: str, port: int, timeout: float = 2.0):
+    """One ``kQueryServers`` round trip: returns ``(addrs, alive)`` where
+    ``addrs[i]`` is server i's registered address ("" before registration)
+    and ``alive[i]`` is 1 while its heartbeat is fresh. Empty lists until
+    the first server registers."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(_MSG_HDR.pack(_K_QUERY_SERVERS, 0, 0, 0, 0, -1, 0))
+        head = _MSG_HDR.unpack(_recv_exact(s, _MSG_HDR.size))
+        args = []
+        for _ in range(head[3]):
+            _, _, nbytes = _ARG_HDR.unpack(_recv_exact(s, _ARG_HDR.size))
+            args.append(_recv_exact(s, nbytes))
+    book = args[0].decode() if args else ""
+    # one "addr\n" per server, "" before that server registered — keep the
+    # empties (drop only the trailing terminator) so addrs[i] stays server i
+    addrs = book.split("\n")[:-1] if book else []
+    alive = list(struct.unpack(f"<{len(args[1]) // 4}i", args[1])) \
+        if len(args) > 1 else []
+    return addrs, alive
+
+
+def apply_ha_env_defaults(env: dict):
+    """Fill the PS-HA env knobs a launcher hands its roles — snapshot dir
+    (a fresh tempdir when unset), snapshot cadence, worker failover
+    deadline. Explicit values always win; shared by ``heturun
+    --ps-max-respawns`` and ``launcher.launch`` so the two never drift.
+
+    Returns the snapshot-root path THIS call created (the caller owns its
+    cleanup at teardown — snapshots hold full PS state and would otherwise
+    accumulate per run), or None when the env already named one."""
+    import tempfile
+    created = None
+    if not env.get("DMLC_PS_SNAPSHOT_DIR"):
+        created = tempfile.mkdtemp(prefix="hetu_ps_snap_")
+        env["DMLC_PS_SNAPSHOT_DIR"] = created
+    env.setdefault("DMLC_PS_SNAPSHOT_MS", "5000")
+    env.setdefault("DMLC_PS_FAILOVER_DEADLINE_MS", "60000")
+    return created
+
+
+def mp_respawn_fn(ctx, target, env: dict, on_spawn=None):
+    """Respawn callable for launchers whose servers are
+    ``ctx.Process(target, (server_id, env))`` entries: the replacement gets
+    the same env plus ``DMLC_PS_RESTORE_DIR`` -> the snapshot root.
+    ``on_spawn(proc)`` (e.g. ``_procs.append``) keeps the launcher's
+    teardown list aware of replacements."""
+    def _respawn(i):
+        renv = dict(env)
+        renv["DMLC_PS_RESTORE_DIR"] = env["DMLC_PS_SNAPSHOT_DIR"]
+        p = ctx.Process(target=target, args=(i, renv))
+        p.start()
+        if on_spawn is not None:
+            on_spawn(p)
+        return p
+    return _respawn
+
+
+def start_mp_supervisor(ctx, server_target, env: dict, server_procs: dict,
+                        on_spawn, *, max_respawns: int) -> "PSSupervisor":
+    """Build and start the launcher-side supervisor for ``ctx.Process``
+    server children — the one wiring shared by ``heturun --ps-max-respawns``
+    and ``launcher.launch`` so the two never drift. Replacements run
+    ``server_target(server_id, env)`` with ``DMLC_PS_RESTORE_DIR`` pointed
+    at the snapshot root; the scheduler address comes from the env block
+    both launchers already hand their roles."""
+    sup = PSSupervisor(env.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                       int(env.get("DMLC_PS_ROOT_PORT", 13200)),
+                       len(server_procs),
+                       mp_respawn_fn(ctx, server_target, env, on_spawn),
+                       procs=server_procs, max_respawns=max_respawns)
+    sup.start()
+    return sup
+
+
+def cleanup_snapshot_root(created) -> None:
+    """Teardown half of ``apply_ha_env_defaults``: remove the snapshot root
+    that call minted (it holds full PS state, twice over — repeated
+    supervised runs must not accumulate them). No-op when the operator
+    named their own dir (``created`` is None)."""
+    if created:
+        import shutil
+        shutil.rmtree(created, ignore_errors=True)
+
+
+def _proc_dead(proc) -> bool:
+    """True when a child handle (subprocess.Popen or mp.Process) has
+    exited; unknown handles are treated as dead (respawn is idempotent)."""
+    if proc is None:
+        return True
+    if hasattr(proc, "poll"):          # subprocess.Popen
+        return proc.poll() is not None
+    if hasattr(proc, "is_alive"):      # multiprocessing.Process
+        return not proc.is_alive()
+    return True
+
+
+def _proc_kill(proc) -> None:
+    try:
+        if hasattr(proc, "kill"):
+            proc.kill()
+        elif hasattr(proc, "terminate"):
+            proc.terminate()
+        if hasattr(proc, "wait"):
+            proc.wait(timeout=5)
+        elif hasattr(proc, "join"):
+            proc.join(timeout=5)
+    except Exception:  # noqa: BLE001 — teardown of a corpse must not throw
+        pass
+
+
+class PSSupervisor(threading.Thread):
+    """Liveness-ledger poller + bounded auto-respawner (daemon thread).
+
+    ``respawn(server_id) -> proc`` must start a replacement server process
+    under the same id with ``DMLC_PS_RESTORE_DIR`` pointing at the snapshot
+    root; the supervisor never builds environments itself, so the same class
+    drives light subprocess clusters (``local_cluster``), ``heturun``'s
+    mp.Process servers, and test harnesses.
+
+    A server is respawned only after it has been seen alive once (its
+    initial registration completed) and its heartbeat then lapsed for
+    ``grace_polls`` consecutive polls; a still-running-but-silent process is
+    killed first so the replacement can bind cleanly. After a respawn the
+    server must register again before it is eligible for another one.
+    """
+
+    def __init__(self, sched_host: str, sched_port: int, n_servers: int,
+                 respawn, procs=None, *, poll_s: float = 0.5,
+                 max_respawns: int = 3, grace_polls: int = 2,
+                 log=None):
+        super().__init__(name="hetu-ps-supervisor", daemon=True)
+        self.sched_host = sched_host
+        self.sched_port = int(sched_port)
+        self.n_servers = int(n_servers)
+        self.respawn = respawn
+        # server id -> current process handle. Held BY REFERENCE: callers
+        # (local_cluster, heturun, test harnesses) kill/replace entries in
+        # their own dict, and the wedged-process check must see the same
+        # handles — a private copy would silently desync.
+        self.procs = procs if procs is not None else {}
+        self.poll_s = float(poll_s)
+        self.max_respawns = int(max_respawns)
+        self.grace_polls = max(1, int(grace_polls))
+        self.log = log or (lambda msg: print(f"# hetu ps-supervisor: {msg}",
+                                             file=sys.stderr, flush=True))
+        self.respawns = 0
+        self.fatal: str | None = None    # set when the budget is exhausted
+        self.events: list[tuple[float, str]] = []
+        self._seen_alive = [False] * self.n_servers
+        self._dead_polls = [0] * self.n_servers
+        self._stop_evt = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self.is_alive():
+            self.join(timeout=10)
+
+    def __enter__(self) -> "PSSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the poll loop -----------------------------------------------------
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.poll_s):
+            # nothing may kill this thread: an escaped exception would end
+            # supervision with `fatal` still None, so the launchers would
+            # keep treating HA as armed while no one respawns anything —
+            # log best-effort and keep polling instead
+            try:
+                self._poll_once()
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self._note(f"supervisor poll error ({e!r}); continuing")
+                except Exception:  # noqa: BLE001 — even logging may fail
+                    pass
+
+    def _poll_once(self) -> None:
+        try:
+            _, alive = query_servers(self.sched_host, self.sched_port)
+        except OSError:
+            return  # scheduler not up yet / transient — keep polling
+        # the scheduler's book only grows on kRegister, so a server that
+        # died before ANY registration is invisible in `alive` — iterate
+        # every expected id and treat the missing tail as not-alive, or
+        # the dead-process path below could never run pre-registration
+        for i in range(self.n_servers):
+            if i < len(alive) and alive[i]:
+                self._seen_alive[i] = True
+                self._dead_polls[i] = 0
+                continue
+            if not self._seen_alive[i]:
+                # never registered: initial bringup or a respawn in
+                # flight — benign while the process is alive, but a
+                # process that DIED before ever sending kRegister
+                # (corrupt snapshot, bind failure) would stall
+                # supervision forever if we only watched heartbeats
+                h = self.procs.get(i)
+                if h is None or not _proc_dead(h):
+                    continue
+            self._dead_polls[i] += 1
+            if self._dead_polls[i] < self.grace_polls:
+                continue
+            self._dead_polls[i] = 0
+            self._respawn(i)
+
+    def _note(self, msg: str) -> None:
+        self.events.append((time.time(), msg))
+        self.log(msg)
+
+    def _respawn(self, i: int) -> None:
+        if self.respawns >= self.max_respawns:
+            if self.fatal is None:
+                self.fatal = (f"server {i} heartbeat lapsed but the respawn "
+                              f"budget ({self.max_respawns}) is exhausted")
+                self._note(self.fatal)
+            return
+        old = self.procs.get(i)
+        if old is not None and not _proc_dead(old):
+            # silent-but-running (wedged) server: clear the id before the
+            # replacement tries to serve under it
+            self._note(f"server {i} heartbeat lapsed but process still "
+                       "running; killing the wedged process")
+            _proc_kill(old)
+        self.respawns += 1
+        self._note(f"server {i} dead; respawning replacement "
+                   f"{self.respawns}/{self.max_respawns} from snapshots")
+        try:
+            self.procs[i] = self.respawn(i)
+        except Exception as e:  # noqa: BLE001
+            # a failed spawn consumed budget (respawns was already bumped);
+            # latch fatal only when none is left — a transient start()
+            # failure (EAGAIN under load) retries on the next lapse instead
+            # of tearing the whole run down while recovery is still possible
+            if self.respawns >= self.max_respawns:
+                self.fatal = f"respawn of server {i} failed: {e}"
+                self._note(self.fatal)
+            else:
+                self._note(f"respawn of server {i} failed: {e}; retrying on "
+                           "next poll")
+            return
+        # must register again before another death counts
+        self._seen_alive[i] = False

@@ -94,13 +94,17 @@ class FaultInjector:
     - ``sigterm@S`` / ``sigint@S`` — deliver the signal to this process
       (exercises preemption handling).
     - ``crash@S`` — raise :class:`FaultInjected` (exercises auto-resume).
+    - ``ps_kill@S[:IDX]`` — SIGKILL live PS server ``IDX`` (default 0) of
+      this process's ``ps.local_cluster`` (exercises the PS
+      snapshot/respawn/failover stack end to end; bounds-checked in
+      ``local_cluster.kill_live_server`` like ``resolve_test_kill_index``).
 
     ``from_env()`` (the only path wired into the executor by default) returns
     None unless :func:`test_mode_enabled` — direct construction is itself an
     explicit opt-in for tests.
     """
 
-    KINDS = ("nan_grads", "stall", "sigterm", "sigint", "crash")
+    KINDS = ("nan_grads", "stall", "sigterm", "sigint", "crash", "ps_kill")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -146,6 +150,10 @@ class FaultInjector:
         e = self.take("stall", step)
         if e is not None:
             time.sleep(e["arg"] if e["arg"] is not None else 3600.0)
+        e = self.take("ps_kill", step)
+        if e is not None:
+            from .ps.local_cluster import kill_live_server
+            kill_live_server(0 if e["arg"] is None else int(e["arg"]))
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:

@@ -108,6 +108,15 @@ def main(argv=None):
                              "total, with exponential backoff — workers "
                              "resume from their checkpointer (single-host "
                              "mode; see docs/FAULT_TOLERANCE.md)")
+    parser.add_argument("--ps-max-respawns", type=int, default=0,
+                        help="PS high availability (single-host mode): "
+                             "servers write continuous shard snapshots "
+                             "(DMLC_PS_SNAPSHOT_DIR/_MS) and a supervisor "
+                             "respawns a dead server from the freshest "
+                             "snapshot up to N times total; workers get a "
+                             "failover deadline (DMLC_PS_FAILOVER_DEADLINE_"
+                             "MS) so in-flight requests re-issue instead of "
+                             "failing (see docs/FAULT_TOLERANCE.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -122,6 +131,14 @@ def main(argv=None):
           f"workers({num_workers}): {workers} }}")
 
     env = dict(os.environ)
+    ps_ha = enable_ps and args.ps_max_respawns > 0 and len(hosts) == 1
+    if enable_ps and args.ps_max_respawns > 0 and len(hosts) > 1:
+        # don't let an operator believe HA is armed when it is not: the
+        # supervisor only drives local children (remote respawn needs a
+        # per-host agent), so multi-host runs get no self-healing yet
+        print("# heturun: --ps-max-respawns is single-host only; PS "
+              "high availability is OFF for this multi-host cluster",
+              file=sys.stderr)
     if enable_ps:
         env.update({
             "DMLC_PS_ROOT_URI": chief_address,
@@ -129,15 +146,30 @@ def main(argv=None):
             "DMLC_NUM_SERVER": str(num_servers),
             "DMLC_NUM_WORKER": str(num_workers),
         })
+    ps_snap_created = None
+    if ps_ha:
+        # PS high availability: snapshots + supervised respawn + worker
+        # failover. Explicit env wins over the defaults.
+        from hetu_tpu.ps.supervisor import apply_ha_env_defaults
+        ps_snap_created = apply_ha_env_defaults(env)
 
     ctx = multiprocessing.get_context("spawn")
+    ps_sup = None
     if len(hosts) == 1:
+        server_procs = {}
         if enable_ps:
             _procs.append(ctx.Process(target=_sched_entry, args=(env,)))
             for i in range(num_servers):
-                _procs.append(ctx.Process(target=_server_entry, args=(i, env)))
+                server_procs[i] = ctx.Process(target=_server_entry,
+                                              args=(i, env))
+                _procs.append(server_procs[i])
             for p in _procs:
                 p.start()
+            if ps_ha:
+                from hetu_tpu.ps.supervisor import start_mp_supervisor
+                ps_sup = start_mp_supervisor(
+                    ctx, _server_entry, env, server_procs, _procs.append,
+                    max_respawns=args.ps_max_respawns)
         def spawn_worker(w):
             wenv = dict(env)
             wenv["WORKER_ID"] = str(w)
@@ -181,6 +213,15 @@ def main(argv=None):
                     # below exit -15, which must not mask the real code
                     rc_final = rc
             now = time.monotonic()
+            if ps_sup is not None and ps_sup.fatal and not rc_final:
+                # the PS tier is permanently down (respawn budget exhausted
+                # or a respawn failed): fail the run now instead of letting
+                # every worker grind through its failover deadline. A worker
+                # failure that already landed keeps its code (first failure
+                # wins, the PR 1 convention).
+                print(f"# heturun: PS supervisor fatal: {ps_sup.fatal}",
+                      file=sys.stderr, flush=True)
+                rc_final = 1
             if rc_final:
                 # a permanently failed worker strands the survivors in
                 # dead-rank collectives — preempt them (SIGTERM so they can
@@ -211,9 +252,14 @@ def main(argv=None):
                     running[w] = spawn_worker(w)
             if running or respawn_at:
                 time.sleep(0.2)
+        if ps_sup is not None:
+            ps_sup.stop()  # before terminate(): teardown is not a death
         for p in _procs:
             p.terminate()
             p.join(timeout=10)
+        if ps_snap_created:
+            from hetu_tpu.ps.supervisor import cleanup_snapshot_root
+            cleanup_snapshot_root(ps_snap_created)
         sys.exit(rc_final if rc_final else
                  (EXIT_PREEMPTED if preempted else 0))
     else:

@@ -4,6 +4,9 @@ Mirrors the reference's tests/hetu_cache/hetu_cache_test.py strategy
 (SURVEY.md §4.4): CacheSparseTable policies exercised against a local
 parameter server, with bounded-staleness propagation checked across workers.
 """
+import os
+import time
+
 import numpy as np
 
 from test_ps import run_cluster
@@ -137,3 +140,61 @@ def test_cache_bounded_staleness(tmp_path):
 
 def test_cache_push_pull(tmp_path):
     run_cluster(_push_pull_combined, tmp_path, n_workers=1)
+
+
+# ---------------------------------------------------------------------------
+# bounded-staleness invariants across a server restart: the replacement
+# restores VALUES AND ROW VERSIONS from the continuous snapshot, so a cache
+# whose lines pre-date the death keeps its contract — no value regression,
+# sync traffic flows through worker failover, and later updates land once
+# ---------------------------------------------------------------------------
+
+def _cache_across_restart(client, rank, tmpdir):
+    from hetu_tpu.cstable import CacheSparseTable
+    client.InitTensor(18, sparse=2, length=NROWS, width=WIDTH,
+                      init_type="constant", init_a=1.0)
+    table = CacheSparseTable(16, NROWS, WIDTH, 18, policy="LRU", bound=0)
+    table.perf_enabled(True)
+    keys = np.arange(28, 36, dtype=np.uint64)  # spans both server shards
+    dest = np.zeros((8, WIDTH), np.float32)
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.0)
+    table.embedding_update(keys, np.full((8, WIDTH), 0.5, np.float32),
+                           sync=True)  # bound=0: pushed immediately
+    # wait for a snapshot covering the push on server 1
+    deadline = time.time() + 30
+    while client.ServerStats(1)["snapshot_updates"] < 1:
+        assert time.time() < deadline, "no covering snapshot appeared"
+        time.sleep(0.05)
+    open(os.path.join(tmpdir, "push_done"), "w").write("ok")
+    from test_ps_fault import _wait_file
+    _wait_file(os.path.join(tmpdir, "killed"))
+    # sync lookup rides the fast channel THROUGH the failover window; the
+    # restored rows carry the pre-death update — never a regression to 1.0
+    table.embedding_lookup(keys, dest, sync=True)
+    np.testing.assert_allclose(dest, 1.5)
+    # the server itself (bypass = raw SyncEmbedding of every row) agrees
+    table.bypass()
+    raw = np.zeros((8, WIDTH), np.float32)
+    table.embedding_lookup(keys, raw, sync=True)
+    np.testing.assert_allclose(raw, 1.5)
+    table.undobypass()
+    # post-restart updates land exactly once on the restored shard
+    table.embedding_update(keys, np.full((8, WIDTH), 0.5, np.float32),
+                           sync=True)
+    table.bypass()
+    table.embedding_lookup(keys, raw, sync=True)
+    np.testing.assert_allclose(raw, 2.0)
+    assert client.ServerStats(1)["restored_updates"] >= 1
+
+
+def test_cache_bounded_staleness_across_server_restart(tmp_path):
+    from test_ps_fault import _run_ha_cluster, _wait_file
+
+    def orchestrate(ctx, env):
+        _wait_file(os.path.join(env["tmpdir"], "push_done"))
+        env["kill"](1)
+        open(os.path.join(env["tmpdir"], "killed"), "w").write("ok")
+
+    sup = _run_ha_cluster(_cache_across_restart, orchestrate, tmp_path)
+    assert sup.respawns == 1 and sup.fatal is None

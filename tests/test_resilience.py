@@ -89,6 +89,43 @@ def test_ps_kill_hook_gated_and_bounds_checked(monkeypatch):
         resolve_test_kill_index(1)                   # out of range
 
 
+def test_ps_kill_fault_kind_parses_and_is_bounds_checked():
+    from hetu_tpu.ps import local_cluster as lc
+    fi = rs.FaultInjector("ps_kill@4:1")
+    e = fi.take("ps_kill", 4)
+    assert e is not None and e["arg"] == 1.0
+    # no live local_cluster in this process: firing is a hard error, never
+    # a silent no-op (the fault test would be meaningless)
+    fi2 = rs.FaultInjector("ps_kill@0")
+    with pytest.raises(RuntimeError, match="no live local_cluster"):
+        fi2.inject_host(0)
+    # bounds check against a (fake) live registry, like
+    # resolve_test_kill_index: the scheduler slot must be unreachable
+    lc._LIVE.update({"n_servers": 2, "servers": {}, "supervisor": None})
+    try:
+        fi3 = rs.FaultInjector("ps_kill@0:5")
+        with pytest.raises(ValueError, match="out of range"):
+            fi3.inject_host(0)
+    finally:
+        lc._LIVE.clear()
+
+
+def test_ps_supervisor_respawn_budget_records_fatal():
+    """PSSupervisor exhausts its bounded respawn budget and records a fatal
+    diagnostic instead of looping (first-failure preservation upstream)."""
+    from hetu_tpu.ps.supervisor import PSSupervisor
+    spawned = []
+    sup = PSSupervisor("127.0.0.1", 1, n_servers=1,
+                       respawn=lambda i: spawned.append(i), max_respawns=1)
+    sup._seen_alive[0] = True
+    sup._respawn(0)                       # consumes the budget
+    assert spawned == [0] and sup.respawns == 1 and sup.fatal is None
+    sup._seen_alive[0] = True
+    sup._respawn(0)                       # budget exhausted -> fatal, no spawn
+    assert spawned == [0]
+    assert sup.fatal is not None and "budget" in sup.fatal
+
+
 def test_pipeline_inflight_window_rejects_zero():
     from hetu_tpu.parallel.pipeline import resolve_inflight_window
     assert resolve_inflight_window(4) == 8           # default 2*pp
@@ -360,6 +397,76 @@ def test_injected_stall_trips_watchdog_with_stack_dump(tmp_path):
     assert "pre_step" in proc.stderr              # last-known phase
     assert "inject_host" in proc.stderr           # the stalled frame is named
     assert "MainThread" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# PS server death inside a supervised training loop (end to end): the
+# ps_kill fault SIGKILLs one of two live servers mid-run; continuous
+# snapshots + PSSupervisor respawn + worker failover absorb it WITHOUT a
+# training-loop restart (child process — local_cluster claims the worker
+# role via os.environ, which must not leak into this test process)
+# ---------------------------------------------------------------------------
+
+def test_supervised_training_survives_ps_server_kill(tmp_path):
+    script = textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        os.environ.update({
+            "HETU_TEST_MODE": "1",
+            "HETU_FAULT_SPEC": "ps_kill@6:1",
+            # tight knobs: death detected + recovered in seconds
+            "DMLC_PS_RECV_TIMEOUT_MS": "2000",
+            "DMLC_PS_MAX_RETRY": "2",
+            "DMLC_PS_HEARTBEAT_MS": "300",
+            "DMLC_PS_HEARTBEAT_TIMEOUT_MS": "1500",
+            "DMLC_PS_FAILOVER_DEADLINE_MS": "60000",
+            "DMLC_PS_FAILOVER_POLL_MS": "200",
+        })
+        import numpy as np
+        from hetu_tpu.ps.local_cluster import local_cluster, get_live_cluster
+
+        with local_cluster(n_servers=2, n_workers=1, ha=True,
+                           snapshot_ms=200, max_respawns=2):
+            import hetu_tpu as ht
+            from hetu_tpu import resilience as rs
+            x = ht.Variable(name="x", trainable=False)
+            y_ = ht.Variable(name="y_", trainable=False)
+            w = ht.init.random_normal((4, 2), stddev=0.5, name="w")
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+            train_op = ht.optim.SGDOptimizer(0.2).minimize(loss)
+            ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                             comm_mode="PS", seed=0)
+            sup = ex.attach_supervisor(rs.Supervisor())  # env fault spec
+            rng = np.random.RandomState(0)
+            bx = rng.randn(16, 4).astype(np.float32)
+            by = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+            losses = []
+            with sup:
+                for step in range(12):   # server 1 dies at step 6's boundary
+                    lv, _ = ex.run("train", feed_dict={x: bx, y_: by})
+                    losses.append(float(lv.asnumpy()))
+            assert all(np.isfinite(losses)), losses
+            assert losses[-1] < losses[0], losses   # still learning after
+            live = get_live_cluster()
+            assert live["supervisor"].respawns == 1, \\
+                live["supervisor"].events
+            assert live["supervisor"].fatal is None
+            print("SURVIVED", len(losses), flush=True)
+    """ % REPO)
+    p = tmp_path / "ps_kill_job.py"
+    p.write_text(script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    proc = subprocess.run([sys.executable, str(p)], capture_output=True,
+                          text=True, timeout=240, env=env, cwd=str(tmp_path))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "SURVIVED 12" in proc.stdout
+    assert "respawning replacement" in proc.stderr  # the supervisor acted
+    # the replacement rebuilt its store from the continuous snapshot (the
+    # worker reconnects via fast retry or the failover wait — both re-issue
+    # the same req_id; which one wins the race is timing, and the dedup
+    # VALUE proof lives in test_ps_fault)
+    assert "restored 1 param shard(s) from snapshot" in proc.stderr
 
 
 # ---------------------------------------------------------------------------
