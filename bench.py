@@ -745,7 +745,7 @@ class _Ledger:
         out["_ledger"] = prov
         return out
 
-    def record(self, key, result):
+    def record(self, key, result, device=None):
         self.cells[key] = {
             "result": result, "sha": self.sha,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -756,6 +756,29 @@ class _Ledger:
         with open(tmp, "w") as f:
             json.dump({"cells": self.cells}, f, indent=1, sort_keys=True)
         os.replace(tmp, self.path)   # atomic: a kill never corrupts it
+        self._telemetry_line(key, result, device)
+
+    def _telemetry_line(self, key, result, device):
+        """One JSONL line per completed cell, appended next to the ledger
+        (BENCH_TELEMETRY.jsonl): records the cell's headline numbers PLUS
+        device_kind and the ASSUMED peak, so docs/ROOFLINE.md's
+        "assumption, not a reading" caveat is auditable per run — an MFU
+        without the peak it was computed against is not a measurement."""
+        path = os.path.join(os.path.dirname(os.path.abspath(self.path)),
+                            "BENCH_TELEMETRY.jsonl")
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "cell": key,
+               "sha": self.sha, "device_kind": device,
+               "peak_tflops_assumed": PEAK_TFLOPS}
+        if isinstance(result, dict):
+            for k in ("samples_per_sec", "step_ms", "mfu", "mfu_6nd",
+                      "mfu_attn_incl", "tokens_per_sec"):
+                if result.get(k) is not None:
+                    rec[k] = result[k]
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            print(f"# bench telemetry line skipped ({e})", file=sys.stderr)
 
 
 def _wait_for_backend(budget, detail):
@@ -979,7 +1002,7 @@ def main():
             dev = out.pop("_device", None)
             if dev and "device" not in detail:
                 detail["device"] = dev
-            ledger.record(key, out)
+            ledger.record(key, out, device=dev)
         detail[key] = out
 
     # headline over the MERGED detail (fresh + ledger): a resnet cell

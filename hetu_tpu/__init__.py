@@ -40,6 +40,7 @@ from . import data
 from . import metrics
 from . import onnx
 from . import graphboard
+from . import telemetry
 from . import tokenizers
 
 __version__ = "0.1.0"

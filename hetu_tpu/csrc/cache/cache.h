@@ -112,6 +112,10 @@ class CacheBase {
 
   void set_bypass(bool v) { bypass_ = v; }
   void set_perf_enabled(bool v) { perf_enabled_ = v; }
+  // rollup-only mode: keep the O(1) counters but skip the per-batch
+  // perf_ append — the telemetry poll arms THIS for long runs, where an
+  // unbounded record vector would grow for the life of the process
+  void set_perf_log(bool v) { perf_log_ = v; }
 
   // -- policy interface --------------------------------------------------
   virtual size_t size() = 0;
@@ -196,6 +200,16 @@ class CacheBase {
     return perf_;
   }
 
+  // O(1) cumulative rollup maintained alongside the per-batch log:
+  // [batches, evictions, pull_miss, pull_uniq, transfered, num_all].
+  // The telemetry poll reads THIS every N steps — re-serializing the
+  // whole perf_ vector as JSON would cost O(batches) per poll.
+  std::vector<long long> perf_rollup() {
+    std::lock_guard<std::mutex> g(perf_mu_);
+    return {rollup_batches_, rollup_evictions_, rollup_pull_miss_,
+            rollup_pull_uniq_, rollup_transfered_, rollup_num_all_};
+  }
+
   std::string repr() {
     std::ostringstream os;
     os << "<hetu_tpu.CacheSparseTable limit=" << limit_ << " size=" << size()
@@ -274,11 +288,10 @@ class CacheBase {
     batched_insert(should_insert);
     if (perf_enabled_) {
       auto t1 = std::chrono::steady_clock::now();
-      std::lock_guard<std::mutex> g(perf_mu_);
-      perf_.push_back({"Pull", size() == limit_, n, u.uniq.size(),
-                       should_insert.size(), 0, pos.size(),
-                       std::chrono::duration<double, std::milli>(t1 - t0)
-                           .count()});
+      note_perf({"Pull", size() == limit_, n, u.uniq.size(),
+                 should_insert.size(), 0, pos.size(),
+                 std::chrono::duration<double, std::milli>(t1 - t0)
+                     .count()});
     }
   }
 
@@ -332,11 +345,10 @@ class CacheBase {
     }
     if (perf_enabled_) {
       auto t1 = std::chrono::steady_clock::now();
-      std::lock_guard<std::mutex> g(perf_mu_);
-      perf_.push_back({"Push", size() == limit_, n, u.uniq.size(), miss,
-                       evicted.size(), should_push.size(),
-                       std::chrono::duration<double, std::milli>(t1 - t0)
-                           .count()});
+      note_perf({"Push", size() == limit_, n, u.uniq.size(), miss,
+                 evicted.size(), should_push.size(),
+                 std::chrono::duration<double, std::milli>(t1 - t0)
+                     .count()});
     }
   }
 
@@ -419,13 +431,12 @@ class CacheBase {
     batched_insert(should_insert);
     if (perf_enabled_) {
       auto t1 = std::chrono::steady_clock::now();
-      std::lock_guard<std::mutex> g(perf_mu_);
-      perf_.push_back({"Push", size() == limit_, n_push, up.uniq.size(), miss,
-                       evicted.size(), should_push.size(),
-                       std::chrono::duration<double, std::milli>(t1 - t0)
-                           .count()});
-      perf_.push_back({"Pull", size() == limit_, n_pull, uq.uniq.size(),
-                       should_insert.size(), 0, pos.size(), 0.0});
+      note_perf({"Push", size() == limit_, n_push, up.uniq.size(), miss,
+                 evicted.size(), should_push.size(),
+                 std::chrono::duration<double, std::milli>(t1 - t0)
+                     .count()});
+      note_perf({"Pull", size() == limit_, n_pull, uq.uniq.size(),
+                 should_insert.size(), 0, pos.size(), 0.0});
     }
   }
 
@@ -469,10 +480,29 @@ class CacheBase {
   hetups::PsWorker* ps_;
   bool bypass_ = false;
   bool perf_enabled_ = false;
+  bool perf_log_ = true;   // per-batch records (the reference perf surface)
   std::vector<LinePtr> evict_;  // dirty evicted lines awaiting flush
 
   std::mutex perf_mu_;
   std::vector<PerfRecord> perf_;
+  long long rollup_batches_ = 0, rollup_evictions_ = 0,
+            rollup_pull_miss_ = 0, rollup_pull_uniq_ = 0,
+            rollup_transfered_ = 0, rollup_num_all_ = 0;
+
+  // single entry point for perf accounting: appends the per-batch record
+  // AND folds it into the rollup counters under one lock acquisition
+  void note_perf(PerfRecord r) {
+    std::lock_guard<std::mutex> g(perf_mu_);
+    rollup_batches_++;
+    rollup_evictions_ += static_cast<long long>(r.num_evict);
+    if (r.type[2] == 'l') {  // "Pull" (vs "Push")
+      rollup_pull_miss_ += static_cast<long long>(r.num_miss);
+      rollup_pull_uniq_ += static_cast<long long>(r.num_unique);
+    }
+    rollup_transfered_ += static_cast<long long>(r.num_transfered);
+    rollup_num_all_ += static_cast<long long>(r.num_all);
+    if (perf_log_) perf_.push_back(r);
+  }
 
   std::thread worker_;
   std::mutex qmu_;

@@ -110,8 +110,20 @@ void CacheBypass(void* h, int enable) {
   static_cast<hetucache::CacheBase*>(h)->set_bypass(enable != 0);
 }
 
+// enable: 0 = off, 1 = full per-batch log + rollup (the reference perf
+// surface), 2 = rollup-only (bounded memory; what telemetry arms)
 void CachePerfEnabled(void* h, int enable) {
-  static_cast<hetucache::CacheBase*>(h)->set_perf_enabled(enable != 0);
+  auto* c = static_cast<hetucache::CacheBase*>(h);
+  c->set_perf_enabled(enable != 0);
+  c->set_perf_log(enable != 2);
+}
+
+// O(1) cumulative perf rollup: fills up to n of [batches, evictions,
+// pull_miss, pull_uniq, transfered, num_all] — the telemetry poll's
+// cheap alternative to re-serializing the whole per-batch log below
+void CachePerfRollup(void* h, long long* out, int n) {
+  auto v = static_cast<hetucache::CacheBase*>(h)->perf_rollup();
+  for (int i = 0; i < n && i < static_cast<int>(v.size()); ++i) out[i] = v[i];
 }
 
 // JSON array of per-batch perf dicts (reference cstable.py perf property)

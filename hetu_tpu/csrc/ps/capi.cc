@@ -337,11 +337,23 @@ const char* getLoads() {
   return g_loads.c_str();
 }
 
-// Per-server HA counters: fills up to n of [updates, snapshot_updates,
-// restored_updates (-1 = fresh), snapshot_version, n_params].
+// Per-server HA + health counters: fills up to n of [updates,
+// snapshot_updates, restored_updates (-1 = fresh), snapshot_version,
+// n_params, requests, apply_ns, apply_count, snapshot_age_ms (-1 = none),
+// dedup_clients] (server.h kServerStats).
 void QueryServerStats(int server, long long* out, int n) {
   guard([&] {
     auto v = worker().server_stats(static_cast<size_t>(server));
+    for (int i = 0; i < n && i < static_cast<int>(v.size()); ++i)
+      out[i] = static_cast<long long>(v[i]);
+  });
+}
+
+// Worker-side RPC counters: fills up to n of [rpcs, retries, failovers]
+// (worker.h client_stats — the telemetry twin of QueryServerStats).
+void QueryClientStats(long long* out, int n) {
+  guard([&] {
+    auto v = worker().client_stats();
     for (int i = 0; i < n && i < static_cast<int>(v.size()); ++i)
       out[i] = static_cast<long long>(v[i]);
   });

@@ -160,6 +160,7 @@ class PsServer {
     Message req;
     while (recv_msg(fd, &req)) {
       if (static_cast<PsfType>(req.head.type) == PsfType::kShutdown) break;
+      req_count_.fetch_add(1, std::memory_order_relaxed);
       ClientSlot* slot =
           (req.head.client_id >= 0 && req.head.req_id > 0)
               ? client_slot(req.head.client_id)
@@ -194,6 +195,7 @@ class PsServer {
       rsp.head.tensor_id = req.head.tensor_id;
       rsp.head.req_id = req.head.req_id;
       uint64_t wseq = 0;
+      const auto handle_t0 = std::chrono::steady_clock::now();
       try {
         handle(req, &rsp, skip_apply, &wseq);
       } catch (const std::exception& e) {
@@ -202,6 +204,16 @@ class PsServer {
         rsp.head.flags = -1;
         rsp.args.clear();
         rsp.args.push_back(Arg::str(e.what()));
+      }
+      if (wseq != 0) {
+        // apply latency (kServerStats): wall time of requests that applied
+        // a write, accumulated as ns + count so the client derives the avg
+        apply_ns_.fetch_add(
+            std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now() - handle_t0)
+                .count(),
+            std::memory_order_relaxed);
+        apply_count_.fetch_add(1, std::memory_order_relaxed);
       }
       if (slot) {
         slot->last_id = req.head.req_id;
@@ -664,24 +676,45 @@ class PsServer {
       case PsfType::kServerStats: {
         // reply: i64[updates applied, updates covered by latest snapshot,
         // update counter restored from (-1 = fresh start), snapshot version,
-        // live param count] — the lost-update accounting surface: after a
-        // recovery, `acked updates before death - restored counter` is
-        // exactly how many applied updates the replacement is missing.
+        // live param count, requests served, apply ns total, apply count,
+        // snapshot age ms (-1 = none taken by THIS incarnation), dedup-
+        // ledger occupancy]. Slots 0-4 are the PR-4 lost-update accounting
+        // surface; 5-9 are the telemetry health extension (clients that ask
+        // for fewer slots still get a valid prefix — the reply is
+        // length-prefixed and QueryServerStats copies min(n, len)).
         int64_t n_params = 0;
         store_.for_each([&](int32_t, Param&) { ++n_params; });
-        int64_t stats[5] = {
+        int64_t dedup_clients;
+        {
+          std::lock_guard<std::mutex> cg(clients_mu_);
+          dedup_clients = static_cast<int64_t>(clients_.size());
+        }
+        const int64_t snap_at = last_snapshot_steady_ms_.load();
+        const int64_t age_ms = snap_at ? steady_now_ms() - snap_at : -1;
+        int64_t stats[10] = {
             static_cast<int64_t>(update_count_.load()),
             static_cast<int64_t>(last_snapshot_counter_.load()),
             restored_counter_.load(),
             static_cast<int64_t>(snapshot_version_.load()),
-            n_params};
-        rsp->args.push_back(Arg::i64(stats, 5));
+            n_params,
+            static_cast<int64_t>(req_count_.load()),
+            static_cast<int64_t>(apply_ns_.load()),
+            static_cast<int64_t>(apply_count_.load()),
+            age_ms,
+            dedup_clients};
+        rsp->args.push_back(Arg::i64(stats, 10));
         break;
       }
       default:
         throw std::runtime_error("server: unknown psf type " +
                                  std::to_string(req.head.type));
     }
+  }
+
+  static int64_t steady_now_ms() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
   }
 
   static void check(Param* p, int32_t key) {
@@ -1086,6 +1119,7 @@ class PsServer {
     last_snapshot_counter_.store(counter);
     last_snapshot_params_ = keys.size();
     last_snapshot_write_seq_ = wseq_at_start;
+    last_snapshot_steady_ms_.store(steady_now_ms());
     // prune: keep this snapshot and its predecessor (the pointer flip and a
     // racing reader of the old snapshot both stay safe); also sweep stale
     // .tmp dirs a crashed predecessor abandoned — each holds a full copy of
@@ -1141,6 +1175,11 @@ class PsServer {
   // idle-check reads (take_snapshot itself serializes via snap_take_mu_)
   std::atomic<size_t> last_snapshot_params_{0};
   std::atomic<uint64_t> last_snapshot_write_seq_{0};
+  // -- telemetry health counters (kServerStats slots 5-9) ------------------
+  std::atomic<uint64_t> req_count_{0};      // requests served (all types)
+  std::atomic<uint64_t> apply_ns_{0};       // wall ns spent in write applies
+  std::atomic<uint64_t> apply_count_{0};
+  std::atomic<int64_t> last_snapshot_steady_ms_{0};  // 0 = none yet
   long test_exit_after_updates_ = -1;              // test hook (gated)
   bool test_exit_snap_ = false;
   ConnThreads conn_threads_;

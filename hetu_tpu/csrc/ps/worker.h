@@ -708,6 +708,16 @@ class PsWorker {
   // -- control -----------------------------------------------------------
   void wait(int32_t key) { pending_.wait(key); }
 
+  // Worker-side RPC counters (telemetry: kServerStats' client-side twin):
+  // [rpc round trips issued, fast-retry attempts, successful failover
+  // re-issues]. Relaxed atomics bumped on the rpc path — counting costs
+  // nothing whether or not anyone ever reads them.
+  std::vector<int64_t> client_stats() const {
+    return {static_cast<int64_t>(rpc_count_.load()),
+            static_cast<int64_t>(retry_count_.load()),
+            static_cast<int64_t>(failover_count_.load())};
+  }
+
   // Per-server HA counters (kServerStats; rides the fast channel):
   // [updates, snapshot_updates, restored_updates(-1 fresh), snapshot_version,
   // n_params]. After a recovery, `updates acked before death -
@@ -905,6 +915,7 @@ class PsWorker {
     const int ch = is_bulk(static_cast<PsfType>(req.head.type)) ? 0 : 1;
     auto& conns = ch == 0 ? servers_ : servers_fast_;
     std::lock_guard<std::mutex> g(server_mu_[ch][server % kMaxServers]);
+    rpc_count_.fetch_add(1, std::memory_order_relaxed);
     req.head.req_id = next_req_id_.fetch_add(1);
     // per-channel client identity: the server's resend-dedup slot assumes
     // monotonic req_ids per client, which holds per channel but not across
@@ -915,6 +926,7 @@ class PsWorker {
     // phase 1: bounded fast retries (the pre-failover semantics)
     for (int attempt = 0; attempt <= max_retry_; ++attempt) {
       if (attempt > 0) {
+        retry_count_.fetch_add(1, std::memory_order_relaxed);
         auto st = query_server_status(server);
         {
           // both channels' retry paths may relocate the same server
@@ -963,6 +975,7 @@ class PsWorker {
             last_err = e.what();
           }
           if (connected && try_roundtrip(conns, server, req, &rsp, &last_err)) {
+            failover_count_.fetch_add(1, std::memory_order_relaxed);
             std::fprintf(stderr,
                          "[hetups worker %d] server %zu recovered at %s; "
                          "request re-issued\n",
@@ -1074,6 +1087,9 @@ class PsWorker {
             .count());
   }
   std::atomic<uint64_t> next_req_id_{boot_req_id()};
+  std::atomic<uint64_t> rpc_count_{0};       // telemetry (client_stats)
+  std::atomic<uint64_t> retry_count_{0};
+  std::atomic<uint64_t> failover_count_{0};
   std::unique_ptr<Conn> sched_;
   std::mutex sched_mu_;
   std::mutex addr_mu_;   // guards server_addrs_ (both channels' retries)

@@ -39,8 +39,12 @@ def _load():
         for name in ("CacheLastError", "CachePerfJson", "CacheRepr"):
             getattr(_lib, name).restype = ctypes.c_char_p
         for name in ("CacheDestroy", "CacheSetBounds", "CacheBypass",
-                     "CachePerfEnabled", "CacheInsertOne"):
+                     "CachePerfEnabled", "CacheInsertOne",
+                     "CachePerfRollup"):
             getattr(_lib, name).restype = None
+        _lib.CachePerfRollup.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int]
         _lib.CacheWait.restype = ctypes.c_int
         _lib.CacheCount.restype = ctypes.c_int
         _lib.CacheLookupOne.restype = ctypes.c_int
@@ -187,9 +191,13 @@ class CacheSparseTable:
     def undobypass(self):
         self._lib.CacheBypass(ctypes.c_void_p(self._handle), 0)
 
-    def perf_enabled(self, enable=True):
-        self._lib.CachePerfEnabled(ctypes.c_void_p(self._handle),
-                                   int(bool(enable)))
+    def perf_enabled(self, enable=True, rollup_only=False):
+        """Arm perf accounting. ``rollup_only=True`` keeps only the O(1)
+        cumulative counters (:meth:`telemetry_summary`) and skips the
+        per-batch log behind :attr:`perf` — bounded memory on long runs."""
+        self._lib.CachePerfEnabled(
+            ctypes.c_void_p(self._handle),
+            2 if (enable and rollup_only) else int(bool(enable)))
 
     @property
     def perf(self):
@@ -215,6 +223,26 @@ class CacheSparseTable:
             return -1
         return (sum(x["num_transfered"] for x in perf)
                 / max(1, sum(x["num_all"] for x in perf)))
+
+    def telemetry_summary(self) -> dict:
+        """O(1) rollup for the telemetry poll (docs/OBSERVABILITY.md):
+        miss/data rates over ALL traffic (cold start included — an operator
+        reconciles against total RPC counts) plus cumulative evictions.
+        Rates are -1 until any traffic of that type exists. Requires
+        ``perf_enabled(True)`` (the PS runtime arms it when telemetry is
+        active). Reads the native running totals (``CachePerfRollup``) —
+        unlike :attr:`perf`, no per-batch log crosses the ctypes boundary,
+        so the poll stays cheap on arbitrarily long runs."""
+        out = (ctypes.c_longlong * 6)()
+        self._lib.CachePerfRollup(ctypes.c_void_p(self._handle), out, 6)
+        batches, evictions, pull_miss, pull_uniq, transfered, num_all = (
+            int(v) for v in out)
+        return {
+            "batches": batches,
+            "evictions": evictions,
+            "miss_rate": pull_miss / pull_uniq if pull_uniq else -1,
+            "data_rate": transfered / num_all if num_all else -1,
+        }
 
     # -- single-key debug API ----------------------------------------------
     def lookup(self, key):

@@ -10,10 +10,12 @@ the *global* batch across the mesh's dp axis in the executor.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .graph.node import Op
 
 
@@ -101,11 +103,36 @@ class Dataloader:
                              float(d["rng_cached_gaussian"])))
         self._peeked = (np.asarray(d["peeked"]) if "peeked" in d else None)
 
+    _tel_handles = None   # (telemetry instance, wait histogram, cursor gauge)
+
     def get_arr(self) -> np.ndarray:
+        tel = _telemetry.get()
+        if tel is None:
+            if self._peeked is not None:
+                batch, self._peeked = self._peeked, None
+                return batch
+            return self._next_batch()
+        # batch-wait: what the step actually waits on — ~0 on a peeked
+        # (prefetched) batch, the transform cost otherwise; the cursor gauge
+        # is the state_dict position an operator sees in hetutop. Handles
+        # cached per telemetry instance: a registry lookup per batch is
+        # measurable on sub-ms steps.
+        h = self._tel_handles
+        if h is None or h[0] is not tel:
+            h = self._tel_handles = (
+                tel,
+                tel.metrics.histogram("hetu_dataloader_wait_ms",
+                                      {"loader": self.name}),
+                tel.metrics.gauge("hetu_dataloader_cursor",
+                                  {"loader": self.name}))
+        t0 = time.perf_counter()
         if self._peeked is not None:
             batch, self._peeked = self._peeked, None
-            return batch
-        return self._next_batch()
+        else:
+            batch = self._next_batch()
+        h[1].observe((time.perf_counter() - t0) * 1e3)
+        h[2].set(self._cursor)
+        return batch
 
     def peek_arr(self) -> np.ndarray:
         """The batch the next ``get_arr`` will return, without consuming it.

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..context import DeviceGroup, get_current_context
+from ..telemetry.tracing import XlaTraceWindow as _XW
 from ..ndarray import DLContext, NDArray, ND_Sparse_Array, SparseValue, cpu, tpu
 from .node import Op, PlaceholderOp, find_topo_sort
 from .gradients import gradients, GradientOp, GradientContext
@@ -55,7 +56,8 @@ class HetuConfig:
                  cstable_policy=None, bsp=False, prefetch=True, enable_lazy=False,
                  cache_bound=100, log_path=None, gpipe=False,
                  gpipe_microbatches=None, dtype=np.float32,
-                 dp_axis="dp", mp_axis="tp", anomaly_guard=False, **kwargs):
+                 dp_axis="dp", mp_axis="tp", anomaly_guard=False,
+                 telemetry=None, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -87,6 +89,12 @@ class HetuConfig:
         from ..resilience import env_truthy
         self.anomaly_guard = bool(anomaly_guard) \
             or env_truthy("HETU_ANOMALY_GUARD")
+        # observability: "off" (default, zero per-step overhead), "metrics"
+        # (registry + per-step JSONL), or "trace" (+ Chrome-trace spans).
+        # Env default: HETU_TELEMETRY; output dir: HETU_TELEMETRY_DIR.
+        # See hetu_tpu/telemetry and docs/OBSERVABILITY.md.
+        from ..telemetry import resolve_mode
+        self.telemetry = resolve_mode(telemetry)
         if self.anomaly_guard and comm_mode in ("PS", "Hybrid"):
             raise ValueError(
                 "anomaly_guard gates the on-device state commit, but PS-"
@@ -366,6 +374,12 @@ class SubExecutor:
                           "dispatch_s": 0.0, "poststep_s": 0.0, "steps": 0}
                          if os.environ.get("HETU_PROFILE", "0")
                          not in ("", "0") else None)
+        # telemetry (docs/OBSERVABILITY.md): PS server-health poll cadence
+        # and the last recorded per-phase wall times (graphboard's
+        # render(..., timings=True) overlay reads these)
+        self._tel_ps_every = max(1, int(os.environ.get(
+            "HETU_TELEMETRY_PS_EVERY", "20")))
+        self.last_phases: Optional[dict] = None
 
         # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
         ps = executor.ps_runtime
@@ -617,6 +631,71 @@ class SubExecutor:
         return {k.replace("_s", "_ms_per_step"): round(v / n * 1000, 3)
                 for k, v in p.items() if k != "steps"} | {"steps": n}
 
+    def _record_telemetry(self, tel, step, t0, t_pre, t_c0, t_c1, t_d0,
+                          t_d1, t_end, compiled_now, feed_vals, batch_vals):
+        """Per-step telemetry: phase spans (trace mode), step metrics and
+        the JSONL step record; PS server health on its poll cadence. Runs
+        only when telemetry is active — the hot path records raw
+        ``perf_counter`` stamps and this emits everything post-hoc."""
+        ex = self.executor
+        step_ms = (t_end - t0) * 1e3
+        phases = {"prestep_ms": (t_pre - t0) * 1e3,
+                  "dispatch_ms": (t_d1 - t_d0) * 1e3,
+                  "poststep_ms": (t_end - t_d1) * 1e3}
+        if compiled_now:
+            phases["compile_ms"] = (t_c1 - t_c0) * 1e3
+        self.last_phases = {"step_ms": step_ms, "step": int(step), **phases}
+        tracer = tel.tracer
+        label = "step" if self.training else "eval"
+        if tracer is not None:
+            tracer.complete(f"{label}:{self.name}", t0, t_end,
+                            args={"step": int(step)})
+            tracer.complete("feed", t0, t_pre)
+            if compiled_now:
+                tracer.complete("compile", t_c0, t_c1)
+            # jax.jit compiles lazily: on a compiled_now step the first
+            # dispatch below carries the actual XLA trace+compile, so the
+            # "compile" span above is only the step-fn build
+            tracer.complete("compute", t_d0, t_d1,
+                            args={"includes_compile": True}
+                            if compiled_now else None)
+            tracer.complete("poststep", t_d1, t_end)
+        tm = ex._tel_metrics
+        if not self.training:
+            tm["eval_ms"].observe(step_ms)
+            return
+        tm["step_ms"].observe(step_ms)
+        tm["steps"].inc()
+        bs = None
+        for v in list(batch_vals) + list(feed_vals):
+            shape = getattr(v, "shape", None)
+            if shape:
+                bs = int(shape[0])
+                break
+        if bs is None and self.res_dl_nodes:
+            bs = self.resident_dl[id(self.res_dl_nodes[0])][1]
+        if bs:
+            tm["examples"].inc(bs)
+        if compiled_now:
+            tm["compiles"].inc()
+            if len(self._compiled) > 1:
+                tm["recompiles"].inc()
+            mon = ex._tel_recompile_mon
+            if mon is not None:
+                for f in mon.check():
+                    # signature-churn diagnosis from the existing Tier B
+                    # RecompileMonitor, surfaced as a telemetry event
+                    tel.event("recompile_budget", sub=self.name,
+                              message=f.message)
+            cost = self.last_cost_analysis() or {}
+            if cost.get("flops"):
+                tm["flops"].set(float(cost["flops"]))
+        tel.step_record(self.name, step, step_ms, phases=phases)
+        ps = ex.ps_runtime
+        if ps is not None and step % self._tel_ps_every == 0:
+            for row in ps.telemetry_stats():
+                tel.record(**row)
+
     def _lowered(self):
         """Re-lower the latest executed step (hits the compilation cache)."""
         if self._last_call is None:
@@ -664,7 +743,9 @@ class SubExecutor:
             eval_node_list=None):
         ex = self.executor
         prof = self._profile  # HETU_PROFILE=1: per-phase wall-time ledger
-        t_run0 = time.perf_counter() if prof is not None else 0.0
+        tel = ex.telemetry   # None when telemetry is off (the only check)
+        timed = prof is not None or tel is not None
+        t_run0 = time.perf_counter() if timed else 0.0
         # resilience supervisor (watchdog beat, host fault injection);
         # training targets only — an eval pass is not a supervised step
         sup = getattr(ex, "supervisor", None) if self.training else None
@@ -737,19 +818,22 @@ class SubExecutor:
             ps.wait_dense(p)   # async DDPushPull updates host_value
             ps_dense_vals.append(ex._prepare_input(p.host_value, batch=False))
 
+        t_pre = time.perf_counter() if timed else 0.0
         if prof is not None:
-            t_pre = time.perf_counter()
             prof["prestep_s"] += t_pre - t_run0
 
         key = self._signature(feed_vals, batch_vals) + (
             tuple(tuple(v.shape) for v in ps_staged_vals),)
         fn = self._compiled.get(key)
+        compiled_now = fn is None
+        t_c0 = t_c1 = t_pre
         if fn is None:
-            t_c0 = time.perf_counter() if prof is not None else 0.0
+            t_c0 = time.perf_counter() if timed else 0.0
             fn = self._build()
             self._compiled[key] = fn
+            t_c1 = time.perf_counter() if timed else 0.0
             if prof is not None:
-                prof["trace_build_s"] += time.perf_counter() - t_c0
+                prof["trace_build_s"] += t_c1 - t_c0
 
         params_t = tuple(ex.state["params"][id(n)] for n in ex.param_nodes)
         slots_t = tuple(ex.state["slots"][id(n)] for n in self.optimizer_nodes)
@@ -765,10 +849,21 @@ class SubExecutor:
                 res_data, tuple(ps_staged_vals), tuple(ps_dense_vals),
                 np.bool_(inject_nan))
         self._last_call = (fn, args)
-        t_d0 = time.perf_counter() if prof is not None else 0.0
-        outputs, new_params, new_slots, new_opstate, ps_grads, finite_t = \
-            fn(*args)
-        t_d1 = time.perf_counter() if prof is not None else 0.0
+        if tel is not None and tel.xla_window is not None and self.training:
+            # env-gated deep dive: HETU_XLA_TRACE=dir[:start[:n]] opens a
+            # bounded jax.profiler window around the configured steps
+            tel.xla_window.on_step(step)
+        t_d0 = time.perf_counter() if timed else 0.0
+        if tel is not None and tel.tracer is not None:
+            # named step regions in the device timeline when a jax profiler
+            # trace is active (the XLA window above, or an external capture)
+            with _XW.step_annotation(step):
+                outputs, new_params, new_slots, new_opstate, ps_grads, \
+                    finite_t = fn(*args)
+        else:
+            outputs, new_params, new_slots, new_opstate, ps_grads, finite_t = \
+                fn(*args)
+        t_d1 = time.perf_counter() if timed else 0.0
         if prof is not None:
             prof["dispatch_s"] += t_d1 - t_d0
 
@@ -837,11 +932,20 @@ class SubExecutor:
             else:
                 ex.state["anomaly_streak"] += 1
                 ex.state["anomaly_total"] += 1
+                if tel is not None:
+                    ex._tel_metrics["anomalies"].inc()
             ex.state["last_step_finite"] = finite
 
+        t_end = time.perf_counter() if timed else 0.0
         if prof is not None:
-            prof["poststep_s"] += time.perf_counter() - t_d1
+            prof["poststep_s"] += t_end - t_d1
             prof["steps"] += 1
+        if tel is not None:
+            # recorded BEFORE supervisor post-step: an emergency flush on
+            # the preemption path must already contain this step's record
+            self._record_telemetry(
+                tel, step, t_run0, t_pre, t_c0, t_c1, t_d0, t_d1, t_end,
+                compiled_now, feed_vals, batch_vals)
 
         # post-step supervision LAST: a rollback rewrites ex.state, an
         # emergency save captures it, and Preempted aborts the return — all
@@ -881,6 +985,43 @@ class Executor:
                                 comm_mode=comm_mode, **kwargs)
         self.config = config
         self.comm_mode = config.comm_mode
+
+        # -- telemetry activation (docs/OBSERVABILITY.md) -------------------
+        # Activated BEFORE the PS runtime spawns so its pull/push streams can
+        # cache the handle. When off, self.telemetry is None and every
+        # instrumented point in SubExecutor.run short-circuits on that one
+        # None check — no timestamps, no allocations.
+        from .. import telemetry as _tel_pkg
+        self.telemetry = _tel_pkg.activate(config.telemetry)
+        self._tel_metrics = None
+        self._tel_recompile_mon = None
+        if self.telemetry is not None:
+            reg = self.telemetry.metrics
+            self._tel_metrics = {
+                "step_ms": reg.histogram("hetu_step_time_ms"),
+                "eval_ms": reg.histogram("hetu_eval_time_ms"),
+                "steps": reg.counter("hetu_steps_total"),
+                "examples": reg.counter("hetu_examples_total"),
+                "compiles": reg.counter("hetu_compiles_total"),
+                "recompiles": reg.counter("hetu_recompiles_total"),
+                "anomalies": reg.counter("hetu_anomaly_trips_total"),
+                "flops": reg.gauge("hetu_flops_per_step"),
+            }
+            from ..analysis.lowered import RecompileMonitor
+            self._tel_recompile_mon = RecompileMonitor(
+                self, budget=int(os.environ.get("HETU_RECOMPILE_BUDGET",
+                                                "3")))
+            try:
+                device_kind = str(jax.devices()[0].device_kind)
+            except Exception:  # noqa: BLE001 — identity is best-effort
+                device_kind = "unknown"
+            # the peak is an ASSUMPTION (docs/ROOFLINE.md): record it next
+            # to the device so every MFU number downstream is auditable
+            self.telemetry.record(
+                "run_info", device_kind=device_kind,
+                peak_tflops_assumed=float(
+                    os.environ.get("HETU_PEAK_TFLOPS", "197")),
+                comm_mode=str(config.comm_mode))
 
         full_topo = find_topo_sort(all_nodes)
         # any variable read through an embedding lookup is a sparse embedding
@@ -1157,6 +1298,17 @@ class Executor:
 
     # -- checkpoint (reference executor.py:355-413; adds optimizer state) ---
     def save(self, file_path: str):
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
+        self._save(file_path)
+        if tel is not None:
+            t1 = time.perf_counter()
+            tel.metrics.histogram("hetu_checkpoint_save_ms").observe(
+                (t1 - t0) * 1e3)
+            if tel.tracer is not None:
+                tel.tracer.complete("checkpoint_save", t0, t1, cat="ckpt")
+
+    def _save(self, file_path: str):
         os.makedirs(file_path, exist_ok=True)
         if self.ps_runtime is not None:
             self.ps_runtime.save(file_path)

@@ -26,10 +26,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Optional
 
 import numpy as np
+
+from .. import telemetry as _telemetry
 
 from .node import Op, PlaceholderOp, find_topo_sort
 from .ops.ps import ParameterServerCommunicateOp, ParameterServerSparsePullOp
@@ -180,6 +183,18 @@ class PSRuntime:
         self.perf = {"sync_pulls": 0, "prefetch_issued": 0,
                      "prefetch_hits": 0, "prefetch_misses": 0,
                      "async_pushes": 0}
+        # telemetry (docs/OBSERVABILITY.md): RPC latency/bytes observed from
+        # the push/pull stream threads; None when off — handles cached here
+        # so the streams pay one attribute read per RPC, not a registry walk
+        self.tel = _telemetry.get()
+        if self.tel is not None:
+            reg = self.tel.metrics
+            self._m_pull_ms = reg.histogram("hetu_ps_pull_ms")
+            self._m_push_ms = reg.histogram("hetu_ps_push_ms")
+            self._m_pull_bytes = reg.counter("hetu_ps_pull_bytes_total")
+            self._m_push_bytes = reg.counter("hetu_ps_push_bytes_total")
+            self._m_pref_hits = reg.counter("hetu_ps_prefetch_hits_total")
+            self._m_pref_miss = reg.counter("hetu_ps_prefetch_misses_total")
         ps_pkg._register_runtime(self)  # drained at worker_finish
 
     # ------------------------------------------------------------------
@@ -296,6 +311,11 @@ class PSRuntime:
                 p.cache = CacheSparseTable(limit, rows, width, p.ps_id,
                                            policy=cfg.cstable_policy,
                                            bound=cfg.cache_bound)
+                if _telemetry.get() is not None:
+                    # arm the C++ perf counters the telemetry poll reads;
+                    # rollup-only — the per-batch log would grow unbounded
+                    # over a long run
+                    p.cache.perf_enabled(True, rollup_only=True)
             if not p.sparse:
                 buf = np.zeros(rows, np.float32)
                 self.comm.Pull(p.ps_id, buf)
@@ -306,6 +326,8 @@ class PSRuntime:
     # pre-step: stage embedding rows / dense values
     # ------------------------------------------------------------------
     def _pull_rows(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
+        tel = self.tel
+        t0 = time.perf_counter() if tel is not None else 0.0
         width = int(np.prod(p.shape[1:]))
         flat = np.ascontiguousarray(idx, dtype=np.int64).ravel()
         dest = np.zeros((flat.size, width), np.float32)
@@ -317,6 +339,14 @@ class PSRuntime:
             with self._rpc_lock:
                 self.comm.SparsePull(p.ps_id, flat, dest)
             self.comm.Wait(p.ps_id)
+        if tel is not None:
+            t1 = time.perf_counter()
+            self._m_pull_ms.observe((t1 - t0) * 1e3)
+            self._m_pull_bytes.inc(dest.nbytes)
+            if tel.tracer is not None:
+                tel.tracer.complete("ps_pull", t0, t1, cat="ps",
+                                    args={"rows": int(flat.size),
+                                          "tensor": p.ps_id})
         return dest.reshape(tuple(idx.shape) + tuple(p.shape[1:]))
 
     def stage_lookup(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
@@ -351,8 +381,12 @@ class PSRuntime:
         expected, fut = ent
         if np.array_equal(expected, np.asarray(idx)):
             self.perf["prefetch_hits"] += 1
+            if self.tel is not None:
+                self._m_pref_hits.inc()
             return fut.result()
         self.perf["prefetch_misses"] += 1
+        if self.tel is not None:
+            self._m_pref_miss.inc()
         fut.result()  # let it finish; the pulled rows are simply unused
         return None
 
@@ -377,7 +411,24 @@ class PSRuntime:
         lr = float(o.lr_value(step)) if o is not None else float(opt["lrs"][0])
         self.comm.SetPushOpts(p.ps_id, lr, opt["l2reg"], opt["wd"])
 
-    def _push_one(self, p: PSParam, grad, idx, step: int):
+    def _push_one(self, p: PSParam, grad, idx, step: int) -> None:
+        tel = self.tel
+        if tel is None:
+            self._push_one_body(p, grad, idx, step)
+            return
+        t0 = time.perf_counter()
+        pushed = self._push_one_body(p, grad, idx, step)
+        t1 = time.perf_counter()
+        self._m_push_ms.observe((t1 - t0) * 1e3)
+        self._m_push_bytes.inc(pushed)
+        if tel.tracer is not None:
+            tel.tracer.complete("ps_push", t0, t1, cat="ps",
+                                args={"tensor": p.ps_id,
+                                      "bytes": int(pushed)})
+
+    def _push_one_body(self, p: PSParam, grad, idx, step: int) -> int:
+        """Returns the pushed payload size in bytes (grad values; the
+        timing around it includes the device sync np.asarray implies)."""
         opt = self._server_opt
         self._refresh_push_opts(p, step)
         if p.sparse:
@@ -412,6 +463,7 @@ class PSRuntime:
                 with self._rpc_lock:
                     self.comm.SparsePush(p.ps_id, flat_idx, g)
                 self.comm.Wait(p.ps_id)
+            return g.nbytes + flat_idx.nbytes
         else:
             g = np.asarray(grad, np.float32).ravel()
             if opt["prescale"]:
@@ -421,6 +473,7 @@ class PSRuntime:
                 self.comm.DDPushPull(p.ps_id, g, out)
             self.comm.Wait(p.ps_id)
             p.host_value = out.reshape(p.shape)
+            return g.nbytes
 
     def push_grad(self, p: PSParam, grad: np.ndarray,
                   idx: Optional[np.ndarray], step: int = 0):
@@ -506,3 +559,48 @@ class PSRuntime:
     def pull_sparse_rows(self, p: PSParam, idx: np.ndarray) -> np.ndarray:
         self.drain()
         return self._pull_rows(p, idx)
+
+    # ------------------------------------------------------------------
+    def telemetry_stats(self) -> list[dict]:
+        """PS-tier health rows for the telemetry JSONL (polled by the
+        executor on its HETU_TELEMETRY_PS_EVERY cadence): one ``ps_server``
+        row per server (the extended kServerStats: updates, snapshot
+        coverage/age/version, request count, apply latency, dedup-ledger
+        occupancy), plus worker-side retry/failover counters and embedding-
+        cache hit/data rates as registry metrics. Never raises — a health
+        poll must not take training down with it."""
+        rows: list[dict] = []
+        if self.tel is None:
+            return rows
+        reg = self.tel.metrics
+        try:
+            for s in range(self.comm.num_servers):
+                with self._rpc_lock:
+                    st = self.comm.ServerStats(s)
+                rows.append({"kind": "ps_server", "server": s, **st})
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
+        try:
+            with self._rpc_lock:
+                cs = self.comm.ClientStats()
+            reg.gauge("hetu_ps_rpcs_total").set(cs["rpcs"])
+            reg.gauge("hetu_ps_retries_total").set(cs["retries"])
+            reg.gauge("hetu_ps_failovers_total").set(cs["failovers"])
+        except Exception:  # noqa: BLE001
+            pass
+        for p in self.params.values():
+            if p.cache is None:
+                continue
+            try:
+                s = p.cache.telemetry_summary()
+            except Exception:  # noqa: BLE001
+                continue
+            labels = {"tensor": str(p.ps_id)}
+            if s["miss_rate"] >= 0:
+                reg.gauge("hetu_cache_hit_rate", labels).set(
+                    1.0 - s["miss_rate"])
+            if s["data_rate"] >= 0:
+                reg.gauge("hetu_cache_data_rate", labels).set(s["data_rate"])
+            reg.gauge("hetu_cache_evictions_total", labels).set(
+                s["evictions"])
+        return rows

@@ -33,6 +33,71 @@ _SEV_COLORS = {"error": ("#f8d7da", "#c0392b"),
                "note": (None, "#888888")}
 _SEV_ORDER = ("error", "warn", "note")
 
+# timings overlay (render(..., timings=True)): phase display names
+_PHASE_NAMES = {"prestep_ms": "feed/PS-pull (pre-step)",
+                "dispatch_ms": "compute (dispatch)",
+                "poststep_ms": "PS-push/bookkeeping (post-step)",
+                "compile_ms": "compile"}
+
+
+def _heat(frac: float) -> str:
+    """Heat ramp for the timings overlay: share of step time -> pale
+    amber .. red."""
+    f = max(0.0, min(1.0, frac))
+    c0, c1 = (0xff, 0xf3, 0xe0), (0xe5, 0x39, 0x35)
+    return "#%02x%02x%02x" % tuple(int(a + (b - a) * f)
+                                   for a, b in zip(c0, c1))
+
+
+def step_timings(executor, name=None):
+    """The last instrumented step's per-phase wall times for one
+    subexecutor ({"step_ms", "step", "prestep_ms", ...}), or None when no
+    step has run with telemetry enabled (HetuConfig(telemetry=...))."""
+    subs = getattr(executor, "subexecutors", None)
+    if subs:
+        sub = subs[name if name is not None else next(iter(subs))]
+    else:
+        sub = executor
+    return getattr(sub, "last_phases", None)
+
+
+def _phase_of_node(node, ps_ids):
+    """Which host-side step phase a node's work lands in (heuristic for the
+    overlay): dataloaders/feeds stage pre-step, PS gradient pushes post-
+    step, PS-hosted lookups pull pre-step; everything else runs inside the
+    dispatched XLA program. Non-feed placeholders (device-resident params)
+    have no phase — returns None."""
+    if getattr(node, "is_dataloader", False):
+        return "prestep_ms"
+    if getattr(node, "is_placeholder", False):
+        return "prestep_ms" if getattr(node, "is_feed", False) else None
+    if type(node).__name__ == "ParameterServerCommunicateOp":
+        return "poststep_ms"
+    embed = getattr(node, "embed_node", None)
+    if embed is not None and id(embed) in ps_ids:
+        return "prestep_ms"
+    return "dispatch_ms"
+
+
+def _timing_overlay(executor, topo, tdict):
+    """{op_id: (frac_of_step, tooltip)} for the timings overlay."""
+    if not tdict:
+        return {}
+    step_ms = tdict.get("step_ms") or 0.0
+    rt = getattr(executor, "ps_runtime", None)
+    ps_ids = set(rt.params.keys()) if rt is not None else set()
+    out = {}
+    for node in topo:
+        phase = _phase_of_node(node, ps_ids)
+        if phase is None or phase not in tdict:
+            continue
+        ms = tdict[phase]
+        frac = ms / step_ms if step_ms else 0.0
+        out[node.id] = (frac,
+                        f"{_PHASE_NAMES.get(phase, phase)}: {ms:.3f} ms of "
+                        f"{step_ms:.3f} ms step ({100 * frac:.0f}%)")
+    return out
+
 
 def _topo_of(executor, name=None):
     subs = getattr(executor, "subexecutors", None)
@@ -73,26 +138,37 @@ def lint_findings(executor, name=None):
     return findings
 
 
-def make_dot(executor, name=None, findings=None) -> str:
+def make_dot(executor, name=None, findings=None, timings=None) -> str:
     """DOT source of the topo (the reference's Digraph, sans dependency).
     ``findings`` (hetulint output) annotate nodes with severity colors and
-    tooltips."""
+    tooltips; ``timings`` (a :func:`step_timings` dict) heat-colors nodes by
+    their phase's share of the last instrumented step."""
     lines = ["digraph hetu {", "  rankdir=TB;",
              '  node [shape=box, style="rounded,filled", '
              'fillcolor="#eeeeee", fontname="Helvetica"];']
     topo = _topo_of(executor, name)
     by_op = _findings_by_op(findings)
+    overlay = _timing_overlay(executor, topo, timings)
     for node in topo:
         color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
         label = node.name.replace('"', "'")
         extra = ""
         fs = by_op.get(node.id)
+        tlay = overlay.get(node.id)
+        tips = []
+        if tlay is not None:
+            color = _heat(tlay[0])
+            tips.append(tlay[1].replace('"', "'"))
         if fs:
+            # findings outrank the heat fill — a lint error must stay visible
             sev = _worst_severity(fs)
             fill, stroke = _SEV_COLORS[sev]
             color = fill or color
-            tip = "\\n".join(str(f).replace('"', "'") for f in fs)
-            extra = f', color="{stroke}", penwidth=2, tooltip="{tip}"'
+            tips = [str(f).replace('"', "'") for f in fs] + tips
+            extra = f', color="{stroke}", penwidth=2'
+        if tips:
+            tip = "\\n".join(tips)
+            extra += f', tooltip="{tip}"'
         lines.append(
             f'  n{node.id} [label="{label}", fillcolor="{color}"{extra}];')
     for node in topo:
@@ -121,9 +197,10 @@ def _layout(topo):
 NODE_W, NODE_H, GAP_X, GAP_Y = 150, 34, 30, 46
 
 
-def make_svg(executor, name=None, findings=None) -> str:
+def make_svg(executor, name=None, findings=None, timings=None) -> str:
     topo = _topo_of(executor, name)
     by_op = _findings_by_op(findings)
+    overlay = _timing_overlay(executor, topo, timings)
     pos, n_ranks, width = _layout(topo)
     W = width * (NODE_W + GAP_X) + GAP_X
     H = n_ranks * (NODE_H + GAP_Y) + GAP_Y
@@ -151,16 +228,22 @@ def make_svg(executor, name=None, findings=None) -> str:
     for node in topo:
         x, y = xy(node)
         color = _KIND_COLORS.get(type(node).__name__, "#eeeeee")
-        stroke, swidth, tip = "#888", 1, ""
+        stroke, swidth = "#888", 1
+        tips = []
+        tlay = overlay.get(node.id)
+        if tlay is not None:
+            color = _heat(tlay[0])
+            tips.append(tlay[1])
         fs = by_op.get(node.id)
         if fs:
+            # findings outrank the heat fill — a lint error must stay visible
             sev = _worst_severity(fs)
             fill, stroke = _SEV_COLORS[sev]
             color = fill or color
             swidth = 2
-            tip = ("<title>"
-                   + html.escape("\n".join(str(f) for f in fs))
-                   + "</title>")
+            tips = [str(f) for f in fs] + tips
+        tip = ("<title>" + html.escape("\n".join(tips)) + "</title>"
+               if tips else "")
         label = node.name if len(node.name) <= 22 else node.name[:20] + "…"
         label = html.escape(label)  # escape AFTER truncating: cutting inside
         # an entity would emit a bare '&' and break the XML
@@ -176,23 +259,49 @@ def make_svg(executor, name=None, findings=None) -> str:
 
 
 def render(executor, name=None, out_dir="graphboard_out", findings=None,
-           lint=False):
+           lint=False, timings=False):
     """Write output.dot / output.svg / index.html; returns out_dir.
 
     ``lint=True`` runs the hetulint analyzer over the graph (plus Tier B if
     a step has executed) and annotates offending nodes — severity-colored
     with hover tooltips — and appends the finding list to index.html.
-    Explicit ``findings`` skip the analyzer run."""
+    Explicit ``findings`` skip the analyzer run.
+
+    ``timings=True`` overlays the LAST instrumented step's per-phase wall
+    times from the telemetry layer (heat coloring by phase share + hover
+    tooltips, plus a phase table in index.html); requires a step to have
+    run with ``HetuConfig(telemetry=...)`` enabled — rendered without the
+    overlay (with a note) otherwise. Pass a :func:`step_timings`-shaped
+    dict to overlay explicit numbers."""
     os.makedirs(out_dir, exist_ok=True)
     if lint and findings is None:
         findings = lint_findings(executor, name)
+    tdict = None
+    if timings:
+        tdict = timings if isinstance(timings, dict) \
+            else step_timings(executor, name)
     with open(os.path.join(out_dir, "output.dot"), "w") as f:
-        f.write(make_dot(executor, name, findings=findings))
-    svg = make_svg(executor, name, findings=findings)
+        f.write(make_dot(executor, name, findings=findings, timings=tdict))
+    svg = make_svg(executor, name, findings=findings, timings=tdict)
     with open(os.path.join(out_dir, "output.svg"), "w") as f:
         f.write(svg)
     body = "<!doctype html><title>hetu_tpu graphboard</title>" \
            "<h3>Executor graph</h3>" + svg
+    if tdict:
+        rows = "".join(
+            f"<tr><td>{html.escape(_PHASE_NAMES.get(k, k))}</td>"
+            f"<td>{tdict[k]:.3f}</td></tr>"
+            for k in ("prestep_ms", "compile_ms", "dispatch_ms",
+                      "poststep_ms") if k in tdict)
+        body += (f"<h3>step {tdict.get('step')} phase timings "
+                 f"({tdict.get('step_ms', 0):.3f} ms total)</h3>"
+                 f"<table border=1 cellpadding=4><tr><th>phase</th>"
+                 f"<th>ms</th></tr>{rows}</table>")
+    elif timings:
+        body += ("<p><em>timings requested but no telemetry data — run a "
+                 "step with HetuConfig(telemetry=&quot;metrics&quot;) or "
+                 "HETU_TELEMETRY=metrics first "
+                 "(docs/OBSERVABILITY.md)</em></p>")
     if findings:
         items = "".join(
             f"<li><code>{html.escape(str(f))}</code></li>"
@@ -205,10 +314,11 @@ def render(executor, name=None, out_dir="graphboard_out", findings=None,
 
 
 def show(executor, port=9997, name=None, out_dir="graphboard_out",
-         findings=None, lint=False):
+         findings=None, lint=False, timings=False):
     """Render + serve on a background thread (reference show :11)."""
     global _server, _thread
-    render(executor, name, out_dir, findings=findings, lint=lint)
+    render(executor, name, out_dir, findings=findings, lint=lint,
+           timings=timings)
     close()
 
     def _make(*a, **k):

@@ -4,6 +4,8 @@ Host-side numpy by design — these run on eval results, not in the step.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 
@@ -48,12 +50,36 @@ def roc_pr_curve(values, curve="ROC"):
 
 def auc(labels, predictions, num_thresholds=200, curve="ROC"):
     """Trapezoidal AUC over thresholded confusion matrices
-    (reference metrics.py:120)."""
+    (reference metrics.py:120).
+
+    Degenerate inputs return NaN with a warning instead of an ``eps``-fudged
+    arbitrary number: empty inputs, and single-class labels — ROC needs
+    both classes (TPR or FPR is 0/0 at every threshold), PR needs at least
+    one positive. The previous behavior silently returned a value like
+    ~0.5 whose magnitude was pure epsilon artifact.
+    """
+    flat_labels = np.asarray(labels).reshape(-1).astype(bool)
+    flat_preds = np.asarray(predictions).reshape(-1)
+    n_pos = int(flat_labels.sum())
+    n_neg = flat_labels.size - n_pos
+    degenerate = None
+    if flat_preds.size == 0 or flat_labels.size == 0:
+        degenerate = "empty labels/predictions"
+    elif curve == "ROC" and (n_pos == 0 or n_neg == 0):
+        degenerate = (f"single-class labels ({n_pos} positive, {n_neg} "
+                      "negative) — ROC AUC needs both classes")
+    elif curve != "ROC" and n_pos == 0:
+        degenerate = "no positive labels — PR AUC needs at least one"
+    if degenerate is not None:
+        warnings.warn(f"auc({curve}) is undefined for {degenerate}; "
+                      "returning NaN", stacklevel=2)
+        return float("nan")
     eps = 1e-7
     thresholds = [(i + 1) * 1.0 / (num_thresholds - 1)
                   for i in range(num_thresholds - 2)]
     thresholds = [0.0 - eps] + thresholds + [1.0 + eps]
-    values = confusion_matrix_at_thresholds(labels, predictions, thresholds)
+    values = confusion_matrix_at_thresholds(flat_labels, flat_preds,
+                                            thresholds)
     x, y = roc_pr_curve(values, curve=curve)
     return float(np.sum(np.abs(np.diff(x)) * (y[:-1] + y[1:]) / 2.0))
 

@@ -119,20 +119,41 @@ class PSClient:
         return self._lib.num_servers()
 
     def ServerStats(self, server: int) -> dict:
-        """Per-server HA counters (rides the fast channel): ``updates``
-        applied since start/restore, ``snapshot_updates`` covered by the
-        latest complete snapshot, ``restored_updates`` the counter the
-        server restored from (-1 = fresh start), ``snapshot_version`` and
-        ``n_params``. After a recovery, ``acked-before-death updates -
-        restored_updates`` is exactly how many updates that shard lost."""
-        out = np.zeros(5, np.int64)
+        """Per-server HA + health counters (rides the fast channel):
+        ``updates`` applied since start/restore, ``snapshot_updates``
+        covered by the latest complete snapshot, ``restored_updates`` the
+        counter the server restored from (-1 = fresh start),
+        ``snapshot_version``, ``n_params``; plus the telemetry extension —
+        ``requests`` served, ``apply_ms_avg`` (mean wall ms per applied
+        write), ``snapshot_age_ms`` since THIS incarnation's latest
+        snapshot (-1 = none yet, including right after a restore), and
+        ``dedup_clients`` (resend-dedup ledger occupancy). After a
+        recovery, ``acked-before-death updates - restored_updates`` is
+        exactly how many updates that shard lost."""
+        out = np.zeros(10, np.int64)
         self._lib.QueryServerStats(ctypes.c_int(int(server)),
                                    out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(5))
+                                   ctypes.c_int(10))
         self._check()
+        apply_cnt = int(out[7])
         return {"updates": int(out[0]), "snapshot_updates": int(out[1]),
                 "restored_updates": int(out[2]),
-                "snapshot_version": int(out[3]), "n_params": int(out[4])}
+                "snapshot_version": int(out[3]), "n_params": int(out[4]),
+                "requests": int(out[5]),
+                "apply_ms_avg": (round(int(out[6]) / apply_cnt / 1e6, 6)
+                                 if apply_cnt else None),
+                "snapshot_age_ms": int(out[8]),
+                "dedup_clients": int(out[9])}
+
+    def ClientStats(self) -> dict:
+        """This worker's RPC counters: round trips issued, fast-retry
+        attempts, successful failover re-issues (worker.h client_stats)."""
+        out = np.zeros(3, np.int64)
+        self._lib.QueryClientStats(out.ctypes.data_as(_i64p),
+                                   ctypes.c_int(3))
+        self._check()
+        return {"rpcs": int(out[0]), "retries": int(out[1]),
+                "failovers": int(out[2])}
 
     # -- tensor init (reference InitTensor binding) -------------------------
     def InitTensor(self, node, sparse, length, width, init_type, init_a,

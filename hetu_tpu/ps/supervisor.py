@@ -19,6 +19,8 @@ exactly like the PR 1 worker-restart conventions.
 """
 from __future__ import annotations
 
+import json
+import os
 import socket
 import struct
 import sys
@@ -191,11 +193,18 @@ class PSSupervisor(threading.Thread):
         self.log = log or (lambda msg: print(f"# hetu ps-supervisor: {msg}",
                                              file=sys.stderr, flush=True))
         self.respawns = 0
+        self.lapses = 0                  # heartbeat lapses detected
         self.fatal: str | None = None    # set when the budget is exhausted
         self.events: list[tuple[float, str]] = []
         self._seen_alive = [False] * self.n_servers
         self._dead_polls = [0] * self.n_servers
         self._stop_evt = threading.Event()
+        # telemetry export: the supervisor lives in the (jax-free) launcher
+        # parent, so it appends its own JSONL next to the workers' files
+        # when a telemetry dir is configured (docs/OBSERVABILITY.md)
+        tel_dir = os.environ.get("HETU_TELEMETRY_DIR")
+        self._tel_path = (os.path.join(tel_dir, "ps_supervisor.jsonl")
+                          if tel_dir else None)
 
     # -- lifecycle ---------------------------------------------------------
     def stop(self) -> None:
@@ -252,11 +261,27 @@ class PSSupervisor(threading.Thread):
             if self._dead_polls[i] < self.grace_polls:
                 continue
             self._dead_polls[i] = 0
+            self.lapses += 1
             self._respawn(i)
+
+    def stats(self) -> dict:
+        """Health counters (telemetry surface): heartbeat lapses detected,
+        respawns spent/budgeted, and the fatal diagnostic if any."""
+        return {"lapses": self.lapses, "respawns": self.respawns,
+                "max_respawns": self.max_respawns, "fatal": self.fatal}
 
     def _note(self, msg: str) -> None:
         self.events.append((time.time(), msg))
         self.log(msg)
+        if self._tel_path:
+            try:
+                with open(self._tel_path, "a") as f:
+                    f.write(json.dumps(
+                        {"ts": round(time.time(), 3), "kind": "event",
+                         "name": "ps_supervisor", "message": msg,
+                         **self.stats()}) + "\n")
+            except OSError:
+                pass  # telemetry must not take supervision down
 
     def _respawn(self, i: int) -> None:
         if self.respawns >= self.max_respawns:

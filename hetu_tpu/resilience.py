@@ -74,6 +74,24 @@ def test_mode_enabled() -> bool:
     return env_truthy("HETU_TEST_MODE")
 
 
+def _tel_event(name: str, flush: bool = False, **fields) -> None:
+    """Typed resilience event into the telemetry JSONL (no-op when telemetry
+    is off). ``flush=True`` on the abort/exit paths — the record must be on
+    disk before ``os._exit``/``Preempted`` ends the process. Never raises:
+    observability must not take the recovery path down with it. Event names
+    map to metrics as documented in docs/OBSERVABILITY.md."""
+    from . import telemetry as _telemetry
+    tel = _telemetry.get()
+    if tel is None:
+        return
+    try:
+        tel.event(name, **fields)
+        if flush:
+            tel.flush()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Fault injection
 # ---------------------------------------------------------------------------
@@ -249,6 +267,8 @@ class Watchdog:
         try:
             self.dump_stacks(stream)
         finally:
+            _tel_event("watchdog_fire", flush=True, phase=phase, step=step,
+                       elapsed_s=round(elapsed, 1))
             try:
                 stream.flush()
             except Exception:  # noqa: BLE001 — never let flush mask the abort
@@ -582,6 +602,9 @@ class Supervisor:
         if self.watchdog is not None:
             self.watchdog.beat(phase=f"{sub.name}:post_step", step=step)
         action = self.anomaly.note(bool(finite))
+        if not finite:
+            _tel_event("anomaly", step=step, action=action,
+                       streak=self.anomaly.streak)
         if action == "rollback":
             self._rollback(ex)
         elif action == "ok" and self.ckptr is not None and self.ckpt_every \
@@ -597,12 +620,16 @@ class Supervisor:
             if self.ckptr is not None and self.last_saved_step != step \
                     and action != "rollback":
                 self.save(ex, step)
+                _tel_event("emergency_save", step=step)
             durable = ("no checkpointer attached — resume will cold-start"
                        if self.ckptr is None else
                        f"durable checkpoint: step {self.last_saved_step}")
             print(f"# hetu supervisor: preemption signal "
                   f"({self.preemption.signum}) at step {step}; {durable}; "
                   f"exiting", file=sys.stderr)
+            _tel_event("preempted", flush=True, step=step,
+                       signum=self.preemption.signum,
+                       durable_step=self.last_saved_step)
             raise Preempted(step)
 
     # -- checkpoint plumbing ------------------------------------------------
@@ -611,8 +638,14 @@ class Supervisor:
         ``step+1`` (the next step to run), so resume needs no arithmetic.
         force=True lets an emergency save land on a step the periodic
         cadence already wrote."""
+        t0 = time.perf_counter()
         self.ckptr.save_step(step, capture_executor_state(ex), force=True)
         self.last_saved_step = step
+        from . import telemetry as _telemetry
+        tel = _telemetry.get()
+        if tel is not None:
+            tel.metrics.histogram("hetu_checkpoint_save_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
 
     def _rollback(self, ex) -> None:
         if self.ckptr is None:
@@ -631,6 +664,8 @@ class Supervisor:
                 f"{self.anomaly.max_consecutive} consecutive non-finite "
                 "steps and no checkpoint exists yet to roll back to")
         load_executor_state(ex, state)
+        _tel_event("rollback", ckpt_step=int(ck_step),
+                   rollbacks=self.anomaly.rollbacks)
         print(f"# hetu supervisor: anomaly streak hit "
               f"{self.anomaly.max_consecutive}; rolled back to checkpoint "
               f"step {ck_step}", file=sys.stderr)
@@ -686,6 +721,8 @@ def supervise(loop_fn, ckptr=None, *, max_restarts: int = 3,
             restarts += 1
             if restarts > max_restarts:
                 raise
+            _tel_event("restart", flush=True, attempt=restarts,
+                       max_restarts=max_restarts, error=type(e).__name__)
             print(f"# hetu supervise: {type(e).__name__}: {e} — restart "
                   f"{restarts}/{max_restarts} after {delay:.1f}s backoff",
                   file=sys.stderr)

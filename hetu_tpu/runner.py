@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import multiprocessing
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -30,6 +31,33 @@ EXIT_WATCHDOG = 85
 
 _procs: list = []
 _shells: list = []
+_tel_dir: str = ""   # --telemetry-dir (run summary written at every exit)
+
+
+def _write_telemetry_summary(rc, preempted, num_workers):
+    """Aggregate the run's per-rank telemetry files into one manifest
+    (run_summary.json) in the shared directory — ranks already write
+    metrics-r<N>.jsonl / trace-r<N>.json side by side (WORKER_ID keys the
+    file names), so the launcher's job is the closing inventory + outcome."""
+    if not _tel_dir:
+        return
+    import glob
+    import json
+    summary = {
+        "workers": num_workers,
+        "exit_code": rc,
+        "preempted": bool(preempted),
+        "files": sorted(os.path.basename(p) for p in
+                        glob.glob(os.path.join(_tel_dir, "*"))
+                        if not p.endswith(".tmp")
+                        and os.path.basename(p) != "run_summary.json"),
+    }
+    try:
+        with open(os.path.join(_tel_dir, "run_summary.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+    except OSError as e:
+        print(f"# heturun: telemetry summary skipped ({e})",
+              file=sys.stderr)
 
 
 def _signal_handler(sig, frame):
@@ -55,7 +83,9 @@ def _signal_handler(sig, frame):
             p.kill()
     for p in _procs:
         p.terminate()
-    sys.exit(EXIT_PREEMPTED if sig == signal.SIGTERM else 130)
+    rc = EXIT_PREEMPTED if sig == signal.SIGTERM else 130
+    _write_telemetry_summary(rc, sig == signal.SIGTERM, len(_shells))
+    sys.exit(rc)
 
 
 def _get_available_port(addr: str) -> int:
@@ -117,6 +147,15 @@ def main(argv=None):
                              "failover deadline (DMLC_PS_FAILOVER_DEADLINE_"
                              "MS) so in-flight requests re-issue instead of "
                              "failing (see docs/FAULT_TOLERANCE.md)")
+    parser.add_argument("--telemetry-dir", default="",
+                        help="shared telemetry directory: workers run with "
+                             "HETU_TELEMETRY_DIR set (HETU_TELEMETRY "
+                             "defaults to 'metrics' unless already set), "
+                             "each rank writes metrics-r<N>.jsonl / "
+                             "trace-r<N>.json there, the PS supervisor "
+                             "appends ps_supervisor.jsonl, and the launcher "
+                             "writes run_summary.json on exit; inspect with "
+                             "bin/hetutop (docs/OBSERVABILITY.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -131,6 +170,14 @@ def main(argv=None):
           f"workers({num_workers}): {workers} }}")
 
     env = dict(os.environ)
+    if args.telemetry_dir:
+        global _tel_dir
+        _tel_dir = os.path.abspath(args.telemetry_dir)
+        os.makedirs(_tel_dir, exist_ok=True)
+        env["HETU_TELEMETRY_DIR"] = _tel_dir
+        env.setdefault("HETU_TELEMETRY", "metrics")
+        # the PS supervisor runs in THIS process and reads the env directly
+        os.environ["HETU_TELEMETRY_DIR"] = _tel_dir
     ps_ha = enable_ps and args.ps_max_respawns > 0 and len(hosts) == 1
     if enable_ps and args.ps_max_respawns > 0 and len(hosts) > 1:
         # don't let an operator believe HA is armed when it is not: the
@@ -260,8 +307,9 @@ def main(argv=None):
         if ps_snap_created:
             from hetu_tpu.ps.supervisor import cleanup_snapshot_root
             cleanup_snapshot_root(ps_snap_created)
-        sys.exit(rc_final if rc_final else
-                 (EXIT_PREEMPTED if preempted else 0))
+        rc = rc_final if rc_final else (EXIT_PREEMPTED if preempted else 0)
+        _write_telemetry_summary(rc, preempted, num_workers)
+        sys.exit(rc)
     else:
         # multi-machine: ssh remote roles; workers get jax.distributed
         # coordinator env (reference: paramiko remote PS + mpirun -host)
@@ -269,8 +317,14 @@ def main(argv=None):
         if args.identify:
             ssh_opts += ["-i", args.identify]
         coord = f"{chief_address}:{_get_available_port(chief_address)}"
+        # forward the PS config AND the telemetry toggles: --telemetry-dir
+        # promises every rank writes to the (shared) dir, so the ssh'd
+        # ranks need the env too, not just the chief-host children.
+        # Values are shell-quoted — the telemetry dir is a user-supplied
+        # path that may carry spaces/metacharacters into the remote line
         env_exports = " ".join(
-            f"{k}={v}" for k, v in env.items() if k.startswith("DMLC_"))
+            f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+            if k.startswith("DMLC_") or k.startswith("HETU_TELEMETRY"))
         sid = 0
         if enable_ps:
             _procs.append(ctx.Process(target=_sched_entry, args=(env,)))
@@ -308,6 +362,9 @@ def main(argv=None):
             rc |= p.wait()
         for p in _procs:
             p.terminate()
+        # multi-host: only this host's files are visible unless the dir is
+        # on a shared filesystem — the summary still inventories what's here
+        _write_telemetry_summary(rc, False, num_workers)
         sys.exit(rc)
 
 

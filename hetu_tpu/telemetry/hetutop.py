@@ -1,0 +1,376 @@
+"""``hetutop`` — live terminal dashboard over a telemetry directory, plus the
+``--check`` schema validator CI uses (exit 0 valid / 1 invalid, mirroring the
+``hetulint --json`` pattern).
+
+Reads the per-rank ``metrics-r<N>.jsonl`` files a run writes (see
+docs/OBSERVABILITY.md for the record schemas) and renders throughput, step-
+time percentiles, MFU against the assumed peak (docs/ROOFLINE.md), PS-tier
+health and cache hit rate. Stdlib-only and jax-free: it runs on a login node
+against a shared filesystem while the job trains.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+# MFU denominator when no peak rides in the records: same default as
+# bench.py / docs/ROOFLINE.md (assumption, not a reading)
+DEFAULT_PEAK_TFLOPS = float(os.environ.get("HETU_PEAK_TFLOPS", "197"))
+
+# metrics snapshots ride only every Nth step record (plus every "final"
+# record) — the per-step cost of percentile math is paid on a cadence
+STEP_REQUIRED = ("sub", "step", "step_ms")
+WINDOW = 200   # dashboard statistics run over the last N step records
+
+
+def metrics_files(dir_path: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(dir_path, "metrics-r*.jsonl")))
+
+
+def load_records(path: str, errors: Optional[list] = None) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                if errors is not None:
+                    errors.append(f"{path}:{i}: invalid JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                if errors is not None:
+                    errors.append(f"{path}:{i}: record is not an object")
+                continue
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# --check: schema validation
+# ---------------------------------------------------------------------------
+
+def check_dir(dir_path: str, out=sys.stdout) -> int:
+    """Validate every record in the directory; print a summary of what a
+    dashboard would read. Returns a process exit code (0 ok, 1 invalid)."""
+    files = metrics_files(dir_path)
+    if not files:
+        print(f"hetutop --check: no metrics-r*.jsonl under {dir_path}",
+              file=out)
+        return 1
+    errors: list[str] = []
+    n_steps = n_events = n_ps = 0
+    step_ms: list[float] = []
+    last_metrics: Optional[dict] = None   # None = no snapshot seen at all
+    ps_last: dict = {}
+    for path in files:
+        for rec in load_records(path, errors):
+            kind = rec.get("kind")
+            if kind == "step":
+                missing = [k for k in STEP_REQUIRED if k not in rec]
+                if missing:
+                    errors.append(f"{path}: step record missing {missing}")
+                    continue
+                if "metrics" in rec and not isinstance(rec["metrics"], dict):
+                    errors.append(f"{path}: step 'metrics' is not an object")
+                    continue
+                n_steps += 1
+                step_ms.append(float(rec["step_ms"]))
+                if isinstance(rec.get("metrics"), dict):
+                    last_metrics = rec["metrics"]
+            elif kind == "final":
+                if not isinstance(rec.get("metrics"), dict):
+                    errors.append(f"{path}: final record missing 'metrics'")
+                    continue
+                last_metrics = rec["metrics"]
+            elif kind == "event":
+                if "name" not in rec:
+                    errors.append(f"{path}: event record missing 'name'")
+                    continue
+                n_events += 1
+            elif kind == "ps_server":
+                if "server" not in rec:
+                    errors.append(f"{path}: ps_server record missing "
+                                  "'server'")
+                    continue
+                n_ps += 1
+                ps_last[rec["server"]] = rec
+            elif kind is None:
+                errors.append(f"{path}: record missing 'kind'")
+    for msg in errors[:20]:
+        print(f"hetutop --check: {msg}", file=out)
+    if len(errors) > 20:
+        print(f"hetutop --check: ... and {len(errors) - 20} more", file=out)
+    if n_steps == 0:
+        print("hetutop --check: no valid step records", file=out)
+        return 1
+    if last_metrics is None:
+        print("hetutop --check: no metrics snapshot (step-with-metrics or "
+              "final record) found", file=out)
+        return 1
+    # the summary below is the CI-readable proof of what the dashboard
+    # reads: step time, recompile count, PS latency + snapshot age
+    rec_count = last_metrics.get("hetu_recompiles_total")
+    print(f"hetutop --check: {len(files)} rank file(s), {n_steps} step, "
+          f"{n_events} event, {n_ps} ps_server record(s); "
+          f"step_ms p50={_pctl(step_ms, 50):.3f} "
+          f"recompiles={rec_count if rec_count is not None else 'n/a'}",
+          file=out)
+    for sid in sorted(ps_last):
+        r = ps_last[sid]
+        print(f"hetutop --check: ps server {sid}: "
+              f"updates={r.get('updates')} "
+              f"snapshot_age_ms={r.get('snapshot_age_ms')} "
+              f"rpc p50={last_metrics.get('hetu_ps_pull_ms_p50', 'n/a')}",
+              file=out)
+    return 1 if errors else 0
+
+
+def _pctl(vals: list[float], p: float) -> float:
+    if not vals:
+        return float("nan")
+    s = sorted(vals)
+    k = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+# ---------------------------------------------------------------------------
+# dashboard
+# ---------------------------------------------------------------------------
+
+def gather(dir_path: str) -> dict:
+    """One dashboard frame's worth of state from the directory (full
+    parse — one-shot use: ``--once``, tests). The live loop uses
+    :class:`Follower`, which tails incrementally."""
+    return _aggregate({p: load_records(p) for p in metrics_files(dir_path)})
+
+
+class Follower:
+    """Incremental reader for live mode: keeps a byte offset and a bounded
+    record buffer per file, so each frame parses only appended lines —
+    frame cost stays O(new data) instead of growing with run length."""
+
+    # per-file history: enough for the WINDOW step stats plus the
+    # interleaved snapshot/event/ps rows that ride between step records
+    BUFFER = 4 * WINDOW
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        self._offsets: dict = {}
+        self._recs: dict = {}
+        # once-per-run records (run_info) and slow-cadence rows (ps_server)
+        # must survive eviction from the bounded buffers
+        self._sticky_run_info: dict = {}
+        self._sticky_ps: dict = {}
+
+    def _poll_file(self, path: str):
+        buf = self._recs.get(path)
+        if buf is None:
+            buf = self._recs[path] = collections.deque(
+                maxlen=self.BUFFER)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return buf
+        off = self._offsets.get(path, 0)
+        if size < off:            # truncated/rotated: start over
+            off = 0
+            buf.clear()
+        if size == off:
+            return buf
+        with open(path, "rb") as f:
+            f.seek(off)
+            chunk = f.read()
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:           # partial tail line: retry next frame
+            return buf
+        self._offsets[path] = off + last_nl + 1
+        for raw in chunk[:last_nl].split(b"\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue          # torn/garbage line: skip, stay live
+            if isinstance(rec, dict):
+                buf.append(rec)
+        return buf
+
+    def poll(self) -> dict:
+        state = _aggregate({p: self._poll_file(p)
+                            for p in metrics_files(self.dir)})
+        self._sticky_run_info.update(state["run_info"])
+        self._sticky_ps.update(state["ps"])
+        state["run_info"] = dict(self._sticky_run_info)
+        state["ps"] = dict(self._sticky_ps)
+        return state
+
+
+def _aggregate(recs_by_file: dict) -> dict:
+    state: dict = {"ranks": {}, "events": [], "ps": {}, "run_info": {}}
+    for path, recs in recs_by_file.items():
+        steps = [r for r in recs if r.get("kind") == "step"
+                 and all(k in r for k in STEP_REQUIRED)]
+        m = {}
+        snaps = []   # (ts, metrics) of every snapshot-bearing record
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "event":
+                state["events"].append(r)
+            elif kind == "ps_server":
+                state["ps"][r.get("server")] = r
+            elif kind == "run_info":
+                state["run_info"].update(r)
+            if kind in ("step", "final") and isinstance(
+                    r.get("metrics"), dict):
+                m = r["metrics"]   # latest snapshot wins
+                if "ts" in r:
+                    snaps.append((r["ts"], r["metrics"]))
+        if not steps:
+            continue
+        rank = steps[-1].get("rank", 0)
+        window = steps[-WINDOW:]
+        t = [r["step_ms"] for r in window]
+        span_s = (window[-1]["ts"] - window[0]["ts"]) if len(window) > 1 \
+            else 0.0
+        ex_rate = None
+        if len(snaps) > 1 and snaps[-1][0] > snaps[0][0]:
+            ex_rate = ((snaps[-1][1].get("hetu_examples_total", 0)
+                        - snaps[0][1].get("hetu_examples_total", 0))
+                       / (snaps[-1][0] - snaps[0][0]))
+        state["ranks"][rank] = {
+            "last_step": window[-1]["step"],
+            "sub": window[-1]["sub"],
+            "steps_per_s": (len(window) - 1) / span_s if span_s > 0 else None,
+            "examples_per_s": ex_rate,
+            "p50": _pctl(t, 50), "p90": _pctl(t, 90), "p99": _pctl(t, 99),
+            "max": max(t),
+            "metrics": m,
+            "last_ts": window[-1]["ts"],
+        }
+    state["events"] = state["events"][-5:]
+    return state
+
+
+def _fmt(v, spec=".1f", na="  n/a") -> str:
+    return na if v is None else format(v, spec)
+
+
+def _metric_children(m: dict, base: str, suffix: str):
+    """Snapshot entries for one metric family: the unlabeled parent
+    (``<base><suffix>``) and/or its labeled children
+    (``base{k="v"}suffix`` -> child tag ``k=v``)."""
+    out = []
+    exact = base + suffix
+    for k, v in m.items():
+        if k == exact:
+            out.append(("", v))
+        elif k.startswith(base + "{") and k.endswith(suffix):
+            labels = k[len(base) + 1:len(k) - len(suffix) - 1]
+            out.append((labels.replace('"', ""), v))
+    return sorted(out)
+
+
+def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
+                 ) -> str:
+    lines = []
+    info = state["run_info"]
+    dev = info.get("device_kind", "?")
+    peak = float(info.get("peak_tflops_assumed", peak_tflops))
+    lines.append(f"hetutop — device {dev}, assumed peak {peak:g} TFLOP/s "
+                 f"(see docs/ROOFLINE.md)")
+    lines.append("rank  sub        step   steps/s    ex/s   p50ms   p90ms"
+                 "   p99ms   maxms    MFU%  recompiles  anomalies")
+    for rank in sorted(state["ranks"]):
+        r = state["ranks"][rank]
+        m = r["metrics"]
+        flops = m.get("hetu_flops_per_step")
+        mfu = None
+        if flops and r["p50"]:
+            mfu = 100.0 * flops / (r["p50"] / 1e3) / (peak * 1e12)
+        lines.append(
+            f"{rank:>4}  {r['sub'][:9]:<9}{r['last_step']:>7}"
+            f"{_fmt(r['steps_per_s'], '8.2f'):>9}"
+            f"{_fmt(r['examples_per_s'], '8.0f'):>8}"
+            f"{r['p50']:>8.2f}{r['p90']:>8.2f}{r['p99']:>8.2f}"
+            f"{r['max']:>8.2f}"
+            f"{_fmt(mfu, '7.1f'):>8}"
+            f"{m.get('hetu_recompiles_total', 0):>11g}"
+            f"{m.get('hetu_anomaly_trips_total', 0):>10g}")
+        extras = []
+        for base, suffix, label in (
+                ("hetu_dataloader_wait_ms", "_p50", "dl wait p50"),
+                ("hetu_ps_pull_ms", "_p50", "ps pull p50"),
+                ("hetu_ps_push_ms", "_p50", "ps push p50"),
+                ("hetu_cache_hit_rate", "", "cache hit")):
+            unit = "" if base.endswith("rate") else "ms"
+            for child, v in _metric_children(m, base, suffix):
+                tag = f"[{child}]" if child else ""
+                extras.append(f"{label}{tag} {v:.3g}{unit}")
+        if extras:
+            lines.append("      " + "  |  ".join(extras))
+    if state["ps"]:
+        lines.append("PS servers:")
+        for sid in sorted(state["ps"]):
+            r = state["ps"][sid]
+            lines.append(
+                f"  s{sid}: updates={r.get('updates')} "
+                f"reqs={r.get('requests')} "
+                f"apply_avg_ms={_fmt(r.get('apply_ms_avg'), '.3f')} "
+                f"snap v{r.get('snapshot_version')} "
+                f"age={_fmt(r.get('snapshot_age_ms'), '.0f')}ms "
+                f"dedup_clients={r.get('dedup_clients')}")
+    if state["events"]:
+        lines.append("recent events:")
+        for e in state["events"]:
+            fields = {k: v for k, v in e.items()
+                      if k not in ("kind", "name", "ts", "rank", "pid")}
+            lines.append(f"  [{time.strftime('%H:%M:%S', time.localtime(e.get('ts', 0)))}] "
+                         f"r{e.get('rank', '?')} {e.get('name')} {fields}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetutop",
+        description="live dashboard / schema check over a hetu_tpu "
+                    "telemetry directory")
+    ap.add_argument("dir", help="telemetry directory (HETU_TELEMETRY_DIR)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the JSONL schema and exit 0/1 (CI mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds in live mode (default 2)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_dir(args.dir)
+    if not metrics_files(args.dir):
+        print(f"hetutop: no metrics-r*.jsonl under {args.dir} (yet)",
+              file=sys.stderr)
+    if args.once:
+        print(render_frame(gather(args.dir)))
+        return 0
+    follower = Follower(args.dir)   # incremental tail: O(new data)/frame
+    try:
+        while True:
+            frame = render_frame(follower.poll())
+            # ANSI clear + home; fall back gracefully on dumb terminals
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

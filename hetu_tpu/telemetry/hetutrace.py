@@ -1,0 +1,149 @@
+"""``hetutrace`` — merge per-rank Chrome-trace files into ONE timeline with
+rank lanes, plus the ``--check`` validator CI uses (exit 0/1).
+
+Each rank's :class:`~hetu_tpu.telemetry.tracing.Tracer` writes
+``trace-r<N>.json`` with ``pid = rank`` and a unix clock anchor in
+``otherData``; the merge re-anchors every rank onto the earliest anchor so
+spans line up in absolute time (bounded by host clock skew), keeps the
+process-name metadata ("rank N" lanes in Perfetto), and emits one
+``trace.json`` loadable by ``chrome://tracing`` or https://ui.perfetto.dev.
+Stdlib-only and jax-free.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _load_doc(path: str):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):            # bare-array Chrome trace form
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError(f"{path}: not a Chrome trace "
+                         "(no traceEvents array)")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# --check
+# ---------------------------------------------------------------------------
+
+def check_file(path: str, out=sys.stdout) -> int:
+    """Validate one trace file; returns a process exit code (0 ok, 1 bad)."""
+    try:
+        doc = _load_doc(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"hetutrace --check: {e}", file=out)
+        return 1
+    errors = []
+    n_spans = 0
+    names = set()
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            errors.append(f"event[{i}]: not an object with 'ph'")
+            continue
+        if ev["ph"] == "X":
+            missing = [k for k in ("name", "ts", "dur", "pid", "tid")
+                       if k not in ev]
+            if missing:
+                errors.append(f"event[{i}] ({ev.get('name')!r}): "
+                              f"missing {missing}")
+                continue
+            if ev["dur"] < 0:
+                errors.append(f"event[{i}] ({ev['name']!r}): negative dur")
+                continue
+            n_spans += 1
+            names.add(ev["name"])
+    for msg in errors[:20]:
+        print(f"hetutrace --check: {path}: {msg}", file=out)
+    if len(errors) > 20:
+        print(f"hetutrace --check: ... and {len(errors) - 20} more",
+              file=out)
+    if n_spans == 0:
+        print(f"hetutrace --check: {path}: no complete ('X') spans",
+              file=out)
+        return 1
+    print(f"hetutrace --check: {path}: {n_spans} span(s), "
+          f"{len(names)} distinct name(s): "
+          f"{', '.join(sorted(names)[:10])}", file=out)
+    return 1 if errors else 0
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def merge(inputs: list[str], out_path: str) -> str:
+    """Merge trace files (or expand directories) into one timeline."""
+    paths: list[str] = []
+    for p in inputs:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p, "trace-r*.json"))))
+        else:
+            paths.append(p)
+    if not paths:
+        raise FileNotFoundError(f"no trace files in {inputs}")
+    docs = [(p, _load_doc(p)) for p in paths]
+    anchors = [d.get("otherData", {}).get("clock_anchor_unix_s")
+               for _, d in docs]
+    base: Optional[float] = min((a for a in anchors if a is not None),
+                                default=None)
+    events: list[dict] = []
+    for idx, ((path, doc), anchor) in enumerate(zip(docs, anchors)):
+        rank = doc.get("otherData", {}).get("rank", idx)
+        # re-anchor this rank's monotonic clock onto the earliest rank's
+        shift_us = ((anchor - base) * 1e6
+                    if anchor is not None and base is not None else 0.0)
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift_us, 1)
+            events.append(ev)
+    merged = {"displayTimeUnit": "ms",
+              "otherData": {"merged_from": [p for p, _ in docs]},
+              "traceEvents": events}
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, separators=(",", ":"))
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetutrace",
+        description="merge per-rank hetu_tpu trace files into one "
+                    "Perfetto-loadable timeline, or --check one file")
+    ap.add_argument("paths", nargs="+",
+                    help="trace file(s) or telemetry director(ies)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate Chrome-trace schema and exit 0/1 "
+                         "(single file; CI mode)")
+    ap.add_argument("-o", "--out", default="trace.json",
+                    help="merged output path (default trace.json)")
+    args = ap.parse_args(argv)
+    if args.check:
+        if len(args.paths) != 1:
+            print("hetutrace --check takes exactly one file",
+                  file=sys.stderr)
+            return 2
+        return check_file(args.paths[0])
+    try:
+        out = merge(args.paths, args.out)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"hetutrace: {e}", file=sys.stderr)
+        return 1
+    print(f"hetutrace: wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Metrics registry: counters, gauges, histograms with a per-step JSONL sink
+and a Prometheus-textfile exporter.
+
+Stdlib-only by design — the registry is imported by components that must stay
+jax-free (the heturun launcher parent, the PS supervisor, dataloaders running
+in light processes). Thread-safe: PS push/pull streams observe latencies from
+their own threads while the step loop snapshots.
+
+Export surfaces:
+
+- ``snapshot()`` — flat ``{name: value}`` dict (histograms contribute
+  ``name_count/_sum/_p50/_p99``) embedded in each step's JSONL record.
+- ``to_prometheus()`` / ``write_prometheus(path)`` — the Prometheus
+  text exposition format (textfile-collector style: counters, gauges, and
+  cumulative-bucket histograms), written atomically via tmp+rename so a
+  scraping node-exporter never reads a torn file.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+# Default histogram buckets: log-spaced milliseconds covering everything from
+# a sub-ms cache hit to a multi-minute compile (upper bound +Inf implied).
+DEFAULT_BUCKETS_MS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                      1000, 2500, 5000, 10000, 30000, 60000, 120000)
+
+# recent-sample window per histogram: percentile math runs over this window
+# (exact over recent behavior — what a dashboard wants), while count/sum/
+# buckets stay cumulative (what Prometheus wants)
+_WINDOW = 512
+
+
+def _esc_label(v) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline) — an
+    unescaped quote in a user-chosen loader/table name would invalidate
+    the whole textfile."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the only mutator.
+
+    The lock makes cross-thread ``inc`` lossless (float ``+=`` is a
+    read-modify-write; PS stream threads and the step loop share e.g.
+    ``hetu_events_total``). Uncontended acquire is ~100 ns — noise next
+    to the JSONL write it accompanies."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    prom_type = "counter"
+
+    def __init__(self, name: str, labels: Optional[dict] = None):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def prom_lines(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {self.value:g}"]
+
+
+class Gauge:
+    """Last-value gauge."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+    prom_type = "gauge"
+
+    def __init__(self, name: str, labels: Optional[dict] = None):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        self.value = float(v)   # single store: atomic enough for a gauge
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def prom_lines(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {self.value:g}"]
+
+
+class Histogram:
+    """Cumulative-bucket histogram plus an exact recent-sample window.
+
+    Buckets/count/sum are cumulative since process start (the Prometheus
+    contract); ``percentile`` answers over the last ``_WINDOW`` samples —
+    a live dashboard wants "p99 lately", not "p99 since boot".
+    """
+
+    __slots__ = ("name", "labels", "buckets", "bucket_counts", "count",
+                 "sum", "min", "max", "_recent", "_lock")
+    prom_type = "histogram"
+
+    def __init__(self, name: str, labels: Optional[dict] = None,
+                 buckets=DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.labels = dict(labels) if labels else None
+        self.buckets = tuple(sorted(buckets))
+        self.bucket_counts = [0] * (len(self.buckets) + 1)  # +Inf last
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._recent = collections.deque(maxlen=_WINDOW)
+        # observe vs percentile/export race: sorted() over a deque being
+        # appended to from a PS stream thread raises "deque mutated during
+        # iteration" — every mutation and every window read locks
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.bucket_counts[idx] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            self._recent.append(v)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] over the recent window; None when empty."""
+        with self._lock:
+            if not self._recent:
+                return None
+            s = sorted(self._recent)
+        k = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[k]
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def prom_lines(self) -> list[str]:
+        lab = self.labels or {}
+        with self._lock:
+            counts = list(self.bucket_counts)
+            total, total_sum = self.count, self.sum
+        out = []
+        cum = 0
+        for bound, n in zip(self.buckets, counts):
+            cum += n
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels({**lab, 'le': f'{bound:g}'})} {cum}")
+        out.append(f"{self.name}_bucket"
+                   f"{_fmt_labels({**lab, 'le': '+Inf'})} {total}")
+        out.append(f"{self.name}_sum{_fmt_labels(self.labels)} "
+                   f"{total_sum:g}")
+        out.append(f"{self.name}_count{_fmt_labels(self.labels)} "
+                   f"{total}")
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide named metric store. ``counter/gauge/histogram`` create on
+    first use and return the live object; callers may also cache the handle
+    (cheaper on hot paths — one dict lookup saved per observation)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}   # (name, labels-key) -> metric
+
+    def _get(self, cls, name: str, labels: Optional[dict], **kw):
+        key = (name, tuple(sorted(labels.items())) if labels else None)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str, labels: Optional[dict] = None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, labels: Optional[dict] = None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, labels: Optional[dict] = None,
+                  buckets=DEFAULT_BUCKETS_MS) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def all_metrics(self) -> list:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- export ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Flat scalar view for the per-step JSONL record."""
+        out: dict = {}
+        for m in self.all_metrics():
+            suffix = _fmt_labels(m.labels)
+            key = m.name + suffix
+            if isinstance(m, Histogram):
+                out[key + "_count"] = m.count
+                out[key + "_sum"] = round(m.sum, 6)
+                for p in (50, 99):
+                    v = m.percentile(p)
+                    if v is not None:
+                        out[f"{key}_p{p}"] = round(v, 6)
+            else:
+                out[key] = m.value
+        return out
+
+    def to_prometheus(self) -> str:
+        # one "# TYPE" line per metric FAMILY with its samples contiguous:
+        # labeled children of the same name (hetu_events_total{event=...})
+        # share it — a second TYPE line for a name, or interleaved
+        # families, make node_exporter reject the whole textfile
+        by_name: dict = {}
+        for m in self.all_metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines: list[str] = []
+        for name, members in by_name.items():
+            lines.append(f"# TYPE {name} {members[0].prom_type}")
+            for m in members:
+                lines.extend(m.prom_lines())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def write_prometheus(self, path: str) -> str:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+        return path
+
+
+class JsonlSink:
+    """Append-only JSONL writer with periodic flush.
+
+    Every record gains ``ts`` (unix seconds) and the writer's identity
+    fields. Flushes at most every ``flush_s`` seconds on write, plus on
+    ``close`` — crash-durability for the resilience events comes from the
+    explicit ``flush()`` those call sites do before aborting."""
+
+    def __init__(self, path: str, base_fields: Optional[dict] = None,
+                 flush_s: float = 1.0):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self._f = open(path, "a")
+        self._base = dict(base_fields or {})
+        # identity fields serialized once: the per-step fast path
+        # (write_fields) splices this fragment instead of re-dumping the
+        # same rank/pid dict thousands of times per second
+        self._base_json = "".join(
+            json.dumps({k: v}, separators=(",", ":"),
+                       default=_json_default)[1:-1] + ","
+            for k, v in self._base.items())
+        self._flush_s = float(flush_s)
+        self._last_flush = time.monotonic()
+        self._lock = threading.Lock()
+
+    def write(self, record: dict) -> None:
+        rec = {"ts": round(time.time(), 3), **self._base, **record}
+        line = json.dumps(rec, separators=(",", ":"),
+                          default=_json_default) + "\n"
+        self._write_line(line)
+
+    def write_fields(self, fields_json: str) -> None:
+        """Hot-path writer: ``fields_json`` is a pre-serialized JSON object
+        body (no braces), e.g. ``'"kind":"step","step":7'``. The caller
+        guarantees validity; ``ts`` + identity fields are spliced in here."""
+        self._write_line(
+            f'{{"ts":{time.time():.3f},{self._base_json}{fields_json}}}\n')
+
+    def _write_line(self, line: str) -> None:
+        with self._lock:
+            if self._f.closed:
+                return  # late writer (atexit ordering); drop, don't raise
+            self._f.write(line)
+            now = time.monotonic()
+            if now - self._last_flush >= self._flush_s:
+                self._f.flush()
+                self._last_flush = now
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+def _json_default(o):
+    """Numpy scalars (step counters, metric values) without importing numpy."""
+    for attr in ("item",):
+        f = getattr(o, attr, None)
+        if callable(f):
+            return f()
+    return str(o)

@@ -1,0 +1,238 @@
+"""Structured step/op tracing: Chrome-trace-format JSON (Perfetto-loadable).
+
+``Tracer`` records complete ("ph": "X") events with microsecond timestamps,
+one lane per thread (the PS push/pull streams show up as their own rows under
+the worker's process lane). Per-rank files are merged into one timeline with
+rank lanes by ``bin/hetutrace``.
+
+Deep dives escalate in two env-gated stages, both owned by
+:class:`XlaTraceWindow`:
+
+- ``jax.profiler.StepTraceAnnotation`` — when the step runs inside an active
+  jax profiler trace, each step gets its own named region in the device
+  timeline (no-op context otherwise; the annotation itself is cheap).
+- ``HETU_XLA_TRACE=dir[:start_step[:n_steps]]`` — a bounded
+  ``jax.profiler.start_trace``/``stop_trace`` window around the configured
+  steps, so a production job can capture an XLA-level trace of steps
+  1000..1009 without tracing the whole run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# trace clock: perf_counter in µs, plus the unix anchor recorded in metadata
+# (perf_counter is monotonic across threads of one process; cross-rank skew
+# is bounded by host clock skew and only affects lane alignment, not spans)
+_T0_PERF = time.perf_counter()
+_T0_UNIX = time.time()
+
+# jax.profiler.StepTraceAnnotation, resolved lazily on first use
+# (None = unresolved, False = jax unavailable — stay stdlib-importable)
+_STEP_ANNOT = None
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _T0_PERF) * 1e6
+
+
+class _SpanCtx:
+    """Context manager for one span; re-entrant use creates nested events
+    (Perfetto nests same-tid "X" events by containment)."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tracer._emit(self.name, self.cat, self._t0,
+                           _now_us() - self._t0, self.args)
+
+
+class Tracer:
+    """Chrome-trace event buffer for ONE process (= one rank).
+
+    Events buffer in memory and are written as a complete JSON object on
+    ``flush()`` (rewrite-in-place via tmp+rename: the file on disk is always
+    valid JSON, even mid-run). A step loop flushes every ``flush_every``
+    spans; resilience abort paths flush explicitly before ``os._exit``.
+    """
+
+    def __init__(self, path: str, rank: int = 0, flush_every: int = 2048,
+                 max_events: Optional[int] = None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.rank = int(rank)
+        self.flush_every = int(flush_every)
+        # the file is rewritten whole on each flush (that is what keeps it
+        # valid JSON at every instant), so the buffer must be bounded —
+        # past the cap new events are counted as dropped, not appended;
+        # trace mode is for bounded diagnosis windows, not week-long runs
+        self.max_events = (int(os.environ.get("HETU_TRACE_MAX_EVENTS",
+                                              "200000"))
+                           if max_events is None else int(max_events))
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()   # serializes tmp+rename
+        self._events: list[dict] = []
+        self._thread_named: set[int] = set()
+        self._metadata = [
+            {"ph": "M", "pid": self.rank, "name": "process_name",
+             "args": {"name": f"rank {self.rank}"}},
+        ]
+        self._since_flush = 0
+
+    def span(self, name: str, cat: str = "step",
+             args: Optional[dict] = None) -> _SpanCtx:
+        return _SpanCtx(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "event",
+                args: Optional[dict] = None) -> None:
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
+              "ts": round(_now_us(), 1), "pid": self.rank,
+              "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def complete(self, name: str, t0_perf: float, t1_perf: float,
+                 cat: str = "step", args: Optional[dict] = None) -> None:
+        """Emit a finished span from two ``time.perf_counter()`` readings —
+        the executor's hot path records bare timestamps and emits post-hoc,
+        so the traced and untraced step bodies stay structurally identical
+        (no nested with-blocks to keep in sync)."""
+        self._emit(name, cat, (t0_perf - _T0_PERF) * 1e6,
+                   (t1_perf - t0_perf) * 1e6, args)
+
+    def _tid(self) -> int:
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._thread_named:
+            self._thread_named.add(tid)
+            self._metadata.append(
+                {"ph": "M", "pid": self.rank, "tid": tid,
+                 "name": "thread_name", "args": {"name": t.name}})
+        return tid
+
+    def _emit(self, name: str, cat: str, ts_us: float, dur_us: float,
+              args: Optional[dict]) -> None:
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": round(ts_us, 1), "dur": round(dur_us, 1),
+              "pid": self.rank, "tid": self._tid()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+            self._since_flush += 1
+            need_flush = self._since_flush >= self.flush_every
+        if need_flush:
+            self.flush()
+
+    def flush(self) -> str:
+        """Write the complete trace file (valid JSON at every point).
+
+        The event list is COPIED under the buffer lock (concat) — the dump
+        below must not iterate a list a stream thread is appending to —
+        and the tmp+rename pair is serialized by its own lock: two
+        concurrent flushes (step loop + PS stream crossing ``flush_every``,
+        or an abort-path flush) each publish a complete file, last one
+        wins, instead of interleaving writes into one shared .tmp."""
+        with self._lock:
+            other = {"clock_anchor_unix_s": round(_T0_UNIX, 3),
+                     "rank": self.rank}
+            if self.dropped:
+                other["dropped_events"] = self.dropped
+            events = self._metadata + self._events
+            self._since_flush = 0
+        doc = {
+            "displayTimeUnit": "ms",
+            "otherData": other,
+            "traceEvents": events,
+        }
+        with self._flush_lock:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        return self.path
+
+
+class XlaTraceWindow:
+    """Bounded jax.profiler trace window + per-step annotations.
+
+    ``spec`` is ``dir[:start_step[:n_steps]]`` (defaults: start 0, 10 steps).
+    ``step_annotation(step)`` returns a context manager for the step body:
+    a ``jax.profiler.StepTraceAnnotation`` while jax is importable, else a
+    no-op. ``on_step(step)`` opens/closes the profiler window; call it at
+    every step boundary — two integer compares when outside the window.
+    """
+
+    def __init__(self, spec: str):
+        parts = spec.split(":")
+        self.dir = parts[0]
+        self.start_step = int(parts[1]) if len(parts) > 1 and parts[1] else 0
+        self.n_steps = int(parts[2]) if len(parts) > 2 and parts[2] else 10
+        self._active = False
+        self._done = False
+
+    @classmethod
+    def from_env(cls) -> Optional["XlaTraceWindow"]:
+        spec = os.environ.get("HETU_XLA_TRACE")
+        return cls(spec) if spec else None
+
+    def on_step(self, step: int) -> None:
+        if self._done:
+            return
+        end = self.start_step + self.n_steps
+        if not self._active:
+            if step >= end:
+                # resumed past the window (auto-resume restores the step
+                # counter): never open — a late start would capture the
+                # wrong steps, not the configured ones
+                self._done = True
+            elif step >= self.start_step:
+                import jax.profiler
+                jax.profiler.start_trace(self.dir)
+                self._active = True
+        elif step >= end:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            import jax.profiler
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    @staticmethod
+    def step_annotation(step: int):
+        global _STEP_ANNOT
+        if _STEP_ANNOT is None:   # resolve once, not per step
+            try:
+                import jax.profiler
+                _STEP_ANNOT = jax.profiler.StepTraceAnnotation
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                _STEP_ANNOT = False
+        if _STEP_ANNOT:
+            return _STEP_ANNOT("hetu_step", step_num=int(step))
+        import contextlib
+        return contextlib.nullcontext()

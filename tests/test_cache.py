@@ -106,6 +106,16 @@ def _bounded_staleness_skips_fresh_rows(client, rank, tmpdir):
     assert perf[0]["num_transfered"] == 8, perf[0]
     assert perf[1]["num_transfered"] == 0, perf[1]
     assert table.overall_miss_rate(include_cold_start=True) >= 0
+    # telemetry_summary reads the native O(1) rollup — it must agree with
+    # aggregating the full per-batch log (the path it replaced)
+    s = table.telemetry_summary()
+    pull = [x for x in perf if x["type"] == "Pull"]
+    assert s["batches"] == len(perf)
+    assert s["evictions"] == sum(x["num_evict"] for x in perf)
+    assert s["miss_rate"] == (sum(x["num_miss"] for x in pull)
+                              / sum(x["num_unique"] for x in pull))
+    assert s["data_rate"] == (sum(x["num_transfered"] for x in perf)
+                              / sum(x["num_all"] for x in perf))
 
 
 def _push_pull_combined(client, rank, tmpdir):
