@@ -19,8 +19,10 @@ vs_baseline: the reference publishes no numbers (BASELINE.md); recorded
 baseline = our round-1 f32 measurement (4929.1 samples/s on v5e-1).
 """
 import contextlib
+import glob
 import json
 import os
+import re
 import signal
 import sys
 import tempfile
@@ -41,16 +43,32 @@ def _mfu(flops_per_step, step_s):
     return flops_per_step / step_s / (PEAK_TFLOPS * 1e12)
 
 
+_PROFILER = None
+
+
+def _profiler():
+    """``hetu_tpu/telemetry/profiler.py`` loaded by FILE PATH (shared with
+    bin/hetuprof): the driver parent must stay jax-free and importing the
+    ``hetu_tpu`` package pulls jax. The module is stdlib-only by
+    contract."""
+    global _PROFILER
+    if _PROFILER is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "hetu_tpu", "telemetry", "profiler.py")
+        spec = importlib.util.spec_from_file_location("_hetuprof", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.setdefault("_hetuprof", mod)   # dataclasses need this
+        spec.loader.exec_module(sys.modules["_hetuprof"])
+        _PROFILER = sys.modules["_hetuprof"]
+    return _PROFILER
+
+
 def _attn_flops(batch, seq, n_layers, d_model, causal):
-    """Attention-score matmul FLOPs per training step (fwd+bwd), which the
-    6ND rule EXCLUDES (they scale with T^2, not with N): per layer the
-    forward QK^T and PV matmuls cost 2*2*B*T^2*d; backward doubles it ->
-    12*B*T^2*d*L for a bidirectional encoder. A causal decoder only
-    computes the lower triangle (the flash kernel skips upper blocks), so
-    half. Reporting MFU against 6ND alone OVERSTATES utilization at long
-    seq — both denominators are reported."""
-    full = 12.0 * batch * seq * seq * d_model * n_layers
-    return full / 2.0 if causal else full
+    """Attention-score matmul FLOPs (the 6ND rule excludes them) — the
+    formula lives in hetu_tpu.telemetry.profiler.attn_flops now so hetutop
+    reports the same two denominators (docs/ROOFLINE.md)."""
+    return _profiler().attn_flops(batch, seq, n_layers, d_model, causal)
 
 
 def _import_models(suite):
@@ -624,6 +642,11 @@ SECTION_ENV = {
 }
 
 
+# pgid of the in-flight section child: the SIGTERM emergency emitter kills
+# it so a driver-terminated bench leaves no orphaned PS cluster behind
+_CURRENT_CHILD_PGID = [None]
+
+
 def _section_subprocess(name, timeout):
     """Run one section in a child process group with a hard timeout. The
     whole GROUP is killed on timeout — the wdl section spawns a PS
@@ -645,6 +668,7 @@ def _section_subprocess(name, timeout):
                             stderr=subprocess.PIPE, text=True,
                             cwd=os.path.dirname(os.path.abspath(__file__)),
                             env=env, start_new_session=True)
+    _CURRENT_CHILD_PGID[0] = proc.pid
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -659,6 +683,7 @@ def _section_subprocess(name, timeout):
         return {"error": f"timed out after {timeout}s (hung compile?)",
                 "hang": True}
     finally:
+        _CURRENT_CHILD_PGID[0] = None
         if proc.poll() is None:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
@@ -813,6 +838,80 @@ def _wait_for_backend(budget, detail):
             return True
 
 
+def _assemble_final(detail, section_keys, error=None):
+    """The ONE final JSON line, from whatever cells exist so far.
+
+    Factored out of main() so the SIGTERM emergency path emits the same
+    structure: completed cells keep their numbers, the headline comes from
+    whichever resnet cells finished, and ``incomplete_cells`` names every
+    section that has no measurement — so a cut-short run yields a partial
+    trajectory point that SAYS it is partial (the BENCH_r05 rc=124 hole,
+    where the driver's cap left no JSON line at all) instead of reading as
+    a win, a loss, or nothing."""
+    headline = 0.0
+    for k, v in detail.items():
+        if k.startswith("resnet18_") and isinstance(v, dict):
+            headline = max(headline, v.get("samples_per_sec") or 0.0)
+    incomplete = [k for k in section_keys
+                  if not isinstance(detail.get(k), dict)
+                  or "error" in detail[k]]
+    line = {
+        "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
+        "value": round(headline, 1) if headline else None,
+        "unit": "samples/sec/chip",
+        "vs_baseline": (round(headline / BASELINE_SAMPLES_PER_SEC, 3)
+                        if headline and BASELINE_SAMPLES_PER_SEC else None),
+        "detail": detail,
+    }
+    if error:
+        line["error"] = error
+    if incomplete:
+        line["incomplete_cells"] = incomplete
+    return line
+
+
+def _install_emergency_emit(detail, section_keys):
+    """SIGTERM handler (installed BEFORE the first timed window): the
+    driver kills a over-budget bench with ``timeout -k 10``, which sends
+    SIGTERM then SIGKILL 10 s later — enough room to print the final line
+    with every completed cell, kill the in-flight section child's process
+    group, and exit 75 (EX_TEMPFAIL, the repo's preemption convention)."""
+    def _emergency(signum, frame):
+        line = _assemble_final(
+            detail, section_keys,
+            error=f"terminated by signal {signum} before completion")
+        print(json.dumps(line), flush=True)
+        pgid = _CURRENT_CHILD_PGID[0]
+        if pgid:
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        os._exit(75)
+    signal.signal(signal.SIGTERM, _emergency)
+
+
+def _latest_good_round(here):
+    """Newest BENCH round artifact with at least one gateable measurement
+    (BENCH_rNN.json driver wrappers and BENCH_SELF_rNN_partial.json
+    ledgers both qualify) — the default --gate baseline. BENCH_r05's
+    parsed-null wrapper is exactly what this must skip."""
+    prof = _profiler()
+    candidates = []
+    for path in glob.glob(os.path.join(here, "BENCH_*r[0-9]*.json")):
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        if m:
+            candidates.append((int(m.group(1)), path))
+    for _, path in sorted(candidates, reverse=True):
+        try:
+            cells, _meta = prof.load_summary(path)
+        except (OSError, ValueError):
+            continue
+        if prof.summary_has_measurement(cells):
+            return path
+    return None
+
+
 def main():
     # the parent NEVER touches jax: a hung backend must not stall the
     # driver's one-JSON-line contract
@@ -885,6 +984,11 @@ def main():
     if lift:
         risky = set()
         detail["wedge_verdict"] = wtext
+
+    # emergency emitter BEFORE the first timed window: a driver kill from
+    # here on still produces the final line with every completed cell
+    section_keys = [k for k, n, _t in sections if n != "probe"]
+    _install_emergency_emit(detail, section_keys)
 
     for key, name, timeout in sections:
         if name == "probe":
@@ -1005,29 +1109,35 @@ def main():
             ledger.record(key, out, device=dev)
         detail[key] = out
 
-    # headline over the MERGED detail (fresh + ledger): a resnet cell
-    # captured by a killed earlier invocation still counts
-    headline = 0.0
-    for k, v in detail.items():
-        if k.startswith("resnet18_") and isinstance(v, dict):
-            headline = max(headline, v.get("samples_per_sec") or 0.0)
+    # final line over the MERGED detail (fresh + ledger): a resnet cell
+    # captured by a killed earlier invocation still counts; a value of None
+    # is unmistakably a failure, not a catastrophic-regression-shaped
+    # measurement, and incomplete_cells names what was not measured
+    line = _assemble_final(detail, section_keys)
 
-    if headline == 0.0:
-        # nothing survived — make it unmistakably a failure, not a
-        # catastrophic-regression-shaped measurement
-        print(json.dumps({"metric": "resnet18_cifar10_train_samples_per_sec"
-                                    "_per_chip", "value": None,
-                          "unit": "samples/sec/chip", "vs_baseline": None,
-                          "detail": detail}))
+    if "--gate" in sys.argv:
+        # self-report regression vs the last good trajectory round: the
+        # verdict rides INSIDE the line (detailed in docs/PROFILING.md);
+        # the driver's exit-code contract is untouched
+        here = os.path.dirname(os.path.abspath(__file__))
+        idx = sys.argv.index("--gate")
+        baseline = (sys.argv[idx + 1]
+                    if idx + 1 < len(sys.argv)
+                    and not sys.argv[idx + 1].startswith("-") else None)
+        baseline = baseline or _latest_good_round(here)
+        if baseline is None:
+            line["gate"] = {"error": "no usable baseline round found"}
+        else:
+            res = _profiler().gate_files(baseline, current_data=line)
+            line["gate"] = {"baseline": os.path.basename(baseline),
+                            "verdict": res.verdict, "status": res.status,
+                            "regressions": res.regressions,
+                            "incomplete": res.incomplete}
+            print(f"# gate vs {baseline}: {res.verdict}", file=sys.stderr)
+
+    print(json.dumps(line))
+    if line["value"] is None:
         sys.exit(1)
-    vs = headline / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
-    print(json.dumps({
-        "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
-        "value": round(headline, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 3),
-        "detail": detail,
-    }))
 
 
 if __name__ == "__main__":

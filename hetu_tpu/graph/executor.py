@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import re
 import time
 from typing import Any, Optional, Sequence
 
@@ -42,6 +43,24 @@ from .ops.ps import ParameterServerCommunicateOp, ParameterServerSparsePullOp
 
 _NO_OUTPUT = "<no-output>"
 _PS_RESIDENT = "<ps-resident-parameter>"
+
+# op-name -> jax.named_scope name: "/" would open a NESTED scope (one op
+# must be one scope segment so the profiler's HLO-metadata join stays 1:1)
+_SCOPE_BAD = re.compile(r"[/\s]+")
+
+
+def _op_scope(node: Op) -> str:
+    return _SCOPE_BAD.sub("_", node.name)
+
+
+def _device_live_bytes() -> Optional[float]:
+    """Live allocated device memory (bytes_in_use), or None where the
+    backend keeps no allocator stats (CPU)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return float(stats["bytes_in_use"]) if stats else None
+    except Exception:  # noqa: BLE001 — observability only
+        return None
 
 
 class HetuConfig:
@@ -328,9 +347,15 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
             f"{node.name} reads a PS-resident embedding table directly; only "
             "embedding_lookup_op / parameterServerSparsePull_op may touch "
             "PS-hosted tables (their rows are staged by the executor)")
+    # every op's lowering runs under jax.named_scope(op.name): the HLO
+    # metadata op_name path then carries graph-op identity, which is what
+    # lets hetuprof attribute device-trace time back to Ops (and dump_hlo
+    # readers navigate the fused program). Trace-time only — zero per-step
+    # runtime cost, and backward ops inherit the scope through the vjp.
     if node.stateful:
         state_in = tc.op_state_in[id(node)]
-        out, new_state = node.compute_stateful(input_vals, state_in, tc)
+        with jax.named_scope(_op_scope(node)):
+            out, new_state = node.compute_stateful(input_vals, state_in, tc)
         # op state (running stats) keeps its own dtype across steps — under
         # bf16 compute the update must not silently downcast the f32 stats
         new_state = jax.tree.map(
@@ -342,7 +367,8 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
             tc.op_state_updates[id(node)] = new_state
         env[id(node)] = out
     else:
-        env[id(node)] = node.compute(input_vals, tc)
+        with jax.named_scope(_op_scope(node)):
+            env[id(node)] = node.compute(input_vals, tc)
 
 
 class SubExecutor:
@@ -365,6 +391,9 @@ class SubExecutor:
         self.anomaly_guard = self.training and self.config.anomaly_guard
         self._compiled: dict[tuple, Any] = {}
         self._last_call = None  # (jitted fn, args) of the latest run
+        # compiled-executable handles keyed by the jitted fn, so repeated
+        # cost/memory/HLO queries re-lower once per signature, not per query
+        self._exe_cache: dict[int, Any] = {}
         # device-side input double buffer: id(node) -> (host batch, device arr)
         self._dev_prefetch: dict[int, tuple] = {}
         # HETU_PROFILE=1: cumulative host-side phase timings + step count
@@ -531,9 +560,13 @@ class SubExecutor:
             # serialized into the (size-limited) remote compile request.
             for (node, bs, bnum), data, cur in zip(res_dl_specs, res_data_t,
                                                    dl_cursors_t):
-                start = (cur % bnum) * bs
-                batch = jax.lax.dynamic_slice_in_dim(data, start, bs, axis=0)
-                env[id(node)] = cast_in(batch)
+                # named like its dataloader node so hetuprof attributes the
+                # on-device batch slice instead of an anonymous dynamic_slice
+                with jax.named_scope(_op_scope(node)):
+                    start = (cur % bnum) * bs
+                    batch = jax.lax.dynamic_slice_in_dim(data, start, bs,
+                                                         axis=0)
+                    env[id(node)] = cast_in(batch)
             # PS-resident embeddings: staged rows stand in for the lookup
             # output; the table itself never exists on device
             for node, val in zip(ps_staged_ops, ps_staged_t):
@@ -552,7 +585,8 @@ class SubExecutor:
                 if node.is_placeholder:
                     raise ValueError(f"Placeholder {node.name} was not fed")
                 if node.is_optimizer:
-                    node.apply_updates(env, slots_in[id(node)], tc)
+                    with jax.named_scope(_op_scope(node)):
+                        node.apply_updates(env, slots_in[id(node)], tc)
                     env[id(node)] = _NO_OUTPUT
                     continue
                 _eval_node(node, env, tc)
@@ -632,7 +666,8 @@ class SubExecutor:
                 for k, v in p.items() if k != "steps"} | {"steps": n}
 
     def _record_telemetry(self, tel, step, t0, t_pre, t_c0, t_c1, t_d0,
-                          t_d1, t_end, compiled_now, feed_vals, batch_vals):
+                          t_d1, t_end, compiled_now, feed_vals, batch_vals,
+                          ps_comm_ms=None):
         """Per-step telemetry: phase spans (trace mode), step metrics and
         the JSONL step record; PS server health on its poll cadence. Runs
         only when telemetry is active — the hot path records raw
@@ -644,6 +679,8 @@ class SubExecutor:
                   "poststep_ms": (t_end - t_d1) * 1e3}
         if compiled_now:
             phases["compile_ms"] = (t_c1 - t_c0) * 1e3
+        if ps_comm_ms is not None:
+            phases["ps_comm_ms"] = ps_comm_ms
         self.last_phases = {"step_ms": step_ms, "step": int(step), **phases}
         tracer = tel.tracer
         label = "step" if self.training else "eval"
@@ -676,6 +713,13 @@ class SubExecutor:
             bs = self.resident_dl[id(self.res_dl_nodes[0])][1]
         if bs:
             tm["examples"].inc(bs)
+        if ps_comm_ms is not None and step_ms > 0:
+            # critical-path PS RPC share of the step (staging pulls + push
+            # issue). The gauge exists only for PS/Hybrid runs; AllReduce
+            # comm lives inside the XLA program — hetuprof --attr separates
+            # it offline from the device trace (docs/PROFILING.md).
+            tel.metrics.gauge("hetu_comm_fraction").set(
+                min(1.0, ps_comm_ms / step_ms))
         if compiled_now:
             tm["compiles"].inc()
             if len(self._compiled) > 1:
@@ -690,8 +734,35 @@ class SubExecutor:
             cost = self.last_cost_analysis() or {}
             if cost.get("flops"):
                 tm["flops"].set(float(cost["flops"]))
+            # 6ND companion denominator (docs/ROOFLINE.md): 6·N·tokens,
+            # tokens from the first integer-typed 2-D feed (token ids) or
+            # the batch size. hetutop shows MFU under BOTH this and the
+            # measured cost-analysis flops (which include attention).
+            tokens = None
+            for v in list(feed_vals) + list(batch_vals):
+                shape = getattr(v, "shape", None)
+                dt = getattr(v, "dtype", None)
+                if shape is not None and len(shape) >= 2 and dt is not None \
+                        and jnp.issubdtype(dt, jnp.integer):
+                    tokens = int(shape[0]) * int(shape[1])
+                    break
+            if tokens is None:
+                tokens = bs
+            if tokens and ex.n_params_total:
+                tel.metrics.gauge("hetu_flops_per_step_6nd").set(
+                    6.0 * ex.n_params_total * tokens)
+            # HBM accounting of the program just compiled, next to the live
+            # allocator gauge polled below — predicted vs resident
+            mem = self.last_memory_analysis()
+            if mem:
+                for k, v in mem.items():
+                    tel.metrics.gauge(f"hetu_hbm_{k}").set(float(v))
         tel.step_record(self.name, step, step_ms, phases=phases)
         ps = ex.ps_runtime
+        if step % self._tel_ps_every == 0:
+            live = _device_live_bytes()
+            if live is not None:
+                tel.metrics.gauge("hetu_hbm_live_bytes").set(live)
         if ps is not None and step % self._tel_ps_every == 0:
             for row in ps.telemetry_stats():
                 tel.record(**row)
@@ -703,19 +774,58 @@ class SubExecutor:
         fn, args = self._last_call
         return fn.lower(*args)
 
+    def _executable(self):
+        """Compiled executable of the latest executed step, cached per
+        jitted program: ``last_cost_analysis``/``last_memory_analysis``/
+        ``dump_hlo(stage="optimized")`` used to re-lower + re-look-up the
+        compile cache on EVERY query — cache-hitting but not free (a
+        whole-program re-trace each time); now one fetch per signature."""
+        if self._last_call is None:
+            return None
+        fn, args = self._last_call
+        exe = self._exe_cache.get(id(fn))
+        if exe is None:
+            exe = fn.lower(*args).compile()
+            self._exe_cache[id(fn)] = exe
+        return exe
+
     def last_cost_analysis(self):
         """XLA cost analysis (flops etc.) of the latest executed step, for
         MFU reporting and the Tier B lints (reaches the compilation cache —
         no recompile). Normalized to a dict or None: jax 0.4.x returns a
         single-element LIST wrapping the dict, newer jax the dict itself."""
         try:
-            low = self._lowered()
-            ca = None if low is None else low.compile().cost_analysis()
+            exe = self._executable()
+            ca = None if exe is None else exe.cost_analysis()
         except Exception:  # noqa: BLE001 — diagnostics only
             return None
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else None
         return ca if isinstance(ca, dict) else None
+
+    def last_memory_analysis(self) -> Optional[dict]:
+        """HBM accounting of the latest executed step program as a plain
+        dict (``argument/output/temp/alias/generated_code`` bytes plus the
+        derived ``peak_bytes`` = args + out + temp − alias, the same formula
+        as the AOT HBM gate in ``__graft_entry__.aot_memory_check``), from
+        the same cached compiled handle as :meth:`last_cost_analysis`.
+        None when nothing has run or the backend exposes no analysis."""
+        try:
+            exe = self._executable()
+            ma = None if exe is None else exe.memory_analysis()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return None
+        if ma is None:
+            return None
+        out = {}
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes"):
+            out[field.replace("_size_in_bytes", "_bytes")] = \
+                int(getattr(ma, field, 0) or 0)
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"] - out["alias_bytes"])
+        return out
 
     def dump_hlo(self, path=None, stage="stablehlo"):
         """The compiled program of the latest executed step as text — the
@@ -728,11 +838,14 @@ class SubExecutor:
         if stage not in ("stablehlo", "optimized"):
             raise ValueError(f"stage must be 'stablehlo' or 'optimized', "
                              f"got {stage!r}")
-        lowered = self._lowered()
-        if lowered is None:
+        if stage == "optimized":
+            exe = self._executable()
+            text = None if exe is None else exe.as_text()
+        else:
+            lowered = self._lowered()
+            text = None if lowered is None else lowered.as_text()
+        if text is None:
             return None
-        text = (lowered.as_text() if stage == "stablehlo"
-                else lowered.compile().as_text())
         if path is not None:
             with open(path, "w") as f:
                 f.write(text)
@@ -780,6 +893,8 @@ class SubExecutor:
         # (shared CTR embeddings) pulls the UNION of its row indices once,
         # then distributes rows to each lookup — one RPC instead of k.
         ps = ex.ps_runtime
+        ps_timed = timed and ps is not None
+        t_ps0 = time.perf_counter() if ps_timed else 0.0
         staged_idx: dict[int, np.ndarray] = {}
         staged_rows: dict[int, np.ndarray] = {}
         for tid, ops in self._staged_by_table.items():
@@ -817,6 +932,7 @@ class SubExecutor:
             p = ps.params[id(n)]
             ps.wait_dense(p)   # async DDPushPull updates host_value
             ps_dense_vals.append(ex._prepare_input(p.host_value, batch=False))
+        ps_comm_s = (time.perf_counter() - t_ps0) if ps_timed else 0.0
 
         t_pre = time.perf_counter() if timed else 0.0
         if prof is not None:
@@ -876,6 +992,7 @@ class SubExecutor:
                 self._dev_prefetch[id(n)] = (nxt, ex._prepare_input(nxt))
 
         # -- PS post-step: push gradients (reference push/pull, ASP/BSP) ----
+        t_pu0 = time.perf_counter() if ps_timed else 0.0
         if ps is not None and ps.async_enabled:
             # async push: the device sync (np.asarray) happens on the push
             # thread, off the critical path
@@ -912,6 +1029,8 @@ class SubExecutor:
                 p = ps.params[id(op.ps_param_node)]
                 idx = self._push_idx(op, staged_idx)
                 ps.push_grad(p, grad, idx, step=step)
+        if ps_timed:
+            ps_comm_s += time.perf_counter() - t_pu0
 
         if self.training:
             for node, val in zip(ex.param_nodes, new_params):
@@ -945,7 +1064,8 @@ class SubExecutor:
             # the preemption path must already contain this step's record
             self._record_telemetry(
                 tel, step, t_run0, t_pre, t_c0, t_c1, t_d0, t_d1, t_end,
-                compiled_now, feed_vals, batch_vals)
+                compiled_now, feed_vals, batch_vals,
+                ps_comm_ms=ps_comm_s * 1e3 if ps_timed else None)
 
         # post-step supervision LAST: a rollback rewrites ex.state, an
         # emergency save captures it, and Preempted aborts the return — all
@@ -1102,6 +1222,18 @@ class Executor:
                       # resilience counters (anomaly_guard):
                       "anomaly_streak": 0, "anomaly_total": 0,
                       "last_step_finite": True}
+        # total trainable parameter count — the N in the 6ND MFU denominator
+        # (docs/ROOFLINE.md). PS-resident tables count too: their lookup/
+        # update flops run per step even though the arrays live server-side.
+        self.n_params_total = sum(
+            int(np.prod(v.shape)) for v in params.values())
+        if self.ps_runtime is not None:
+            self.n_params_total += sum(
+                int(np.prod(p.shape))
+                for p in self.ps_runtime.params.values())
+        if self.telemetry is not None:
+            self.telemetry.metrics.gauge("hetu_params_total").set(
+                float(self.n_params_total))
         # resilience.Supervisor hook point (attach_supervisor)
         self.supervisor = None
 

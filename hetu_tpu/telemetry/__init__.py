@@ -86,6 +86,14 @@ class Telemetry:
             Tracer(os.path.join(out_dir, f"trace-r{self.rank}.json"),
                    rank=self.rank) if mode == "trace" else None)
         self.xla_window = XlaTraceWindow.from_env()
+        if self.xla_window is not None:
+            # advertise the deep-dive window in the JSONL so hetuprof can
+            # locate the XLA trace dir and normalize per-op times per step
+            # without re-reading the caller's environment
+            self.sink.write({"kind": "xla_trace",
+                             "dir": self.xla_window.dir,
+                             "start_step": self.xla_window.start_step,
+                             "n_steps": self.xla_window.n_steps})
         self._prom_path = os.path.join(out_dir,
                                        f"metrics-r{self.rank}.prom")
         # full registry snapshots ride only every Nth step record: the
@@ -186,6 +194,17 @@ class Telemetry:
 def get() -> Optional[Telemetry]:
     """The active telemetry, or None when off — the per-call-site gate."""
     return _active
+
+
+def record_model_info(**fields) -> None:
+    """Advertise model geometry (``n_layers``, ``d_model``, ``seq_len``,
+    ``causal``, optionally ``n_params``) to the dashboards: hetutop uses it
+    to report MFU under the attention-inclusive denominator next to 6ND
+    (docs/ROOFLINE.md). No-op when telemetry is off — trainers call this
+    unconditionally after building their model."""
+    t = get()
+    if t is not None:
+        t.record("model_info", **fields)
 
 
 def activate(mode: Optional[str] = None, out_dir: Optional[str] = None,

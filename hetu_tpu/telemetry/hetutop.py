@@ -19,6 +19,8 @@ import sys
 import time
 from typing import Optional
 
+from .profiler import attn_flops   # stdlib-only module (shared w/ bench.py)
+
 # MFU denominator when no peak rides in the records: same default as
 # bench.py / docs/ROOFLINE.md (assumption, not a reading)
 DEFAULT_PEAK_TFLOPS = float(os.environ.get("HETU_PEAK_TFLOPS", "197"))
@@ -166,9 +168,10 @@ class Follower:
         self.dir = dir_path
         self._offsets: dict = {}
         self._recs: dict = {}
-        # once-per-run records (run_info) and slow-cadence rows (ps_server)
-        # must survive eviction from the bounded buffers
+        # once-per-run records (run_info/model_info) and slow-cadence rows
+        # (ps_server) must survive eviction from the bounded buffers
         self._sticky_run_info: dict = {}
+        self._sticky_model: dict = {}
         self._sticky_ps: dict = {}
 
     def _poll_file(self, path: str):
@@ -209,14 +212,17 @@ class Follower:
         state = _aggregate({p: self._poll_file(p)
                             for p in metrics_files(self.dir)})
         self._sticky_run_info.update(state["run_info"])
+        self._sticky_model.update(state["model"])
         self._sticky_ps.update(state["ps"])
         state["run_info"] = dict(self._sticky_run_info)
+        state["model"] = dict(self._sticky_model)
         state["ps"] = dict(self._sticky_ps)
         return state
 
 
 def _aggregate(recs_by_file: dict) -> dict:
-    state: dict = {"ranks": {}, "events": [], "ps": {}, "run_info": {}}
+    state: dict = {"ranks": {}, "events": [], "ps": {}, "run_info": {},
+                   "model": {}}
     for path, recs in recs_by_file.items():
         steps = [r for r in recs if r.get("kind") == "step"
                  and all(k in r for k in STEP_REQUIRED)]
@@ -230,6 +236,10 @@ def _aggregate(recs_by_file: dict) -> dict:
                 state["ps"][r.get("server")] = r
             elif kind == "run_info":
                 state["run_info"].update(r)
+            elif kind == "model_info":
+                # model geometry (telemetry.record_model_info) unlocks the
+                # analytic attention-inclusive MFU denominator
+                state["model"].update(r)
             if kind in ("step", "final") and isinstance(
                     r.get("metrics"), dict):
                 m = r["metrics"]   # latest snapshot wins
@@ -280,6 +290,37 @@ def _metric_children(m: dict, base: str, suffix: str):
     return sorted(out)
 
 
+def _mfu_pair(m: dict, model: dict, p50_ms, peak_tflops: float):
+    """MFU under BOTH denominators (docs/ROOFLINE.md: 6ND alone overstates
+    utilization at long seq): 6ND from the executor's
+    ``hetu_flops_per_step_6nd`` gauge; attention-inclusive as 6ND + the
+    analytic attention add-on when model geometry is known
+    (``telemetry.record_model_info``), else the measured XLA cost-analysis
+    flops — which count the score matmuls by construction."""
+    if not p50_ms:
+        return None, None
+    denom = (p50_ms / 1e3) * peak_tflops * 1e12
+    f6 = m.get("hetu_flops_per_step_6nd")
+    mfu6 = 100.0 * f6 / denom if f6 else None
+    f_attn = None
+    if f6 and all(k in model for k in ("n_layers", "d_model", "seq_len")):
+        # invert tokens with the SAME N that produced the gauge
+        # (hetu_params_total; the executor's count includes PS-resident
+        # tables) — a user-supplied model n_params may count differently
+        # and would scale the recovered token count by the ratio
+        n = m.get("hetu_params_total") or model.get("n_params")
+        if n:
+            tokens = f6 / (6.0 * n)
+            seq = float(model["seq_len"])
+            f_attn = f6 + attn_flops(tokens / seq, seq,
+                                     model["n_layers"], model["d_model"],
+                                     bool(model.get("causal")))
+    if f_attn is None:
+        f_attn = m.get("hetu_flops_per_step")
+    mfu_a = 100.0 * f_attn / denom if f_attn else None
+    return mfu6, mfu_a
+
+
 def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
                  ) -> str:
     lines = []
@@ -289,21 +330,19 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
     lines.append(f"hetutop — device {dev}, assumed peak {peak:g} TFLOP/s "
                  f"(see docs/ROOFLINE.md)")
     lines.append("rank  sub        step   steps/s    ex/s   p50ms   p90ms"
-                 "   p99ms   maxms    MFU%  recompiles  anomalies")
+                 "   p99ms   maxms MFU6nd% MFUatt%  recompiles  anomalies")
     for rank in sorted(state["ranks"]):
         r = state["ranks"][rank]
         m = r["metrics"]
-        flops = m.get("hetu_flops_per_step")
-        mfu = None
-        if flops and r["p50"]:
-            mfu = 100.0 * flops / (r["p50"] / 1e3) / (peak * 1e12)
+        mfu6, mfu_a = _mfu_pair(m, state.get("model", {}), r["p50"], peak)
         lines.append(
             f"{rank:>4}  {r['sub'][:9]:<9}{r['last_step']:>7}"
             f"{_fmt(r['steps_per_s'], '8.2f'):>9}"
             f"{_fmt(r['examples_per_s'], '8.0f'):>8}"
             f"{r['p50']:>8.2f}{r['p90']:>8.2f}{r['p99']:>8.2f}"
             f"{r['max']:>8.2f}"
-            f"{_fmt(mfu, '7.1f'):>8}"
+            f"{_fmt(mfu6, '7.1f'):>8}"
+            f"{_fmt(mfu_a, '7.1f'):>8}"
             f"{m.get('hetu_recompiles_total', 0):>11g}"
             f"{m.get('hetu_anomaly_trips_total', 0):>10g}")
         extras = []
@@ -311,11 +350,17 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
                 ("hetu_dataloader_wait_ms", "_p50", "dl wait p50"),
                 ("hetu_ps_pull_ms", "_p50", "ps pull p50"),
                 ("hetu_ps_push_ms", "_p50", "ps push p50"),
-                ("hetu_cache_hit_rate", "", "cache hit")):
-            unit = "" if base.endswith("rate") else "ms"
+                ("hetu_cache_hit_rate", "", "cache hit"),
+                ("hetu_comm_fraction", "", "comm frac")):
+            unit = "" if base.endswith(("rate", "fraction")) else "ms"
             for child, v in _metric_children(m, base, suffix):
                 tag = f"[{child}]" if child else ""
                 extras.append(f"{label}{tag} {v:.3g}{unit}")
+        hbm = m.get("hetu_hbm_peak_bytes")
+        if hbm:
+            live = m.get("hetu_hbm_live_bytes")
+            extras.append(f"hbm compiled {hbm / 2**20:.0f}MiB"
+                          + (f" live {live / 2**20:.0f}MiB" if live else ""))
         if extras:
             lines.append("      " + "  |  ".join(extras))
     if state["ps"]:

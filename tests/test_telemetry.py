@@ -383,6 +383,9 @@ def _telemetry_ps_worker(client, rank, tmpdir):
     snap = tel.metrics.snapshot()
     # PS push latency histogram saw this run's gradient pushes
     assert snap.get("hetu_ps_push_ms_count", 0) > 0, snap
+    # critical-path PS RPC share of the step (hetuprof pillar 1; the
+    # executor stamps the staging-pull + push blocks on PS runs)
+    assert 0 < snap.get("hetu_comm_fraction", 0) <= 1, snap
     tel.flush()
     # extended kServerStats: request count, apply latency, dedup ledger
     st = client.ServerStats(0)
