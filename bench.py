@@ -127,6 +127,64 @@ def _bench_resnet18(batch_size, warmup, iters, dtype):
 
 
 
+def bench_introspect_overhead(width=512, batch=512, warmup=None, iters=60,
+                              cadence=None):
+    """Measured hetuscope introspection overhead (docs/OBSERVABILITY.md
+    acceptance: <5% of step time at the default cadence) — two identical
+    MLP trainers, introspect off vs on, same shapes/seed, timed back to
+    back on CPU (a framework-overhead measurement, so the SECTION_ENV pin
+    keeps it off the tunneled chip and deterministic). The on-window pays
+    the real amortized cost: 1-in-cadence steps run the stats variant and
+    its one extra device fetch."""
+    import hetu_tpu as ht
+    from hetu_tpu.telemetry import scope as scope_mod
+
+    cadence = cadence or scope_mod.DEFAULT_CADENCE
+    if warmup is None:
+        warmup = cadence + 5   # must compile BOTH variants of the on-step
+
+    def build(introspect):
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        h = x
+        for i in range(3):
+            w = ht.init.random_normal((width, width), stddev=0.05,
+                                      name=f"w{i}")
+            h = ht.relu_op(ht.matmul_op(h, w))
+        wo = ht.init.random_normal((width, 8), stddev=0.05, name="wo")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, wo), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         seed=0, introspect=introspect)
+        rng = np.random.RandomState(0)
+        bx = rng.randn(batch, width).astype(np.float32)
+        by = np.eye(8, dtype=np.float32)[rng.randint(0, 8, batch)]
+        return ex, {x: bx, y_: by}
+
+    def window(introspect):
+        ex, feeds = build(introspect)
+        for _ in range(warmup):
+            ex.run("train", feed_dict=feeds)
+        loss = ex.run("train", feed_dict=feeds)[0]
+        float(np.mean(loss.asnumpy()))   # drain before the window
+        t0 = time.time()
+        for _ in range(iters - 1):
+            ex.run("train", feed_dict=feeds)
+        last = ex.run("train", feed_dict=feeds)[0]
+        float(np.mean(last.asnumpy()))   # one sync for the whole window
+        return (time.time() - t0) / iters * 1000
+
+    ms_off = window(0)
+    scope_mod.shutdown()   # detach the recorder between the A/B arms
+    ms_on = window(cadence)
+    scope_mod.shutdown()
+    return {"step_ms_off": round(ms_off, 4), "step_ms_on": round(ms_on, 4),
+            "introspect_overhead_pct": round(
+                (ms_on - ms_off) / ms_off * 100, 2),
+            "cadence": cadence}
+
+
 def _capture_trace(out, step_twice, trace_dir, label):
     """Post-window jax.profiler capture shared by the LM cells (bert,
     transformer/350): runs AFTER the timed window so tracing overhead
@@ -615,6 +673,12 @@ def _run_section(name):
         out = bench_pipeline_ab(**(dict(d_model=64, n_layers=4, d_ff=128,
                                         vocab_size=512, seq=32, mb=2,
                                         microbatches=12) if smoke else {}))
+    elif name == "introspect":
+        # hetuscope overhead cell (docs/OBSERVABILITY.md): the <5%-at-
+        # default-cadence claim is MEASURED here, not asserted
+        kw = (dict(width=32, batch=16, iters=12, warmup=4)
+              if smoke else {})
+        out = bench_introspect_overhead(**kw)
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -639,6 +703,9 @@ def _run_section(name):
 SECTION_ENV = {
     "pipeline": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
                  "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    # framework-overhead A/B: pinned off the tunneled chip so the delta
+    # measures hetuscope, not tunnel jitter
+    "introspect": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
 }
 
 
@@ -796,7 +863,9 @@ class _Ledger:
                "peak_tflops_assumed": PEAK_TFLOPS}
         if isinstance(result, dict):
             for k in ("samples_per_sec", "step_ms", "mfu", "mfu_6nd",
-                      "mfu_attn_incl", "tokens_per_sec"):
+                      "mfu_attn_incl", "tokens_per_sec",
+                      "introspect_overhead_pct", "step_ms_off",
+                      "step_ms_on"):
                 if result.get(k) is not None:
                     rec[k] = result[k]
         try:
@@ -959,7 +1028,8 @@ def main():
                      ("flash_attention_seq4096", "flash4k", 420),
                      ("vit_base_finetune", "vit", 600),
                      ("pipeline_gpipe_vs_1f1b", "pipeline", 600),
-                     ("wdl_criteo_hybrid_ps", "wdl", 600)]
+                     ("wdl_criteo_hybrid_ps", "wdl", 600),
+                     ("introspect_overhead", "introspect", 420)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent
     # with a cold compile that outlives a killed client server-side and

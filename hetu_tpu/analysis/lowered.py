@@ -39,7 +39,8 @@ HOST_CALLBACK_MARKERS = (
 DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
 
 _SIG_PARTS = ("feed signature", "dataloader-batch signature",
-              "optimizer host token", "PS staged-row shapes")
+              "optimizer host token", "PS staged-row shapes",
+              "introspection cadence", "poisoned op")
 
 
 def _sub_finding(sub, lint, severity, message) -> Finding:
@@ -72,16 +73,28 @@ def _describe_sig_change(prev, cur) -> str:
 
 def recompile_findings(sub, budget: int = 3) -> list[Finding]:
     """Flag a subexecutor whose compile cache outgrew ``budget`` distinct
-    step signatures — the signature churn that turns steps into compiles."""
+    step signatures — the signature churn that turns steps into compiles.
+    Counted over SHAPE signatures (``_base_sigs``) when available: the
+    hetuscope cadence/poison variants of one signature are deliberate
+    extra compiles, not churn."""
     cache = getattr(sub, "_compiled", None)
-    if cache is None or len(cache) <= budget:
+    if cache is None:
         return []
-    sigs = list(cache.keys())
+    # collapse the hetuscope cadence/poison variants (2 trailing key
+    # components) onto their shape signature, preserving first-seen order:
+    # both the count and the churn diff must describe SHAPE churn, not a
+    # deliberate variant switch
+    sigs = list(dict.fromkeys(
+        k[:len(_SIG_PARTS) - 2] if len(k) > len(_SIG_PARTS) - 2 else k
+        for k in cache))
+    n = len(sigs)
+    if n <= budget:
+        return []
     churn = (f"; last change: {_describe_sig_change(sigs[-2], sigs[-1])}"
              if len(sigs) >= 2 else "")
     return [_sub_finding(
         sub, "recompile-budget", WARN,
-        f"{len(sigs)} distinct step programs compiled (budget {budget}) — "
+        f"{n} distinct step programs compiled (budget {budget}) — "
         "the step signature churns across steps, so steps pay compile "
         f"latency instead of running{churn}. Pad batches (drop_last), fix "
         "feed shapes, or hoist host-side optimizer state")]
@@ -204,7 +217,8 @@ class RecompileMonitor:
             cache = getattr(sub, "_compiled", None)
             if cache is None:
                 continue
-            n = len(cache)
+            base = getattr(sub, "_base_sigs", None)
+            n = len(base) if base else len(cache)
             if n > self.budget and n > self._reported.get(name, 0):
                 self._reported[name] = n
                 out.extend(recompile_findings(sub, self.budget))

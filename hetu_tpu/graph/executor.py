@@ -26,6 +26,7 @@ import os
 import pickle
 import re
 import time
+import zlib
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -53,6 +54,28 @@ def _op_scope(node: Op) -> str:
     return _SCOPE_BAD.sub("_", node.name)
 
 
+def _flight_crc(feed_dict, batch_host) -> int:
+    """Cheap batch fingerprint for the flight recorder: a chained crc32
+    over a bounded stride-sample (≤512 elements per array, first + spread)
+    of every fed/loaded host array — identifies WHICH batch a recorded
+    step saw without storing data or paying a full-array pass per step."""
+    h = 0
+    vals = list(batch_host.values())
+    for v in (feed_dict or {}).values():
+        if hasattr(v, "asnumpy"):
+            v = v.asnumpy()
+        vals.append(v)
+    for v in vals:
+        try:
+            a = np.asarray(v).ravel()
+            stride = max(1, a.size // 512)
+            h = zlib.crc32(np.ascontiguousarray(a[::stride][:512]).tobytes(),
+                           h)
+        except (TypeError, ValueError):
+            continue
+    return h
+
+
 def _device_live_bytes() -> Optional[float]:
     """Live allocated device memory (bytes_in_use), or None where the
     backend keeps no allocator stats (CPU)."""
@@ -76,7 +99,7 @@ class HetuConfig:
                  cache_bound=100, log_path=None, gpipe=False,
                  gpipe_microbatches=None, dtype=np.float32,
                  dp_axis="dp", mp_axis="tp", anomaly_guard=False,
-                 telemetry=None, **kwargs):
+                 telemetry=None, introspect=None, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -114,6 +137,13 @@ class HetuConfig:
         # See hetu_tpu/telemetry and docs/OBSERVABILITY.md.
         from ..telemetry import resolve_mode
         self.telemetry = resolve_mode(telemetry)
+        # numeric-health introspection (docs/OBSERVABILITY.md "numeric
+        # health"): 0 = off (default, zero per-step scope work — same
+        # None-check-only contract as telemetry), N = fused in-graph stats
+        # every N steps + flight recorder + NaN/Inf provenance on guard
+        # trips. Env default: HETU_INTROSPECT (+ HETU_INTROSPECT_EVERY).
+        from ..telemetry.scope import resolve_introspect
+        self.introspect = resolve_introspect(introspect)
         if self.anomaly_guard and comm_mode in ("PS", "Hybrid"):
             raise ValueError(
                 "anomaly_guard gates the on-device state commit, but PS-"
@@ -232,6 +262,12 @@ class TraceContext:
         # f32 master copies of params when compute_dtype is lower precision
         # (filled by the step builder; optimizer updates read these)
         self.master_params: dict[int, Any] = {}
+        # hetuscope hooks: a clip_grad_norm optimizer publishes its fused
+        # global-norm reduction here so the introspection stats reuse it
+        # (one computation, two consumers); poison_scope is the nan_op
+        # fault target — that op's output is NaN'd inside the trace
+        self.grad_global_norm: Optional[Any] = None
+        self.poison_scope: Optional[str] = None
         # Fold the node's position WITHIN this topo, not its process-global
         # id: global ids depend on how many nodes earlier code constructed,
         # which made RNG streams (dropout etc.) vary with test order.
@@ -308,6 +344,9 @@ class TraceContext:
                                       env2, self.rng_key, self.step,
                                       self.op_state_in)
                 sub_tc._in_grad_retrace = True
+                # the vjp re-trace must see the same poisoned op as the
+                # primal trace, or grads would flow from clean values
+                sub_tc.poison_scope = self.poison_scope
                 for node in sub_topo:
                     # skip the gradient/comm/optimizer tail — only the forward
                     # path to the loss matters inside the vjp closure
@@ -369,6 +408,13 @@ def _eval_node(node: Op, env: dict, tc: TraceContext):
     else:
         with jax.named_scope(_op_scope(node)):
             env[id(node)] = node.compute(input_vals, tc)
+    if tc.poison_scope is not None and _op_scope(node) == tc.poison_scope:
+        # nan_op fault (HETU_FAULT_SPEC, test mode): poison exactly this
+        # op's output so provenance can be proven to localize it
+        out = env[id(node)]
+        if hasattr(out, "dtype") and jnp.issubdtype(out.dtype,
+                                                    jnp.floating):
+            env[id(node)] = jnp.full_like(out, jnp.nan)
 
 
 class SubExecutor:
@@ -391,6 +437,17 @@ class SubExecutor:
         self.anomaly_guard = self.training and self.config.anomaly_guard
         self._compiled: dict[tuple, Any] = {}
         self._last_call = None  # (jitted fn, args) of the latest run
+        # hetuscope introspection (docs/OBSERVABILITY.md "numeric health"):
+        # armed iff the Executor built an Introspector and this target
+        # trains. Stats/poison variants of the step compile under distinct
+        # cache keys; _base_sigs tracks the shape signatures alone so those
+        # variants never read as recompile churn. _scope_meta is the
+        # (topo-ordered scope keys, per-op input map) pair captured while
+        # tracing a stats variant — what find_culprit walks.
+        self.introspect = self.training and executor.introspector is not None
+        self._base_sigs: set = set()
+        self._replay_compiled: dict[tuple, Any] = {}
+        self._scope_meta: Optional[tuple] = None
         # compiled-executable handles keyed by the jitted fn, so repeated
         # cost/memory/HLO queries re-lower once per signature, not per query
         self._exe_cache: dict[int, Any] = {}
@@ -505,9 +562,19 @@ class SubExecutor:
             return batch_host[id(node)]
         raise ValueError(f"no host value for {node.name!r}")
 
-    def _build(self):
+    def _build(self, introspect_now=False, poison_scope=None,
+               donate_ok=True):
+        """Build one jitted step variant. ``introspect_now`` fuses the
+        hetuscope per-op/per-param reductions into the program and returns
+        them as one extra output; ``poison_scope`` NaN-poisons that op's
+        output inside the trace (the ``nan_op`` fault); ``donate_ok=False``
+        builds the no-donation debug variant the provenance replay uses
+        (inputs must survive the call)."""
+        from ..telemetry import scope as _scope
         ex = self.executor
         param_nodes = ex.param_nodes
+        pf_names = {id(n): f for n, f in zip(ex.param_nodes,
+                                             ex._param_file_names())}
         topo = self.topo
         eval_nodes = self.eval_nodes
         training = self.training
@@ -578,6 +645,7 @@ class SubExecutor:
             op_state_in = {id(n): s for n, s in zip(stateful_nodes, opstate_t)}
             tc = TraceContext(config, topo, training, env, rng, step, op_state_in)
             tc.master_params = masters
+            tc.poison_scope = poison_scope
             slots_in = {id(n): s for n, s in zip(opt_nodes, slots_t)}
             for node in topo:
                 if id(node) in env:
@@ -601,6 +669,66 @@ class SubExecutor:
             new_opstate = tuple(tc.op_state_updates.get(id(n), op_state_in[id(n)])
                                 for n in stateful_nodes)
             ps_grads = tuple(tc.ps_grad_outputs[id(op)] for op in ps_comm_ops)
+            scope_stats = ()
+            if introspect_now:
+                # -- hetuscope in-graph stats (one extra fetch) ------------
+                # Per-op activation stats for every float-typed value in
+                # the env (activations, grads, fed inputs) keyed by the
+                # same named_scope identity hetuprof joins on, plus
+                # per-parameter grad norms and update/param ratios.
+                # Computed BEFORE the guard gating so the table describes
+                # the ATTEMPTED update — exactly what a NaN post-mortem
+                # needs. XLA fuses the reductions into the step program.
+                key_by_id: dict[int, str] = {}
+                used: set[str] = set()
+                op_entries = []
+                for node in topo:
+                    v = env.get(id(node))
+                    if node.is_optimizer or v is None or v is _NO_OUTPUT \
+                            or v is _PS_RESIDENT or isinstance(v, tuple):
+                        continue
+                    if not (hasattr(v, "dtype")
+                            and jnp.issubdtype(v.dtype, jnp.floating)) \
+                            or not getattr(v, "size", 0):
+                        continue
+                    k = _op_scope(node)
+                    if k in used:   # duplicate user op names stay distinct
+                        k = f"{k}__{node.id}"
+                    used.add(k)
+                    key_by_id[id(node)] = k
+                    op_entries.append((k, v))
+                param_entries = []
+                for onode in opt_nodes:
+                    for var, gnode in zip(onode.vars, onode.inputs):
+                        g = env.get(id(gnode))
+                        if g is None or isinstance(g, tuple) \
+                                or not hasattr(g, "dtype"):
+                            continue   # PS-managed: server owns the update
+                        param_entries.append(
+                            (pf_names.get(id(var), var.name), g,
+                             masters.get(id(var)),
+                             tc.param_updates.get(id(var))))
+                loss_val = None
+                for n, v in zip(eval_nodes, outputs):
+                    if n.is_optimizer:
+                        continue
+                    if hasattr(v, "dtype") \
+                            and jnp.issubdtype(v.dtype, jnp.floating) \
+                            and getattr(v, "size", 0) == 1:
+                        loss_val = v
+                        break
+                # stats pack into ONE stacked vector (the single extra
+                # fetch); the slot spec + topo order + input map are
+                # trace-time metadata, captured host-side for find_culprit
+                spec, scope_stats = _scope.traced_stats(
+                    op_entries, param_entries, loss_val,
+                    tc.grad_global_norm)
+                self._scope_meta = (
+                    [key_by_id[id(n)] for n in topo if id(n) in key_by_id],
+                    {key_by_id[id(n)]: [key_by_id[id(i)] for i in n.inputs
+                                        if id(i) in key_by_id]
+                     for n in topo if id(n) in key_by_id},
+                    spec)
             finite = jnp.bool_(True)
             if guard:
                 # -- anomaly guard (resilience layer) ----------------------
@@ -639,12 +767,12 @@ class SubExecutor:
                     keep(s, op_state_in[id(n)])
                     for s, n in zip(new_opstate, stateful_nodes))
             return outputs, new_params, new_slots, new_opstate, ps_grads, \
-                finite
+                finite, scope_stats
 
         # HETU_NO_DONATE=1: bisect knob for the bench wedge harness
         # (tools/wedge_bisect.py) — donation changes XLA's buffer
         # assignment, one of the suspects for the bf16 bs>=256 hang
-        donate = ((0, 1, 2) if training
+        donate = ((0, 1, 2) if training and donate_ok
                   and os.environ.get("HETU_NO_DONATE") != "1" else ())
         return jax.jit(step_fn, donate_argnums=donate)
 
@@ -722,7 +850,9 @@ class SubExecutor:
                 min(1.0, ps_comm_ms / step_ms))
         if compiled_now:
             tm["compiles"].inc()
-            if len(self._compiled) > 1:
+            # recompile churn counts distinct SHAPE signatures, not the
+            # hetuscope cadence/poison variants of the same signature
+            if len(self._base_sigs) > 1:
                 tm["recompiles"].inc()
             mon = ex._tel_recompile_mon
             if mon is not None:
@@ -766,6 +896,85 @@ class SubExecutor:
         if ps is not None and step % self._tel_ps_every == 0:
             for row in ps.telemetry_stats():
                 tel.record(**row)
+
+    # -- hetuscope helpers --------------------------------------------------
+    def _default_poison_scope(self) -> Optional[str]:
+        """Target of a ``nan_op@step`` fault with no explicit op name: the
+        first computing node in topological order."""
+        for n in self.topo:
+            if n.inputs and not n.is_optimizer:
+                return _op_scope(n)
+        return None
+
+    def _host_lr(self) -> Optional[float]:
+        """Best-effort host-visible learning rate for the flight record
+        (None for purely traced schedules)."""
+        for n in self.optimizer_nodes:
+            lr = n.optimizer.learning_rate
+            try:
+                return float(lr.get()) if hasattr(lr, "get") else float(lr)
+            except (TypeError, ValueError):
+                continue
+        return None
+
+    def _flight_cursors(self) -> Optional[dict]:
+        """Dataloader positions (host cursors + device-resident cursors)
+        for the flight record — with the batch crc32 and the step's RNG
+        fold, enough to re-point a replay at the failing batch."""
+        out = {}
+        for n in self.host_dl_nodes:
+            dl = getattr(n, "dataloaders", {}).get(self.name)
+            cur = getattr(dl, "_cursor", None)
+            if cur is not None:
+                out[n.name] = int(cur)
+        for n in self.res_dl_nodes:
+            out[n.name] = int(self._dl_cursor.get(id(n), 0))
+        return out or None
+
+    def _loss_at_trip(self, outputs) -> Optional[float]:
+        """The first scalar float eval output (the loss, by convention) as
+        a host float — read only on a guard trip, where the step already
+        synced on the finite flag."""
+        for n, v in zip(self.eval_nodes, outputs):
+            if n.is_optimizer:
+                continue
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
+                    and getattr(v, "size", 0) == 1:
+                return float(np.asarray(v))
+        return None
+
+    def _provenance_replay(self, step, base_key, feed_vals, batch_vals,
+                           dl_cursors, res_data, ps_staged_vals,
+                           ps_dense_vals, inject_nan, poison_scope):
+        """Debug sub-executor for NaN/Inf provenance: re-run the failing
+        step bit-identically — the guard's gated commit left params/slots/
+        op-state at their pre-step values, the step number re-seeds the
+        same RNG fold, and the feed/batch device arrays were not donated —
+        through a no-donation stats variant of the same program, then
+        localize the first op (topological order) that emitted non-finite
+        values. Compile cost is paid once per signature, only after a
+        trip."""
+        ex = self.executor
+        rkey = base_key + (poison_scope,)
+        fn = self._replay_compiled.get(rkey)
+        if fn is None:
+            fn = self._build(introspect_now=True, poison_scope=poison_scope,
+                             donate_ok=False)
+            self._replay_compiled[rkey] = fn
+        params_t = tuple(ex.state["params"][id(n)] for n in ex.param_nodes)
+        slots_t = tuple(ex.state["slots"][id(n)]
+                        for n in self.optimizer_nodes)
+        opstate_t = tuple(ex.state["op_state"][id(n)]
+                          for n in self.stateful_nodes)
+        args = (params_t, slots_t, opstate_t, ex.rng_root, np.int32(step),
+                tuple(feed_vals), tuple(batch_vals), tuple(dl_cursors),
+                res_data, tuple(ps_staged_vals), tuple(ps_dense_vals),
+                np.bool_(inject_nan))
+        from ..telemetry import scope as _scope
+        *_rest, stats_t = fn(*args)
+        order, inputs_map, spec = self._scope_meta
+        stats = _scope.host_stats(spec, stats_t)
+        return _scope.find_culprit(order, inputs_map, stats, step)
 
     def _lowered(self):
         """Re-lower the latest executed step (hits the compilation cache)."""
@@ -857,13 +1066,15 @@ class SubExecutor:
         ex = self.executor
         prof = self._profile  # HETU_PROFILE=1: per-phase wall-time ledger
         tel = ex.telemetry   # None when telemetry is off (the only check)
-        timed = prof is not None or tel is not None
+        intro = ex.introspector if self.introspect else None
+        timed = prof is not None or tel is not None or intro is not None
         t_run0 = time.perf_counter() if timed else 0.0
+        step = ex.state["step"]
         # resilience supervisor (watchdog beat, host fault injection);
         # training targets only — an eval pass is not a supervised step
         sup = getattr(ex, "supervisor", None) if self.training else None
         if sup is not None:
-            sup.pre_step(ex, self, ex.state["step"])
+            sup.pre_step(ex, self, step)
         feed_dict = feed_dict or {}
         feed_vals = []
         for node in self.feed_nodes:
@@ -938,23 +1149,35 @@ class SubExecutor:
         if prof is not None:
             prof["prestep_s"] += t_pre - t_run0
 
-        key = self._signature(feed_vals, batch_vals) + (
+        # hetuscope: cadence-gated stats variant + nan_op fault poisoning.
+        # Variants key the compile cache alongside the shape signature;
+        # _base_sigs keeps recompile accounting blind to them.
+        introspect_now = intro is not None and step % intro.cadence == 0
+        poison_scope = None
+        if sup is not None and hasattr(sup, "poison_op"):
+            p = sup.poison_op(step)
+            if p is not None:
+                poison_scope = p or self._default_poison_scope()
+
+        base_key = self._signature(feed_vals, batch_vals) + (
             tuple(tuple(v.shape) for v in ps_staged_vals),)
+        key = base_key + (introspect_now, poison_scope)
         fn = self._compiled.get(key)
         compiled_now = fn is None
         t_c0 = t_c1 = t_pre
         if fn is None:
             t_c0 = time.perf_counter() if timed else 0.0
-            fn = self._build()
+            fn = self._build(introspect_now=introspect_now,
+                             poison_scope=poison_scope)
             self._compiled[key] = fn
             t_c1 = time.perf_counter() if timed else 0.0
             if prof is not None:
                 prof["trace_build_s"] += t_c1 - t_c0
+        self._base_sigs.add(base_key)
 
         params_t = tuple(ex.state["params"][id(n)] for n in ex.param_nodes)
         slots_t = tuple(ex.state["slots"][id(n)] for n in self.optimizer_nodes)
         opstate_t = tuple(ex.state["op_state"][id(n)] for n in self.stateful_nodes)
-        step = ex.state["step"]
 
         res_data = tuple(self.resident_dl[id(n)][0]
                          for n in self.res_dl_nodes)
@@ -975,10 +1198,10 @@ class SubExecutor:
             # trace is active (the XLA window above, or an external capture)
             with _XW.step_annotation(step):
                 outputs, new_params, new_slots, new_opstate, ps_grads, \
-                    finite_t = fn(*args)
+                    finite_t, scope_stats_t = fn(*args)
         else:
-            outputs, new_params, new_slots, new_opstate, ps_grads, finite_t = \
-                fn(*args)
+            outputs, new_params, new_slots, new_opstate, ps_grads, finite_t, \
+                scope_stats_t = fn(*args)
         t_d1 = time.perf_counter() if timed else 0.0
         if prof is not None:
             prof["dispatch_s"] += t_d1 - t_d0
@@ -1055,6 +1278,55 @@ class SubExecutor:
                     ex._tel_metrics["anomalies"].inc()
             ex.state["last_step_finite"] = finite
 
+        # -- hetuscope: stats fetch, flight record, NaN/Inf provenance ------
+        prov = None
+        if intro is not None:
+            from ..telemetry import scope as _scope
+            stats_host = None
+            if self.anomaly_guard and not finite:
+                if introspect_now:
+                    # the failing step WAS a stats step, and the guard's
+                    # finite check already synced it: its own packed table
+                    # localizes the culprit, no replay needed
+                    stats_host = _scope.host_stats(self._scope_meta[2],
+                                                   scope_stats_t)
+                    order, inputs_map = self._scope_meta[:2]
+                    prov = _scope.find_culprit(order, inputs_map,
+                                               stats_host, step)
+                else:
+                    prov = self._provenance_replay(
+                        step, base_key, feed_vals, batch_vals, dl_cursors,
+                        res_data, ps_staged_vals, ps_dense_vals, inject_nan,
+                        poison_scope)
+            rec = {"sub": self.name, "step": int(step),
+                   "step_ms": round((time.perf_counter() - t_run0) * 1e3, 4),
+                   "finite": bool(finite), "seed": int(self.config.seed),
+                   "lr": self._host_lr(),
+                   "batch_crc32": _flight_crc(feed_dict, batch_host),
+                   "cursors": self._flight_cursors()}
+            intro.record_step(rec, stats=stats_host)
+            if introspect_now and stats_host is None:
+                # DEFER the cadence fetch: materializing the packed vector
+                # now would block on this step's compute and stall the
+                # dispatch pipeline (measured: the stall, not the fused
+                # reductions, dominated the overhead). It resolves at the
+                # next step boundary / flush / first read, mutating the
+                # ring record in place and exporting the hetu_scope_*
+                # gauges + scope JSONL row then.
+                def _resolve(vec=scope_stats_t, spec=self._scope_meta[2],
+                             name=self.name, s=int(step), tel=tel,
+                             intro=intro):
+                    stats = _scope.host_stats(spec, vec)
+                    if tel is not None:
+                        intro.export(tel, name, s, stats)
+                    return stats
+
+                intro.defer(rec, _resolve)
+            elif tel is not None and stats_host is not None:
+                intro.export(tel, self.name, step, stats_host)
+            if prov is not None:
+                intro.on_anomaly(prov, telemetry=tel)
+
         t_end = time.perf_counter() if timed else 0.0
         if prof is not None:
             prof["poststep_s"] += t_end - t_d1
@@ -1069,9 +1341,22 @@ class SubExecutor:
 
         # post-step supervision LAST: a rollback rewrites ex.state, an
         # emergency save captures it, and Preempted aborts the return — all
-        # only valid after the commit above
+        # only valid after the commit above. On a trip the anomaly event
+        # carries the headline numbers (loss at trip; global grad norm when
+        # provenance ran) so post-mortems don't need the flight recorder
+        # for them.
         if sup is not None:
-            sup.post_step(ex, self, step, finite=finite)
+            extra = {}
+            if self.anomaly_guard and not finite:
+                # the provenance stats already carry the at-trip loss —
+                # reuse them; the extra device fetch is only for guard-
+                # without-introspection runs
+                loss_v = prov.get("loss") if prov is not None else None
+                extra["loss"] = (loss_v if loss_v is not None
+                                 else self._loss_at_trip(outputs))
+                if prov is not None:
+                    extra["grad_norm"] = prov.get("grad_norm")
+            sup.post_step(ex, self, step, finite=finite, **extra)
 
         results = []
         wanted = eval_node_list if eval_node_list is not None else self.eval_nodes
@@ -1142,6 +1427,20 @@ class Executor:
                 peak_tflops_assumed=float(
                     os.environ.get("HETU_PEAK_TFLOPS", "197")),
                 comm_mode=str(config.comm_mode))
+
+        # -- numeric-health introspection (hetuscope) -----------------------
+        # Armed by HetuConfig(introspect=...) / HETU_INTROSPECT; None when
+        # off, and every scope point in SubExecutor.run gates on that one
+        # None check. The flight recorder shares the telemetry directory
+        # (flight/ subdir) so bin/hetuscope reads one place post-mortem.
+        self.introspector = None
+        if config.introspect:
+            from ..telemetry import scope as _scope
+            scope_dir = (self.telemetry.dir if self.telemetry is not None
+                         else os.environ.get("HETU_TELEMETRY_DIR",
+                                             "hetu_telemetry"))
+            self.introspector = _scope.Introspector(config.introspect,
+                                                    scope_dir)
 
         full_topo = find_topo_sort(all_nodes)
         # any variable read through an embedding lookup is a sparse embedding
@@ -1511,10 +1810,14 @@ class Executor:
     def close(self):
         """Drain and stop the PS async I/O threads (reference worker
         Finalize). Safe to call more than once; training can resume on the
-        synchronous path afterwards."""
+        synchronous path afterwards. Also detaches this executor's
+        hetuscope introspector so later abort flushes don't rewrite a
+        finished run's flight file."""
         if self.ps_runtime is not None:
             self.ps_runtime.drain()
             self.ps_runtime.shutdown()
+        if self.introspector is not None:
+            self.introspector.close()
 
     def fetch_dense_parameter_value(self, nodes):
         """Reference executor.py:1236 — current parameter values (PS-hosted

@@ -21,11 +21,26 @@ from .graph.gradients import gradients
 
 
 class Optimizer:
-    """Base optimizer holding the lr (float or an ``lr_scheduler``)."""
+    """Base optimizer holding the lr (float or an ``lr_scheduler``).
 
-    def __init__(self, learning_rate, l2reg=0.0):
+    ``clip_grad_norm`` clips the GLOBAL gradient norm (all trainable vars
+    together, torch ``clip_grad_norm_`` semantics) before the update rule;
+    the fused norm reduction it computes is published to the trace context
+    so the hetuscope introspection pass reuses it instead of re-reducing
+    (one computation, two consumers). PS-resident parameters update
+    server-side per gradient push and are NOT clipped (their grads never
+    reach ``apply_dense``); the norm is taken over the locally-applied
+    gradients only.
+    """
+
+    def __init__(self, learning_rate, l2reg=0.0, clip_grad_norm=None):
         self.learning_rate = learning_rate
         self.l2reg = float(l2reg)
+        if clip_grad_norm is not None and float(clip_grad_norm) <= 0:
+            raise ValueError(
+                f"clip_grad_norm must be > 0, got {clip_grad_norm}")
+        self.clip_grad_norm = (None if clip_grad_norm is None
+                               else float(clip_grad_norm))
 
     # -- graph construction -------------------------------------------------
     def minimize(self, loss, var_list: Optional[Sequence[Op]] = None):
@@ -71,8 +86,8 @@ class Optimizer:
 
 
 class SGDOptimizer(Optimizer):
-    def __init__(self, learning_rate=0.01, l2reg=0.0):
-        super().__init__(learning_rate, l2reg)
+    def __init__(self, learning_rate=0.01, l2reg=0.0, clip_grad_norm=None):
+        super().__init__(learning_rate, l2reg, clip_grad_norm)
 
     def apply_dense(self, param, grad, slot, lr):
         grad = self._regularized(param, grad)
@@ -80,8 +95,9 @@ class SGDOptimizer(Optimizer):
 
 
 class MomentumOptimizer(Optimizer):
-    def __init__(self, learning_rate=0.01, momentum=0.9, nesterov=False, l2reg=0.0):
-        super().__init__(learning_rate, l2reg)
+    def __init__(self, learning_rate=0.01, momentum=0.9, nesterov=False,
+                 l2reg=0.0, clip_grad_norm=None):
+        super().__init__(learning_rate, l2reg, clip_grad_norm)
         self.momentum = float(momentum)
         self.nesterov = nesterov
 
@@ -100,8 +116,8 @@ class MomentumOptimizer(Optimizer):
 
 class AdaGradOptimizer(Optimizer):
     def __init__(self, learning_rate=0.01, initial_accumulator_value=0.0,
-                 eps=1e-7, l2reg=0.0):
-        super().__init__(learning_rate, l2reg)
+                 eps=1e-7, l2reg=0.0, clip_grad_norm=None):
+        super().__init__(learning_rate, l2reg, clip_grad_norm)
         self.initial_accumulator_value = float(initial_accumulator_value)
         self.eps = float(eps)
 
@@ -117,8 +133,9 @@ class AdaGradOptimizer(Optimizer):
 
 class AdamOptimizer(Optimizer):
     def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
-                 epsilon=1e-7, l2reg=0.0, weight_decay=0.0):
-        super().__init__(learning_rate, l2reg)
+                 epsilon=1e-7, l2reg=0.0, weight_decay=0.0,
+                 clip_grad_norm=None):
+        super().__init__(learning_rate, l2reg, clip_grad_norm)
         self.beta1 = float(beta1)
         self.beta2 = float(beta2)
         self.epsilon = float(epsilon)
@@ -143,9 +160,10 @@ class AdamOptimizer(Optimizer):
 
 class AdamWOptimizer(AdamOptimizer):
     def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
-                 epsilon=1e-7, weight_decay=0.01):
+                 epsilon=1e-7, weight_decay=0.01, clip_grad_norm=None):
         super().__init__(learning_rate, beta1, beta2, epsilon,
-                         l2reg=0.0, weight_decay=weight_decay)
+                         l2reg=0.0, weight_decay=weight_decay,
+                         clip_grad_norm=clip_grad_norm)
 
 
 class OptimizerOp(Op):
@@ -194,6 +212,24 @@ class OptimizerOp(Op):
 
     def apply_updates(self, env, slots, tc):
         lr = self.optimizer.lr_value(tc.step)
+        clip = self.optimizer.clip_grad_norm
+        scale = None
+        if clip is not None:
+            # global-norm clipping over every locally-applied gradient —
+            # ONE fused reduction, published on the trace context so the
+            # hetuscope introspection stats reuse it instead of
+            # re-reducing (scope.traced_stats' grad_global_norm input)
+            sq = []
+            for grad_node in self.inputs:
+                g = env[id(grad_node)]
+                if g is None or isinstance(g, tuple):
+                    continue  # PS-managed: the server applies the update
+                gf = g.astype(jnp.float32) if g.dtype != jnp.float32 else g
+                sq.append(jnp.sum(gf * gf))
+            if sq:
+                gnorm = jnp.sqrt(sum(sq))
+                tc.grad_global_norm = gnorm
+                scale = jnp.minimum(1.0, clip / (gnorm + 1e-12))
         new_slots = []
         for var, grad_node, slot in zip(self.vars, self.inputs, slots):
             # mixed precision: update the f32 master copy, not the (possibly
@@ -205,6 +241,8 @@ class OptimizerOp(Op):
                 continue
             if hasattr(grad, "dtype") and grad.dtype != param.dtype:
                 grad = grad.astype(param.dtype)
+            if scale is not None:
+                grad = grad * scale.astype(param.dtype)
             new_param, new_slot = self.optimizer.apply_dense(param, grad, slot, lr)
             tc.param_updates[id(var)] = new_param
             new_slots.append(new_slot)

@@ -92,6 +92,17 @@ def _tel_event(name: str, flush: bool = False, **fields) -> None:
         pass
 
 
+def _flight_flush(reason: str) -> None:
+    """Flush any armed hetuscope flight recorder (telemetry/scope.py) on an
+    abort path — the ring of recent step records must be on disk before the
+    process dies. No-op when introspection is off; never raises."""
+    try:
+        from .telemetry import scope as _scope
+        _scope.flush_flight(reason)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Fault injection
 # ---------------------------------------------------------------------------
@@ -108,6 +119,11 @@ class FaultInjector:
 
     - ``nan_grads@S`` — the executor poisons that step's parameter update
       with NaN inside the trace (exercises the anomaly guard end to end).
+    - ``nan_op@S[:OPNAME]`` — the executor NaN-poisons one op's OUTPUT
+      inside the trace at step S (``OPNAME`` is the op's named_scope
+      identity, ``/``/whitespace replaced by ``_``; default: the first
+      computing op in topological order) — the deterministic seed the
+      hetuscope NaN/Inf provenance pass must localize.
     - ``stall@S:SECONDS`` — sleep at the step boundary (trips the watchdog).
     - ``sigterm@S`` / ``sigint@S`` — deliver the signal to this process
       (exercises preemption handling).
@@ -122,7 +138,8 @@ class FaultInjector:
     explicit opt-in for tests.
     """
 
-    KINDS = ("nan_grads", "stall", "sigterm", "sigint", "crash", "ps_kill")
+    KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
+             "ps_kill")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -137,9 +154,13 @@ class FaultInjector:
                     f"bad fault entry {part!r}: expected kind@step[:arg] with "
                     f"kind in {self.KINDS}")
             step_s, _, arg_s = rest.partition(":")
+            # nan_op's arg is an OP NAME, every other kind's a number
+            arg = None
+            if arg_s:
+                arg = arg_s if kind == "nan_op" else float(arg_s)
             self.entries.append({
                 "kind": kind, "step": int(step_s),
-                "arg": float(arg_s) if arg_s else None, "fired": False,
+                "arg": arg, "fired": False,
             })
 
     @classmethod
@@ -269,6 +290,7 @@ class Watchdog:
         finally:
             _tel_event("watchdog_fire", flush=True, phase=phase, step=step,
                        elapsed_s=round(elapsed, 1))
+            _flight_flush("watchdog")
             try:
                 stream.flush()
             except Exception:  # noqa: BLE001 — never let flush mask the abort
@@ -598,13 +620,39 @@ class Supervisor:
         fi = self.fault_injector
         return fi is not None and fi.fires("nan_grads", step)
 
-    def post_step(self, ex, sub, step: int, finite: bool = True) -> None:
+    def poison_op(self, step: int) -> Optional[str]:
+        """The op whose output this step's trace should NaN-poison
+        (consumes the ``nan_op`` fault entry): None = no poison, ``""`` =
+        the executor's default first op, else the op's scope name."""
+        fi = self.fault_injector
+        if fi is None:
+            return None
+        e = fi.take("nan_op", step)
+        if e is None:
+            return None
+        return e["arg"] or ""
+
+    def post_step(self, ex, sub, step: int, finite: bool = True,
+                  loss=None, grad_norm=None) -> None:
+        """``loss``/``grad_norm`` are the at-trip headline numbers the
+        executor passes on a non-finite step (loss is NaN/Inf by
+        construction — that IS the headline; grad_norm arrives when the
+        hetuscope provenance pass ran) — recorded in the anomaly event so
+        post-mortems need not open the flight recorder for them."""
         if self.watchdog is not None:
             self.watchdog.beat(phase=f"{sub.name}:post_step", step=step)
         action = self.anomaly.note(bool(finite))
         if not finite:
+            from .telemetry.scope import json_num
+            extra = {}
+            if loss is not None:
+                # non-finite (the usual case at a trip) serializes as the
+                # string "NaN"/"Infinity" — the JSONL must stay strict JSON
+                extra["loss"] = json_num(loss)
+            if grad_norm is not None:
+                extra["grad_norm"] = json_num(grad_norm)
             _tel_event("anomaly", step=step, action=action,
-                       streak=self.anomaly.streak)
+                       streak=self.anomaly.streak, **extra)
         if action == "rollback":
             self._rollback(ex)
         elif action == "ok" and self.ckptr is not None and self.ckpt_every \
@@ -630,6 +678,7 @@ class Supervisor:
             _tel_event("preempted", flush=True, step=step,
                        signum=self.preemption.signum,
                        durable_step=self.last_saved_step)
+            _flight_flush("preempted")
             raise Preempted(step)
 
     # -- checkpoint plumbing ------------------------------------------------
@@ -718,6 +767,7 @@ def supervise(loop_fn, ckptr=None, *, max_restarts: int = 3,
                   f"exiting {EXIT_PREEMPTED}", file=sys.stderr)
             raise SystemExit(EXIT_PREEMPTED)
         except recoverable as e:
+            _flight_flush("crash")
             restarts += 1
             if restarts > max_restarts:
                 raise

@@ -1,6 +1,8 @@
 """Runtime telemetry: metrics registry + structured tracing + JSONL sinks.
 
-Three pillars (docs/OBSERVABILITY.md):
+Pillars (docs/OBSERVABILITY.md; numeric health lives in :mod:`.scope` —
+hetuscope introspection, NaN/Inf provenance, flight recorder — and is
+armed separately via ``HetuConfig(introspect=...)``):
 
 - **Metrics** — process-wide counters/gauges/histograms
   (:mod:`.registry`), snapshotted into a per-step JSONL record and exported

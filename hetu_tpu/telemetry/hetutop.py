@@ -14,6 +14,7 @@ import argparse
 import collections
 import glob
 import json
+import math
 import os
 import sys
 import time
@@ -69,7 +70,7 @@ def check_dir(dir_path: str, out=sys.stdout) -> int:
               file=out)
         return 1
     errors: list[str] = []
-    n_steps = n_events = n_ps = 0
+    n_steps = n_events = n_ps = n_scope = 0
     step_ms: list[float] = []
     last_metrics: Optional[dict] = None   # None = no snapshot seen at all
     ps_last: dict = {}
@@ -105,6 +106,13 @@ def check_dir(dir_path: str, out=sys.stdout) -> int:
                     continue
                 n_ps += 1
                 ps_last[rec["server"]] = rec
+            elif kind == "scope":
+                # hetuscope numeric-health row (cadence steps only)
+                missing = [k for k in ("sub", "step") if k not in rec]
+                if missing:
+                    errors.append(f"{path}: scope record missing {missing}")
+                    continue
+                n_scope += 1
             elif kind is None:
                 errors.append(f"{path}: record missing 'kind'")
     for msg in errors[:20]:
@@ -122,7 +130,7 @@ def check_dir(dir_path: str, out=sys.stdout) -> int:
     # reads: step time, recompile count, PS latency + snapshot age
     rec_count = last_metrics.get("hetu_recompiles_total")
     print(f"hetutop --check: {len(files)} rank file(s), {n_steps} step, "
-          f"{n_events} event, {n_ps} ps_server record(s); "
+          f"{n_events} event, {n_ps} ps_server, {n_scope} scope record(s); "
           f"step_ms p50={_pctl(step_ms, 50):.3f} "
           f"recompiles={rec_count if rec_count is not None else 'n/a'}",
           file=out)
@@ -169,10 +177,12 @@ class Follower:
         self._offsets: dict = {}
         self._recs: dict = {}
         # once-per-run records (run_info/model_info) and slow-cadence rows
-        # (ps_server) must survive eviction from the bounded buffers
+        # (ps_server, hetuscope scope) must survive eviction from the
+        # bounded buffers
         self._sticky_run_info: dict = {}
         self._sticky_model: dict = {}
         self._sticky_ps: dict = {}
+        self._sticky_scope: dict = {}
 
     def _poll_file(self, path: str):
         buf = self._recs.get(path)
@@ -214,15 +224,17 @@ class Follower:
         self._sticky_run_info.update(state["run_info"])
         self._sticky_model.update(state["model"])
         self._sticky_ps.update(state["ps"])
+        self._sticky_scope.update(state["scope"])
         state["run_info"] = dict(self._sticky_run_info)
         state["model"] = dict(self._sticky_model)
         state["ps"] = dict(self._sticky_ps)
+        state["scope"] = dict(self._sticky_scope)
         return state
 
 
 def _aggregate(recs_by_file: dict) -> dict:
     state: dict = {"ranks": {}, "events": [], "ps": {}, "run_info": {},
-                   "model": {}}
+                   "model": {}, "scope": {}}
     for path, recs in recs_by_file.items():
         steps = [r for r in recs if r.get("kind") == "step"
                  and all(k in r for k in STEP_REQUIRED)]
@@ -240,6 +252,9 @@ def _aggregate(recs_by_file: dict) -> dict:
                 # model geometry (telemetry.record_model_info) unlocks the
                 # analytic attention-inclusive MFU denominator
                 state["model"].update(r)
+            elif kind == "scope":
+                # latest hetuscope numeric-health row per rank
+                state["scope"][r.get("rank", 0)] = r
             if kind in ("step", "final") and isinstance(
                     r.get("metrics"), dict):
                 m = r["metrics"]   # latest snapshot wins
@@ -273,6 +288,21 @@ def _aggregate(recs_by_file: dict) -> dict:
 
 def _fmt(v, spec=".1f", na="  n/a") -> str:
     return na if v is None else format(v, spec)
+
+
+def _defloat(v):
+    """A recorded number back as a float — hetuscope serializes non-finite
+    values as the strings "NaN"/"Infinity" to keep the JSONL strict JSON;
+    float() parses them back. None on anything non-numeric."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _finite(v):
+    f = _defloat(v)
+    return f if f is not None and math.isfinite(f) else None
 
 
 def _metric_children(m: dict, base: str, suffix: str):
@@ -363,6 +393,37 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
                           + (f" live {live / 2**20:.0f}MiB" if live else ""))
         if extras:
             lines.append("      " + "  |  ".join(extras))
+    if state.get("scope"):
+        # hetuscope numeric health (docs/OBSERVABILITY.md): latest cadence
+        # row per rank — global grad norm, worst layer, update ratio,
+        # non-finite op count
+        lines.append("numeric health (hetuscope):")
+        for rank in sorted(state["scope"]):
+            s = state["scope"][rank]
+            params = s.get("params") or {}
+            worst = max(params.items(),
+                        key=lambda kv: _finite(kv[1].get("grad_norm"))
+                        or 0.0,
+                        default=None)
+            # _finite filters None, NaN (zero-norm params) and the "NaN"
+            # strings a trip row serializes
+            ratios = [r for d in params.values()
+                      if (r := _finite(d.get("update_ratio"))) is not None]
+            ops = s.get("ops") or {}
+            nonfin = [k for k, v in ops.items()
+                      if (_defloat(v.get("nonfinite")) or 0.0) > 0]
+            line = (f"  r{rank} step {s.get('step')}: "
+                    f"loss {_fmt(_defloat(s.get('loss')), '.4g', 'n/a')} "
+                    f"grad_norm "
+                    f"{_fmt(_defloat(s.get('grad_norm')), '.4g', 'n/a')}")
+            if worst is not None:
+                line += (f"  worst layer {worst[0]} "
+                         f"({_finite(worst[1].get('grad_norm')) or 0.0:.3g})")
+            if ratios:
+                line += f"  upd/param max {max(ratios):.3g}"
+            line += (f"  NONFINITE: {', '.join(nonfin[:4])}" if nonfin
+                     else "  nonfinite ops: 0")
+            lines.append(line)
     if state["ps"]:
         lines.append("PS servers:")
         for sid in sorted(state["ps"]):

@@ -1,0 +1,743 @@
+"""hetuscope — in-graph training-dynamics introspection, NaN/Inf provenance,
+and the crash flight recorder (docs/OBSERVABILITY.md "numeric health").
+
+Three pieces, armed together by ``HetuConfig(introspect=...)`` /
+``HETU_INTROSPECT`` (off by default, same None-check-only contract as
+telemetry — with introspection off the executor performs ZERO scope work,
+asserted by tests/test_scope.py):
+
+- **In-graph stats** — on a step cadence the executor compiles a stats
+  variant of the jitted step that fuses per-parameter and per-op scalar
+  reductions into the program (grad global/per-layer norm, update/param
+  ratio, activation rms/absmax, %-nonfinite), keyed by the ``named_scope``
+  op identity hetuprof already uses. The whole table returns as ONE extra
+  fetch per cadence step — no per-stat host round trips.
+  :func:`traced_stats` builds the reductions (called during jit trace);
+  :func:`host_stats` materializes the table host-side.
+- **NaN/Inf provenance** — when the anomaly guard trips, the executor
+  re-runs the failing step bit-identically (same pre-step state, batch and
+  RNG fold; the guard's gated commit preserved all three) through a
+  no-donation debug variant of the same stats program, and
+  :func:`find_culprit` names the FIRST op in topological order that
+  emitted non-finite values from all-finite inputs — turning "step 412 was
+  NaN" into "layer3/matmul overflowed, input absmax 6.4e4".
+- **Flight recorder** — :class:`FlightRecorder` keeps a bounded ring of
+  the last K step records (loss, grad norm, step time, lr, dataloader
+  cursors + batch checksum, finiteness) and flushes it atomically to
+  ``<telemetry_dir>/flight/flight-r<rank>.json`` on anomaly, watchdog
+  abort, preemption (SIGTERM/SIGINT) and crash-restart — every resilience
+  abort path calls :func:`flush_flight`.
+
+``bin/hetuscope`` renders the post-mortem report from a telemetry
+directory (flight ring + ``kind:"scope"`` JSONL records +
+``nan_provenance`` events); ``--check`` is the CI schema smoke.
+
+Stdlib-only at import (``bin/hetuscope`` loads this file by path, jax-free,
+like ``bin/hetuprof`` does with profiler.py); jax is imported lazily inside
+the two traced/host helpers the executor calls.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+DEFAULT_CADENCE = 10          # steps between in-graph stats fetches
+DEFAULT_FLIGHT_K = 64         # flight-ring depth (HETU_FLIGHT_K)
+FLIGHT_SCHEMA = 1
+
+_OFFISH = ("", "0", "off", "false", "no", "none")
+_ONISH = ("1", "on", "true", "yes")
+
+
+def resolve_introspect(value=None) -> int:
+    """One spelling of the arming resolution, returning the stats cadence in
+    steps (0 = off). ``True``/``"on"``/``"1"`` arm at :data:`DEFAULT_CADENCE`
+    (overridable via ``HETU_INTROSPECT_EVERY``); an integer (or numeric
+    string) >= 1 is an explicit cadence; ``None`` falls back to the
+    ``HETU_INTROSPECT`` env var; anything falsy is off."""
+    if value is None:
+        value = os.environ.get("HETU_INTROSPECT", "")
+    if isinstance(value, bool):
+        value = "on" if value else "off"
+    if isinstance(value, (int, float)):
+        n = int(value)
+        if n < 0:
+            raise ValueError(f"introspect cadence must be >= 0, got {n}")
+        return n
+    value = str(value).strip().lower()
+    if value in _OFFISH:
+        return 0
+    if value in _ONISH:
+        return max(1, int(os.environ.get("HETU_INTROSPECT_EVERY",
+                                         str(DEFAULT_CADENCE))))
+    n = int(value)
+    if n < 0:   # same validation as the int branch — "-5" must not arm
+        raise ValueError(f"introspect cadence must be >= 0, got {n}")
+    return max(1, n)
+
+
+def json_num(v):
+    """A number as a strict-JSON-safe value: non-finite floats become the
+    strings "NaN"/"Infinity"/"-Infinity" (Python's ``float()`` parses them
+    back). The post-mortem artifacts exist precisely for runs whose losses
+    ARE NaN — bare NaN tokens would make them invalid for every non-Python
+    consumer (jq, browsers, log pipelines)."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return v
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "Infinity"
+    if f == float("-inf"):
+        return "-Infinity"
+    return f
+
+
+def json_safe(obj):
+    """Recursively apply :func:`json_num` to a dict/list tree (copies —
+    never mutates the flight ring's live records)."""
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, float):
+        return json_num(obj)
+    return obj
+
+
+def default_rank() -> int:
+    """Rank identity for flight file names — the launcher's WORKER_ID, same
+    convention as the telemetry package (re-inlined: this module is loaded
+    by file path from ``bin/hetuscope``, outside the package)."""
+    try:
+        return int(os.environ.get("WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# traced reductions (called from INSIDE the executor's jit trace)
+# ---------------------------------------------------------------------------
+
+def traced_stats(op_entries, param_entries, loss_val=None,
+                 grad_global_norm=None):
+    """The fused in-graph stats, PACKED: returns ``(spec, vector)`` where
+    ``vector`` is one stacked f32 array of every scalar reduction and
+    ``spec`` names each slot. The step program returns the single vector
+    (literally one extra fetch — materializing dozens of separate device
+    scalars measured ~3x the whole table's cost); ``spec`` is trace-time
+    metadata the executor stores host-side and feeds to
+    :func:`host_stats`.
+
+    ``op_entries`` — ``[(scope_key, traced_value)]`` for every float-typed
+    node output (activations, grads, comm outputs, fed inputs), in
+    topological order. ``param_entries`` — ``[(name, grad, old, new)]`` per
+    trainable parameter (``old``/``new`` may be None for PS-resident ones).
+    ``grad_global_norm`` reuses a norm an optimizer with ``clip_grad_norm``
+    already computed (one computation, two consumers) instead of
+    re-reducing."""
+    import jax.numpy as jnp
+    eps = 1e-12
+    spec: list = []
+    vals: list = []
+
+    def emit(path, v):
+        spec.append(path)
+        vals.append(v.astype(jnp.float32))
+
+    for key, v in op_entries:
+        vf = v.astype(jnp.float32)
+        fin = jnp.isfinite(vf)
+        safe = jnp.where(fin, vf, 0.0)
+        # absmax/rms over the FINITE values: a single inf must not erase
+        # the "how close to overflow was the rest" signal
+        emit(("ops", key, "absmax"), jnp.max(jnp.abs(safe)))
+        emit(("ops", key, "rms"), jnp.sqrt(jnp.mean(safe * safe)))
+        emit(("ops", key, "nonfinite"),
+             jnp.mean((~fin).astype(jnp.float32)))
+    sq_terms = []
+    for name, grad, old, new in param_entries:
+        gf = grad.astype(jnp.float32)
+        sq = jnp.sum(gf * gf)
+        sq_terms.append(sq)
+        emit(("params", name, "grad_norm"), jnp.sqrt(sq))
+        if old is not None and new is not None:
+            of = old.astype(jnp.float32)
+            nf = new.astype(jnp.float32)
+            den = jnp.sqrt(jnp.sum(of * of))
+            # undefined (NaN) for an all-zero parameter — an eps
+            # denominator would report a meaningless 1e10 "ratio" for
+            # every zero-initialized bias; consumers filter NaN
+            emit(("params", name, "update_ratio"),
+                 jnp.where(den > 0,
+                           jnp.sqrt(jnp.sum((nf - of) ** 2))
+                           / jnp.maximum(den, eps),
+                           jnp.nan))
+    if grad_global_norm is not None:
+        gnorm = grad_global_norm
+    elif sq_terms:
+        gnorm = jnp.sqrt(sum(sq_terms))
+    else:
+        gnorm = jnp.float32(0.0)
+    emit(("grad_norm",), gnorm)
+    if loss_val is not None:
+        emit(("loss",), jnp.reshape(loss_val, ()))
+    return spec, jnp.stack(vals)
+
+
+def host_stats(spec, vec) -> dict:
+    """Rebuild the nested stats dict from the packed vector — ONE host
+    fetch; leaves become plain Python floats (JSON- and flight-safe)."""
+    import numpy as np
+    arr = np.asarray(vec)
+    out: dict = {"params": {}, "ops": {}}
+    for path, v in zip(spec, arr):
+        v = float(v)
+        if len(path) == 1:
+            out[path[0]] = v
+        else:
+            group, key, field = path
+            out[group].setdefault(key, {})[field] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# provenance: first non-finite op in topological order
+# ---------------------------------------------------------------------------
+
+def find_culprit(order, inputs_map, stats, step) -> dict:
+    """Localize the non-finite source from a per-op stats table.
+
+    ``order`` — scope keys in topological order; ``inputs_map`` —
+    ``{scope_key: [input scope keys]}`` (both recorded by the executor at
+    trace time); ``stats`` — the host-side table from :func:`host_stats`.
+    The culprit is the first op whose output is non-finite while every
+    table-known input is finite — everything after it is propagation, not
+    cause. Returns a provenance dict (``op`` is None when the poison
+    entered at the parameter-update/state level, e.g. the ``nan_grads``
+    injection, which never flows through an op output)."""
+    ops = stats.get("ops", {})
+    bad = [k for k in order if ops.get(k, {}).get("nonfinite", 0.0) > 0.0]
+    result = {
+        "step": int(step),
+        "nonfinite_ops": len(bad),
+        "grad_norm": stats.get("grad_norm"),
+        "loss": stats.get("loss"),
+    }
+    for k in bad:
+        ins = inputs_map.get(k, [])
+        if all(ops.get(i, {}).get("nonfinite", 0.0) == 0.0 for i in ins):
+            result["op"] = k
+            result["output"] = ops[k]
+            result["inputs"] = {
+                i: {"absmax": ops[i]["absmax"],
+                    "nonfinite": ops[i]["nonfinite"]}
+                for i in ins if i in ops}
+            return result
+    result["op"] = None
+    result["note"] = ("no op-level culprit: non-finite values entered at "
+                      "the parameter-update/optimizer-state level (e.g. an "
+                      "update-level injection), not through an op output")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded ring of per-step records, flushed atomically on abort.
+
+    ``record`` is the hot-path mutator (a locked deque append of an
+    already-host-side dict); ``flush`` writes the whole ring plus the abort
+    reason to ``<dir>/flight-r<rank>.json`` via tmp+rename and NEVER raises
+    — it runs on the watchdog/preemption/crash paths, where observability
+    must not take recovery down with it."""
+
+    def __init__(self, out_dir: str, rank: Optional[int] = None,
+                 k: Optional[int] = None):
+        self.dir = out_dir
+        self.rank = default_rank() if rank is None else int(rank)
+        if k is None:
+            k = int(os.environ.get("HETU_FLIGHT_K", str(DEFAULT_FLIGHT_K)))
+        self.k = max(1, int(k))
+        self._ring: collections.deque = collections.deque(maxlen=self.k)
+        self._lock = threading.Lock()
+        self.flushes = 0
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, f"flight-r{self.rank}.json")
+
+    def record(self, rec: dict) -> None:
+        # the SAME dict object enters the ring: a deferred stats
+        # resolution (Introspector.resolve_pending) mutates it in place
+        rec.setdefault("ts", round(time.time(), 3))
+        with self._lock:
+            self._ring.append(rec)
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def flush(self, reason: str, provenance: Optional[dict] = None
+              ) -> Optional[str]:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            with self._lock:
+                recs = list(self._ring)
+                self.flushes += 1
+            doc = {"schema": FLIGHT_SCHEMA, "reason": reason,
+                   "rank": self.rank, "k": self.k,
+                   "flushed_ts": round(time.time(), 3),
+                   "flushes": self.flushes,
+                   "records": json_safe(recs)}
+            if provenance is not None:
+                doc["provenance"] = json_safe(provenance)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, separators=(",", ":"), default=str)
+            os.replace(tmp, self.path)
+            return self.path
+        except Exception:  # noqa: BLE001 — abort paths must survive this
+            return None
+
+
+# ---------------------------------------------------------------------------
+# the Introspector: per-process hub the executor talks to
+# ---------------------------------------------------------------------------
+
+# armed introspectors, for the resilience abort hooks (flush_flight);
+# normally one per process, like the telemetry singleton
+_armed: list = []
+_lock = threading.Lock()
+
+
+class Introspector:
+    """Owns the cadence, the flight ring, and the latest stats/provenance.
+    Created by the Executor when ``HetuConfig(introspect=...)`` arms; the
+    executor is the only writer, dashboards/post-mortems the readers."""
+
+    def __init__(self, cadence: int, out_dir: str,
+                 rank: Optional[int] = None):
+        self.cadence = max(1, int(cadence))
+        self.dir = out_dir
+        self.flight = FlightRecorder(os.path.join(out_dir, "flight"),
+                                     rank=rank)
+        # deferred cadence fetch: (ring record, resolver) — materializing
+        # the packed stats vector right after dispatch would SYNC on the
+        # step and stall the dispatch pipeline; instead the executor
+        # defers it, and it resolves at the next step boundary (the step
+        # has long completed), on flush, or on first read
+        self._pending: Optional[tuple] = None
+        self._last_stats: Optional[dict] = None
+        self.last_provenance: Optional[dict] = None
+        with _lock:
+            _armed.append(self)
+
+    # -- per-step ----------------------------------------------------------
+    @property
+    def last_stats(self) -> Optional[dict]:
+        """Latest materialized stats table (resolves any pending fetch)."""
+        self.resolve_pending()
+        return self._last_stats
+
+    def defer(self, rec: dict, resolver) -> None:
+        """Park a cadence step's un-materialized stats: ``resolver()``
+        returns the host table (and exports it) when called."""
+        self.resolve_pending()
+        self._pending = (rec, resolver)
+
+    def resolve_pending(self) -> None:
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        rec, resolver = p
+        try:
+            stats = resolver()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return
+        rec["stats"] = stats       # the ring holds this same dict
+        self._last_stats = stats
+
+    def record_step(self, rec: dict, stats: Optional[dict] = None) -> None:
+        """One flight-ring entry per training step; ``stats`` rides along
+        immediately only when the caller already synced (a guard trip) —
+        cadence steps use :meth:`defer` instead."""
+        self.resolve_pending()
+        if stats is not None:
+            self._last_stats = stats
+            rec["stats"] = stats
+        self.flight.record(rec)
+
+    def export(self, telemetry, sub: str, step: int, stats: dict) -> None:
+        """Cadence-step export: ``hetu_scope_*`` gauges + one
+        ``kind:"scope"`` JSONL record (ops trimmed to the interesting rows
+        — every non-finite op plus the top absmax — so a wide graph does
+        not bloat the stream; the full table lives in the flight ring)."""
+        def fin(v):
+            return v is not None and v == v and abs(v) != float("inf")
+
+        # gauges only take FINITE values (a NaN gauge would leak bare NaN
+        # tokens into every later metrics snapshot); the non-finite story
+        # is told by hetu_scope_nonfinite_ops + the provenance event
+        g = telemetry.metrics.gauge
+        if fin(stats.get("grad_norm")):
+            g("hetu_scope_grad_norm").set(stats["grad_norm"])
+        if fin(stats.get("loss")):
+            g("hetu_scope_loss").set(stats["loss"])
+        params = stats.get("params", {})
+        ratios = [r for d in params.values()
+                  if fin(r := d.get("update_ratio"))]
+        if ratios:
+            g("hetu_scope_update_ratio_max").set(max(ratios))
+        ops = stats.get("ops", {})
+        if ops:
+            absmaxes = [d["absmax"] for d in ops.values()
+                        if fin(d.get("absmax"))]
+            if absmaxes:
+                g("hetu_scope_act_absmax").set(max(absmaxes))
+            g("hetu_scope_nonfinite_ops").set(
+                sum(1 for d in ops.values() if d["nonfinite"] > 0.0))
+        telemetry.record("scope", sub=sub, step=int(step),
+                         grad_norm=json_num(stats.get("grad_norm")),
+                         loss=json_num(stats.get("loss")),
+                         params=json_safe(params),
+                         ops=json_safe(trim_ops(ops)))
+
+    # -- abort paths -------------------------------------------------------
+    def flush(self, reason: str, provenance: Optional[dict] = None):
+        """Durable flush, resolving any pending stats first — EXCEPT on a
+        watchdog abort, where the device is presumed wedged and a blocking
+        fetch would hang the dump."""
+        if reason != "watchdog":
+            self.resolve_pending()
+        return self.flight.flush(reason, provenance=provenance)
+
+    def on_anomaly(self, provenance: dict, telemetry=None) -> None:
+        """Guard-trip hook: record + durably flush the ring with the
+        provenance verdict, and (when telemetry is on) emit the
+        ``nan_provenance`` event the acceptance demo reads."""
+        self.last_provenance = provenance
+        self.flight.record({"kind": "provenance", **provenance})
+        self.flush("anomaly", provenance=provenance)
+        if telemetry is not None:
+            try:
+                telemetry.event("nan_provenance", **json_safe(provenance))
+                telemetry.flush()
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+
+    def close(self) -> None:
+        with _lock:
+            if self in _armed:
+                _armed.remove(self)
+
+
+def get() -> Optional[Introspector]:
+    """The most recently armed introspector, or None (the per-call gate)."""
+    with _lock:
+        return _armed[-1] if _armed else None
+
+
+def flush_flight(reason: str) -> None:
+    """Flush every armed flight ring — called by the resilience abort paths
+    (watchdog fire, preemption, crash-restart). Never raises."""
+    with _lock:
+        recs = list(_armed)
+    for intro in recs:
+        try:
+            intro.flush(reason)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def shutdown() -> None:
+    """Detach every armed introspector (tests; also lets a long-lived
+    process re-arm against a fresh directory)."""
+    with _lock:
+        _armed.clear()
+
+
+def trim_ops(ops: dict, top: int = 8) -> dict:
+    """The JSONL-worthy subset of a per-op table: every op with non-finite
+    values, plus the ``top`` largest by absmax."""
+    keep = {k: v for k, v in ops.items() if v.get("nonfinite", 0.0) > 0.0}
+    by_absmax = sorted(ops.items(), key=lambda kv: -kv[1].get("absmax", 0.0))
+    for k, v in by_absmax[:top]:
+        keep.setdefault(k, v)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# post-mortem report + CI check (bin/hetuscope)
+# ---------------------------------------------------------------------------
+
+def flight_files(dir_path: str) -> list:
+    return sorted(glob.glob(os.path.join(dir_path, "flight",
+                                         "flight-r*.json")))
+
+
+def _load_flight(path: str, errors: list) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: unreadable ({e})")
+        return None
+    for k in ("schema", "reason", "rank", "records"):
+        if k not in doc:
+            errors.append(f"{path}: missing {k!r}")
+            return None
+    if not isinstance(doc["records"], list):
+        errors.append(f"{path}: 'records' is not a list")
+        return None
+    for i, rec in enumerate(doc["records"]):
+        if not isinstance(rec, dict):
+            errors.append(f"{path}: record {i} is not an object")
+            return None
+        if rec.get("kind") != "provenance" and "step" not in rec:
+            errors.append(f"{path}: step record {i} missing 'step'")
+            return None
+    return doc
+
+
+def _scan_metrics(dir_path: str):
+    """Scope records + nan_provenance events from the metrics JSONL (absent
+    files are fine — introspection also runs with telemetry off)."""
+    scopes, provs = [], []
+    for path in sorted(glob.glob(os.path.join(dir_path,
+                                              "metrics-r*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "scope":
+                        scopes.append(rec)
+                    elif rec.get("kind") == "event" \
+                            and rec.get("name") == "nan_provenance":
+                        provs.append(rec)
+        except OSError:
+            continue
+    return scopes, provs
+
+
+def _fmt_num(v, spec=".3g") -> str:
+    if v is None:
+        return "n/a"
+    try:
+        return format(float(v), spec)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _render_provenance(prov: dict, lines: list) -> None:
+    op = prov.get("op")
+    if op:
+        out = prov.get("output", {})
+        lines.append(
+            f"  first non-finite op (topological order): {op}"
+            f"  [step {prov.get('step')}]")
+        lines.append(
+            f"    output: nonfinite={_fmt_num(out.get('nonfinite'), '.1%')} "
+            f"absmax={_fmt_num(out.get('absmax'))} "
+            f"rms={_fmt_num(out.get('rms'))}")
+        for name, st in (prov.get("inputs") or {}).items():
+            lines.append(
+                f"    input {name}: absmax={_fmt_num(st.get('absmax'))} "
+                f"nonfinite={_fmt_num(st.get('nonfinite'), '.1%')}")
+    else:
+        lines.append(f"  [step {prov.get('step')}] "
+                     + prov.get("note", "no op-level culprit"))
+    lines.append(
+        f"    at trip: loss={_fmt_num(prov.get('loss'))} "
+        f"grad_norm={_fmt_num(prov.get('grad_norm'))} "
+        f"nonfinite ops downstream: {prov.get('nonfinite_ops')}")
+
+
+def render_report(dir_path: str, last: int = 12) -> str:
+    """The hetuscope post-mortem: flight ring tail, layer health, op
+    health, and the provenance verdict, from whatever the directory holds."""
+    lines = [f"hetuscope — numeric-health post-mortem of {dir_path}"]
+    errors: list = []
+    docs = [d for p in flight_files(dir_path)
+            if (d := _load_flight(p, errors)) is not None]
+    scopes, provs = _scan_metrics(dir_path)
+    if not docs and not scopes and not provs:
+        lines.append("  (no flight/flight-r*.json and no scope/"
+                     "nan_provenance records — was the run armed with "
+                     "HETU_INTROSPECT?)")
+        return "\n".join(lines)
+    for doc in docs:
+        recs = doc["records"]
+        steps = [r for r in recs if r.get("kind") != "provenance"]
+        lines.append(
+            f"rank {doc['rank']}: flight ring flushed on "
+            f"{doc['reason']!r} at "
+            f"{time.strftime('%H:%M:%S', time.localtime(doc.get('flushed_ts', 0)))}"
+            f" ({len(steps)} step record(s), ring depth {doc.get('k')})")
+        lines.append("  step     loss  grad_norm  step_ms  finite"
+                     "  batch_crc32")
+        for r in steps[-last:]:
+            st = r.get("stats") or {}
+            lines.append(
+                f"  {r.get('step', '?'):>4}"
+                f"  {_fmt_num(st.get('loss'), '9.4g'):>9}"
+                f"  {_fmt_num(st.get('grad_norm'), '9.4g'):>9}"
+                f"  {_fmt_num(r.get('step_ms'), '7.2f'):>7}"
+                f"  {str(r.get('finite', '?')):>6}"
+                f"  {r.get('batch_crc32', 'n/a')}")
+        latest = None
+        for r in reversed(steps):
+            if r.get("stats"):
+                latest = r["stats"]
+                break
+        if latest and latest.get("params"):
+            lines.append("  layer health (latest stats step):")
+            lines.append("    parameter            grad_norm  update/param")
+            for name, d in latest["params"].items():
+                lines.append(
+                    f"    {name[:20]:<20} {_fmt_num(d.get('grad_norm'), '9.4g'):>9}"
+                    f"  {_fmt_num(d.get('update_ratio'), '12.4g'):>12}")
+        if latest and latest.get("ops"):
+            ops = latest["ops"]
+            nonfin = [k for k, v in ops.items()
+                      if v.get("nonfinite", 0.0) > 0.0]
+            hot = sorted(ops.items(),
+                         key=lambda kv: -kv[1].get("absmax", 0.0))[:5]
+            lines.append(
+                f"  op health: {len(ops)} instrumented, "
+                f"{len(nonfin)} non-finite"
+                + (f" ({', '.join(nonfin[:5])})" if nonfin else ""))
+            for k, v in hot:
+                lines.append(f"    absmax {k}: {_fmt_num(v.get('absmax'))}"
+                             f" (rms {_fmt_num(v.get('rms'))})")
+        prov = doc.get("provenance")
+        if prov:
+            lines.append("  NaN/Inf provenance:")
+            _render_provenance(prov, lines)
+    if provs:
+        lines.append("nan_provenance events (telemetry JSONL):")
+        for p in provs:
+            _render_provenance(p, lines)
+    elif scopes:
+        s = scopes[-1]
+        lines.append(
+            f"latest scope record: sub={s.get('sub')} step={s.get('step')} "
+            f"grad_norm={_fmt_num(s.get('grad_norm'))} "
+            f"loss={_fmt_num(s.get('loss'))}")
+    for e in errors:
+        lines.append(f"  warning: {e}")
+    return "\n".join(lines)
+
+
+def check_dir(dir_path: str, out=sys.stdout) -> int:
+    """CI validation of a flight directory (exit 0 valid / 1 invalid)."""
+    files = flight_files(dir_path)
+    if not files:
+        print(f"hetuscope --check: no flight/flight-r*.json under "
+              f"{dir_path}", file=out)
+        return 1
+    errors: list = []
+    n_steps = n_prov = 0
+    for path in files:
+        doc = _load_flight(path, errors)
+        if doc is None:
+            continue
+        n_steps += sum(1 for r in doc["records"]
+                       if r.get("kind") != "provenance")
+        if doc.get("provenance") is not None:
+            n_prov += 1
+    for msg in errors[:20]:
+        print(f"hetuscope --check: {msg}", file=out)
+    if errors:
+        return 1
+    print(f"hetuscope --check: {len(files)} flight file(s), {n_steps} step "
+          f"record(s), {n_prov} with provenance", file=out)
+    return 0
+
+
+def self_check(out=sys.stdout) -> int:
+    """Dependency-free CI smoke (``hetuscope --check`` with no directory):
+    exercises the recorder -> flush -> validate -> render pipeline on
+    synthetic records in a temp dir; exit 0 iff the whole loop holds."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        intro = Introspector(cadence=2, out_dir=td, rank=0)
+        try:
+            stats = {"loss": 0.7, "grad_norm": 1.25,
+                     "params": {"w": {"grad_norm": 1.2,
+                                      "update_ratio": 0.01}},
+                     "ops": {"MatMulOp_1": {"absmax": 3.0, "rms": 0.5,
+                                            "nonfinite": 0.0},
+                             "ReluOp_2": {"absmax": 3.0, "rms": 0.4,
+                                          "nonfinite": 0.5}}}
+            for step in range(4):
+                intro.record_step(
+                    {"sub": "train", "step": step, "step_ms": 1.0,
+                     "finite": step != 3, "batch_crc32": 12345},
+                    stats=stats if step % 2 == 0 else None)
+            prov = find_culprit(["MatMulOp_1", "ReluOp_2"],
+                                {"ReluOp_2": ["MatMulOp_1"]}, stats, step=3)
+            if prov.get("op") != "ReluOp_2":
+                print("hetuscope --check: self-test culprit mismatch: "
+                      f"{prov}", file=out)
+                return 1
+            intro.on_anomaly(prov)
+            rc = check_dir(td, out=out)
+            if rc != 0:
+                return rc
+            report = render_report(td)
+            for needle in ("ReluOp_2", "flight ring flushed on 'anomaly'",
+                           "layer health"):
+                if needle not in report:
+                    print(f"hetuscope --check: self-test report missing "
+                          f"{needle!r}", file=out)
+                    return 1
+            print("hetuscope --check: self-test ok (record/flush/validate/"
+                  "render)", file=out)
+            return 0
+        finally:
+            intro.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetuscope",
+        description="numeric-health post-mortem over a hetu_tpu telemetry "
+                    "directory (flight recorder + scope records + NaN/Inf "
+                    "provenance)")
+    ap.add_argument("dir", nargs="?",
+                    help="telemetry directory (HETU_TELEMETRY_DIR); "
+                         "optional with --check (self-test)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the flight schema and exit 0/1 (CI "
+                         "mode); with no dir, run the built-in self-test")
+    ap.add_argument("--last", type=int, default=12,
+                    help="step records to show per rank (default 12)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return self_check() if args.dir is None else check_dir(args.dir)
+    if args.dir is None:
+        ap.error("dir is required unless --check")
+    print(render_report(args.dir, last=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

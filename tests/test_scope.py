@@ -1,0 +1,474 @@
+"""hetuscope (hetu_tpu/telemetry/scope.py + bin/hetuscope,
+docs/OBSERVABILITY.md "numeric health"):
+
+- in-graph stats: fused grad norms / update ratios / activation stats
+  returned as one extra fetch on the cadence, numerically verified
+- NaN/Inf provenance: a seeded ``nan_op`` fault is localized to the exact
+  poisoned op (and only it) in the JSONL event AND the hetuscope report —
+  the acceptance demo
+- introspect off (the default) performs ZERO scope work (mutator-patch
+  pattern from test_telemetry) and compiles no stats variant
+- flight recorder: valid + complete after a SIGTERM'd child run
+- satellites: clip_grad_norm (shared global-norm reduction), nan_op spec
+  parsing, hetuscope --check CI smoke, hetutop numeric-health panel
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import hetu_tpu as ht  # noqa: E402
+from hetu_tpu import resilience as rs  # noqa: E402
+from hetu_tpu.telemetry import scope as scope_mod  # noqa: E402
+from hetu_tpu.graph.executor import _op_scope  # noqa: E402
+
+
+@pytest.fixture
+def fresh(tmp_path, monkeypatch):
+    """Isolated telemetry + scope singletons and a tmp output dir."""
+    from hetu_tpu import telemetry
+    telemetry.shutdown()
+    scope_mod.shutdown()
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_INTROSPECT", raising=False)
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path / "tel"))
+    yield str(tmp_path / "tel")
+    telemetry.shutdown()
+    scope_mod.shutdown()
+
+
+def build_job(tmp=None, seed=0, introspect=5, telemetry=None,
+              anomaly_guard=True, clip=None, lr=0.1):
+    """Feed-fed 2-layer softmax job (deterministic); returns
+    (executor, run_closure, feed arrays)."""
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    w = ht.init.random_normal((8, 4), stddev=0.5, name="w")
+    b = ht.init.zeros((4,), name="b")
+    h = ht.matmul_op(x, w)
+    logits = h + ht.broadcastto_op(b, h)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    opt = ht.optim.SGDOptimizer(lr, clip_grad_norm=clip)
+    train_op = opt.minimize(loss)
+    kw = {}
+    if telemetry is not None:
+        kw["telemetry"] = telemetry
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=seed,
+                     anomaly_guard=anomaly_guard, introspect=introspect,
+                     **kw)
+    rng = np.random.RandomState(7)
+    bx = rng.randn(16, 8).astype(np.float32)
+    by = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+
+    def run():
+        return ex.run("train", feed_dict={x: bx, y_: by})
+
+    return ex, run, (bx, by)
+
+
+# ---------------------------------------------------------------------------
+# config resolution + spec parsing
+# ---------------------------------------------------------------------------
+
+def test_resolve_introspect_modes(monkeypatch):
+    monkeypatch.delenv("HETU_INTROSPECT", raising=False)
+    monkeypatch.delenv("HETU_INTROSPECT_EVERY", raising=False)
+    assert scope_mod.resolve_introspect(None) == 0
+    assert scope_mod.resolve_introspect(False) == 0
+    assert scope_mod.resolve_introspect("off") == 0
+    assert scope_mod.resolve_introspect(True) == scope_mod.DEFAULT_CADENCE
+    assert scope_mod.resolve_introspect("on") == scope_mod.DEFAULT_CADENCE
+    assert scope_mod.resolve_introspect(3) == 3
+    assert scope_mod.resolve_introspect("7") == 7
+    assert scope_mod.resolve_introspect(1) == 1   # int 1 = every step
+    monkeypatch.setenv("HETU_INTROSPECT", "1")    # env "1" = on @ default
+    assert scope_mod.resolve_introspect(None) == scope_mod.DEFAULT_CADENCE
+    monkeypatch.setenv("HETU_INTROSPECT_EVERY", "4")
+    assert scope_mod.resolve_introspect("on") == 4
+    with pytest.raises(ValueError):
+        scope_mod.resolve_introspect("sometimes")
+    for bad in (-5, "-5"):   # the string path validates like the int path
+        with pytest.raises(ValueError):
+            scope_mod.resolve_introspect(bad)
+
+
+def test_json_num_strict_serialization():
+    assert scope_mod.json_num(float("nan")) == "NaN"
+    assert scope_mod.json_num(float("inf")) == "Infinity"
+    assert scope_mod.json_num(float("-inf")) == "-Infinity"
+    assert scope_mod.json_num(1.5) == 1.5
+    assert scope_mod.json_num("MatMul_4") == "MatMul_4"   # non-numeric kept
+    safe = scope_mod.json_safe({"a": [float("nan"), 2.0],
+                                "b": {"c": float("inf")}})
+    assert safe == {"a": ["NaN", 2.0], "b": {"c": "Infinity"}}
+    assert float(scope_mod.json_num(float("nan"))) != \
+        float(scope_mod.json_num(float("nan")))   # float() round-trip = NaN
+
+
+def test_nan_op_fault_spec_keeps_string_arg():
+    fi = rs.FaultInjector("nan_op@3:MatMul_4, nan_op@5, stall@7:2.5")
+    e = fi.take("nan_op", 3)
+    assert e["arg"] == "MatMul_4"          # op name stays a string
+    e2 = fi.take("nan_op", 5)
+    assert e2["arg"] is None               # default op
+    assert fi.take("stall", 7)["arg"] == 2.5   # numeric args unchanged
+
+
+def test_supervisor_poison_op_consumes_entry():
+    sup = rs.Supervisor(fault_injector=rs.FaultInjector("nan_op@2:Foo"))
+    assert sup.poison_op(1) is None
+    assert sup.poison_op(2) == "Foo"
+    assert sup.poison_op(2) is None        # one-shot
+    sup2 = rs.Supervisor(fault_injector=rs.FaultInjector("nan_op@0"))
+    assert sup2.poison_op(0) == ""         # "" = executor default op
+
+
+# ---------------------------------------------------------------------------
+# in-graph stats
+# ---------------------------------------------------------------------------
+
+def test_stats_numerically_consistent(fresh):
+    """grad_norm is the root-sum-square of the per-param norms, and the
+    SGD update/param ratio equals lr * grad_norm(w) / ||w|| exactly."""
+    lr = 0.1
+    ex, run, _ = build_job(introspect=1, lr=lr)
+    w_node = [n for n in ex.param_nodes if n.name == "w"][0]
+    w_pre = np.asarray(ex.state["params"][id(w_node)]).copy()
+    run()
+    stats = ex.introspector.last_stats
+    assert stats is not None
+    params = stats["params"]
+    assert set(params) == {"w", "b"}
+    rss = np.sqrt(sum(d["grad_norm"] ** 2 for d in params.values()))
+    assert stats["grad_norm"] == pytest.approx(rss, rel=1e-5)
+    # SGD: ||delta w|| = lr * ||grad w||
+    expect = lr * params["w"]["grad_norm"] / np.linalg.norm(w_pre)
+    assert params["w"]["update_ratio"] == pytest.approx(expect, rel=1e-4)
+    # zero-init bias: ratio is NaN (undefined), not a 1e10 artifact
+    assert np.isnan(params["b"]["update_ratio"])
+    # activation table keyed by named_scope identity, all finite
+    assert any(k.startswith("MatMul") for k in stats["ops"])
+    assert all(d["nonfinite"] == 0.0 for d in stats["ops"].values())
+    assert stats["loss"] == pytest.approx(
+        float(np.asarray(run()[0].asnumpy())), rel=0.5)  # same ballpark
+
+
+def test_cadence_gates_stats_and_variants(fresh):
+    """Stats ride only every Nth step; the stats program is a second
+    compile of the SAME shape signature (no recompile churn)."""
+    ex, run, _ = build_job(introspect=3)
+    sub = ex.subexecutors["train"]
+    fr = ex.introspector.flight
+    for _ in range(7):   # steps 0..6; stats at 0, 3, 6
+        run()
+    # cadence fetches are deferred one boundary; reading last_stats
+    # resolves the final pending one into its ring record
+    assert ex.introspector.last_stats is not None
+    recs = fr.records()
+    assert [r["step"] for r in recs] == list(range(7))
+    assert [("stats" in r) for r in recs] == [
+        True, False, False, True, False, False, True]
+    assert len(sub._compiled) == 2       # plain + stats variant
+    assert len(sub._base_sigs) == 1      # ONE shape signature
+    from hetu_tpu.analysis.lowered import recompile_findings
+    assert recompile_findings(sub, budget=1) == []   # variants != churn
+
+
+def test_clip_grad_norm_bounds_the_update(fresh):
+    """With clip C << grad norm, the global update norm is exactly lr*C,
+    and the introspection grad_norm reuses the clip's PRE-clip reduction."""
+    lr, C = 0.1, 0.05
+    ex, run, _ = build_job(introspect=1, clip=C, lr=lr)
+    pre = {n.name: np.asarray(ex.state["params"][id(n)]).copy()
+           for n in ex.param_nodes}
+    run()
+    post = {n.name: np.asarray(ex.state["params"][id(n)])
+            for n in ex.param_nodes}
+    upd = np.sqrt(sum(np.sum((post[k] - pre[k]) ** 2) for k in pre))
+    gnorm = ex.introspector.last_stats["grad_norm"]
+    assert gnorm > C                      # clip engaged
+    assert upd == pytest.approx(lr * C, rel=1e-4)
+    # unclipped twin from the same seed: same direction, scaled grads
+    from hetu_tpu import telemetry
+    telemetry.shutdown()
+    scope_mod.shutdown()
+    ex2, run2, _ = build_job(introspect=0, lr=lr)
+    pre2 = {n.name: np.asarray(ex2.state["params"][id(n)]).copy()
+            for n in ex2.param_nodes}
+    run2()
+    post2 = {n.name: np.asarray(ex2.state["params"][id(n)])
+             for n in ex2.param_nodes}
+    scale = C / gnorm
+    for k in pre:
+        np.testing.assert_allclose(post[k] - pre[k],
+                                   (post2[k] - pre2[k]) * scale,
+                                   rtol=1e-4, atol=1e-7)
+
+
+def test_clip_rejects_nonpositive():
+    with pytest.raises(ValueError, match="clip_grad_norm"):
+        ht.optim.SGDOptimizer(0.1, clip_grad_norm=0.0)
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf provenance (the acceptance demo)
+# ---------------------------------------------------------------------------
+
+def test_provenance_localizes_poisoned_op(fresh):
+    """nan_op poisons one mid-graph op; the guard trips, the replay names
+    exactly that op in intro.last_provenance, the JSONL nan_provenance
+    event, and the bin/hetuscope report — and the anomaly event carries
+    the at-trip loss (satellite: enriched payload)."""
+    ex, run, _ = build_job(introspect=5, telemetry="metrics")
+    sub = ex.subexecutors["train"]
+    target = _op_scope([n for n in sub.topo if "MatMul" in n.name][0])
+    sup = ex.attach_supervisor(rs.Supervisor(
+        fault_injector=rs.FaultInjector(f"nan_op@2:{target}")))
+    with sup:
+        for step in range(4):
+            pre = {n.name: np.asarray(ex.state["params"][id(n)]).copy()
+                   for n in ex.param_nodes}
+            run()
+            if step == 2:   # guard skipped the poisoned step bit-identically
+                for n in ex.param_nodes:
+                    np.testing.assert_array_equal(
+                        pre[n.name], np.asarray(ex.state["params"][id(n)]))
+    prov = ex.introspector.last_provenance
+    assert prov is not None and prov["op"] == target
+    assert prov["step"] == 2
+    assert prov["output"]["nonfinite"] == 1.0
+    assert all(v["nonfinite"] == 0.0 for v in prov["inputs"].values())
+    assert prov["nonfinite_ops"] > 1      # downstream propagation seen...
+    # ...but ONLY the poisoned op is named as the culprit
+    # step 2 was off-cadence -> the debug replay (no donation) ran
+    assert len(sub._replay_compiled) == 1
+
+    from hetu_tpu import telemetry
+    telemetry.get().flush()
+    recs = [json.loads(l) for l in
+            open(os.path.join(fresh, "metrics-r0.jsonl"))]
+    evs = [r for r in recs if r.get("kind") == "event"
+           and r.get("name") == "nan_provenance"]
+    assert len(evs) == 1 and evs[0]["op"] == target
+    anomalies = [r for r in recs if r.get("kind") == "event"
+                 and r.get("name") == "anomaly"]
+    assert anomalies and "loss" in anomalies[0]   # enriched payload
+    # non-finite values serialize as strings so the JSONL stays STRICT
+    # JSON (jq-parseable) — float() round-trips them
+    assert anomalies[0]["loss"] == "NaN"
+    assert np.isnan(float(anomalies[0]["loss"]))
+    # every line of the whole stream parses under a strict decoder
+    import math as _math
+    strict = json.JSONDecoder(parse_constant=lambda c: (_ for _ in ()).throw(
+        ValueError(f"non-strict constant {c}")))
+    for l in open(os.path.join(fresh, "metrics-r0.jsonl")):
+        strict.decode(l)
+
+    # the CLI report names the op (real subprocess, jax-free load path)
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetuscope"), fresh],
+        env=env, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert f"first non-finite op (topological order): {target}" in out.stdout
+
+
+def test_provenance_on_cadence_step_skips_replay(fresh):
+    """A trip on a stats step localizes from that step's own fused table —
+    no debug replay compile."""
+    ex, run, _ = build_job(introspect=1)
+    sub = ex.subexecutors["train"]
+    target = _op_scope([n for n in sub.topo if "MatMul" in n.name][0])
+    sup = ex.attach_supervisor(rs.Supervisor(
+        fault_injector=rs.FaultInjector(f"nan_op@1:{target}")))
+    with sup:
+        run()
+        run()
+    assert ex.introspector.last_provenance["op"] == target
+    assert sub._replay_compiled == {}
+
+
+def test_nan_grads_injection_has_no_op_culprit(fresh):
+    """The update-level nan_grads poison never flows through an op output:
+    provenance reports op=None with the explanatory note."""
+    ex, run, _ = build_job(introspect=5)
+    sup = ex.attach_supervisor(rs.Supervisor(
+        fault_injector=rs.FaultInjector("nan_grads@1")))
+    with sup:
+        run()
+        run()
+    prov = ex.introspector.last_provenance
+    assert prov is not None and prov["op"] is None
+    assert "no op-level culprit" in prov["note"]
+
+
+# ---------------------------------------------------------------------------
+# off-mode: zero scope work
+# ---------------------------------------------------------------------------
+
+def test_off_mode_adds_zero_scope_work(fresh, monkeypatch):
+    """With introspect off (the default), a training step performs no
+    flight-ring appends, no stats builds, no exports — counted by patching
+    every scope-layer mutator — and compiles no stats variant."""
+    calls = []
+    monkeypatch.setattr(scope_mod.FlightRecorder, "record",
+                        lambda self, rec: calls.append(("flight", rec)))
+    monkeypatch.setattr(scope_mod.FlightRecorder, "flush",
+                        lambda self, reason, provenance=None:
+                        calls.append(("flush", reason)))
+    monkeypatch.setattr(scope_mod, "traced_stats",
+                        lambda *a, **k: calls.append(("stats",)) or ())
+    monkeypatch.setattr(scope_mod, "host_stats",
+                        lambda *a: calls.append(("host",)) or {})
+    ex, run, _ = build_job(introspect=None)   # env cleared by fixture
+    assert ex.introspector is None
+    assert ex.config.introspect == 0
+    for _ in range(3):
+        run()
+    assert calls == []
+    assert len(ex.subexecutors["train"]._compiled) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_bounded_and_flush_atomic(tmp_path):
+    fr = scope_mod.FlightRecorder(str(tmp_path / "flight"), rank=0, k=4)
+    for i in range(10):
+        fr.record({"step": i})
+    recs = fr.records()
+    assert [r["step"] for r in recs] == [6, 7, 8, 9]   # last K only
+    path = fr.flush("test")
+    doc = json.load(open(path))
+    assert doc["schema"] == scope_mod.FLIGHT_SCHEMA
+    assert doc["reason"] == "test" and len(doc["records"]) == 4
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_flight_recorder_complete_after_sigterm_child(tmp_path):
+    """A SIGTERM'd supervised run leaves a valid, complete flight dir: the
+    preemption path flushes the ring before Preempted exits the process
+    (exit 75)."""
+    tel_dir = str(tmp_path / "tel")
+    script = textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        os.environ.update({"HETU_TEST_MODE": "1",
+                           "HETU_TELEMETRY_DIR": %r})
+        import numpy as np
+        import hetu_tpu as ht
+        from hetu_tpu import resilience as rs
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        w = ht.init.random_normal((6, 3), stddev=0.5, name="w")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         seed=0, introspect=2)
+        sup = ex.attach_supervisor(rs.Supervisor(
+            preemption=rs.PreemptionHandler(),
+            fault_injector=rs.FaultInjector("sigterm@3")))
+        rng = np.random.RandomState(0)
+        bx = rng.randn(8, 6).astype(np.float32)
+        by = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+
+        def loop(state, start):
+            with sup:
+                for _ in range(start, 10):
+                    ex.run("train", feed_dict={x: bx, y_: by})
+        rs.supervise(loop, None)
+        print("FINISHED")   # must never be reached
+    """ % (REPO, tel_dir))
+    p = tmp_path / "sigterm_job.py"
+    p.write_text(script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    proc = subprocess.run([sys.executable, str(p)], capture_output=True,
+                          text=True, timeout=240, env=env,
+                          cwd=str(tmp_path))
+    assert proc.returncode == rs.EXIT_PREEMPTED, (proc.stdout, proc.stderr)
+    assert "FINISHED" not in proc.stdout
+    fpath = os.path.join(tel_dir, "flight", "flight-r0.json")
+    doc = json.load(open(fpath))
+    assert doc["reason"] == "preempted"
+    steps = [r for r in doc["records"] if "step" in r]
+    # steps 0..3 all recorded (step 3 ran; the signal fired at its boundary)
+    assert [r["step"] for r in steps] == [0, 1, 2, 3]
+    for r in steps:
+        assert "batch_crc32" in r and "finite" in r and "step_ms" in r
+    assert "stats" in steps[0] and "stats" in steps[2]   # cadence 2
+    # the directory validates under the CI checker
+    assert scope_mod.check_dir(tel_dir) == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI + dashboards
+# ---------------------------------------------------------------------------
+
+def test_hetuscope_check_smoke():
+    """bin/hetuscope --check with no dir runs the built-in self-test
+    (record -> flush -> validate -> render), exit 0; an empty dir is
+    invalid, exit 1 — the hetutop/hetutrace CI pattern."""
+    env = {**os.environ, "PYTHONPATH": REPO}
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetuscope"), "--check"],
+        env=env, capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr + ok.stdout
+    assert "self-test ok" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetuscope"), "--check",
+         "/tmp/definitely-not-a-telemetry-dir"],
+        env=env, capture_output=True, text=True)
+    assert bad.returncode == 1
+
+
+def test_scope_metrics_and_hetutop_panel(fresh):
+    """Cadence exports land as hetu_scope_* gauges + kind:"scope" JSONL
+    rows; hetutop validates them and renders the numeric-health panel."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.telemetry import hetutop
+    ex, run, _ = build_job(introspect=2, telemetry="metrics")
+    for _ in range(5):
+        run()
+    assert ex.introspector.last_stats  # resolve the deferred final fetch
+    tel = telemetry.get()
+    snap = tel.metrics.snapshot()
+    assert snap["hetu_scope_grad_norm"] > 0
+    assert snap["hetu_scope_act_absmax"] > 0
+    assert snap["hetu_scope_nonfinite_ops"] == 0
+    assert snap["hetu_scope_update_ratio_max"] > 0
+    tel.flush()
+    assert hetutop.check_dir(fresh) == 0
+    frame = hetutop.render_frame(hetutop.gather(fresh))
+    assert "numeric health (hetuscope)" in frame
+    assert "grad_norm" in frame and "nonfinite ops: 0" in frame
+    recs = [json.loads(l) for l in
+            open(os.path.join(fresh, "metrics-r0.jsonl"))]
+    scopes = [r for r in recs if r.get("kind") == "scope"]
+    assert len(scopes) == 3               # steps 0, 2, 4
+    assert all("params" in r and "ops" in r for r in scopes)
+
+
+def test_find_culprit_orders_and_notes():
+    order = ["a", "b", "c"]
+    inputs = {"b": ["a"], "c": ["b"]}
+    stats = {"grad_norm": 1.0,
+             "ops": {"a": {"absmax": 1.0, "rms": 0.5, "nonfinite": 0.0},
+                     "b": {"absmax": 0.0, "rms": 0.0, "nonfinite": 1.0},
+                     "c": {"absmax": 0.0, "rms": 0.0, "nonfinite": 0.3}}}
+    prov = scope_mod.find_culprit(order, inputs, stats, step=7)
+    assert prov["op"] == "b" and prov["nonfinite_ops"] == 2
+    assert prov["inputs"]["a"]["nonfinite"] == 0.0
+    clean = scope_mod.find_culprit(
+        order, inputs, {"ops": {k: {"absmax": 1, "rms": 1, "nonfinite": 0.0}
+                                for k in order}}, step=7)
+    assert clean["op"] is None and "no op-level culprit" in clean["note"]
