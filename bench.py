@@ -460,6 +460,153 @@ def bench_wdl_ps(batch_size=128, warmup=5, iters=40, feature_dim=100000):
         return out
 
 
+# ---------------------------------------------------------------------------
+# hetuq (docs/COMM_QUANT.md): quantized-communication A/B cells. Both are
+# framework-relative measurements pinned to the CPU backend (SECTION_ENV) —
+# the PS cell's bytes-on-wire counters and AUC delta and the DP cell's
+# loss deltas are device-independent, and determinism beats tunnel jitter.
+# ---------------------------------------------------------------------------
+
+def bench_comm_quant_ps(batch_size=128, steps=1000, feature_dim=10000,
+                        embedding_size=32, n_test=1024, warmup=5,
+                        n_train=8192, learning_rate=0.02, stddev=0.1):
+    """WDL-Criteo under comm_mode='PS' (dense AND sparse params PS-hosted),
+    quant off vs int8: bytes-on-wire from the worker's raw/wire counters
+    (client_stats), step time, and final test AUC per leg. The acceptance
+    claim — >=3x wire reduction at AUC within 0.002 — is measured here.
+    lr/stddev are tuned so BOTH legs converge well clear of the synthetic
+    task's steep learning-curve transition — reading AUC mid-transition
+    would measure noise-shifted timing, not quality."""
+    from hetu_tpu.ps.local_cluster import local_cluster
+    with local_cluster(n_servers=2, n_workers=1):
+        import hetu_tpu as ht
+        from hetu_tpu import metrics as ht_metrics
+        models = _import_models("ctr")
+        from models.load_data import load_criteo_data
+
+        (tr_dense, tr_sparse, tr_y), (te_dense, te_sparse, te_y) = \
+            load_criteo_data(feature_dimension=feature_dim,
+                             n_train=n_train, n_test=n_test)
+        out = {}
+        for leg, mode in enumerate(("off", "int8")):
+            # disjoint server tensor ids per leg (see bench_wdl_ps)
+            os.environ["HETU_PS_ID_BASE"] = str(leg * 1000)
+            dense = ht.dataloader_op([
+                ht.Dataloader(tr_dense, batch_size, "train"),
+                ht.Dataloader(te_dense, batch_size, "validate")])
+            sparse = ht.dataloader_op([
+                ht.Dataloader(tr_sparse, batch_size, "train"),
+                ht.Dataloader(te_sparse, batch_size, "validate")])
+            y_ = ht.dataloader_op([
+                ht.Dataloader(tr_y, batch_size, "train"),
+                ht.Dataloader(te_y, batch_size, "validate")])
+            loss, y, labels, train_op = models.wdl_criteo(
+                dense, sparse, y_, feature_dimension=feature_dim,
+                embedding_size=embedding_size, learning_rate=learning_rate,
+                stddev=stddev)
+            ex = ht.Executor({"train": [loss, train_op],
+                              "validate": [loss, y, y_]}, ctx=ht.cpu(0),
+                             comm_mode="PS", seed=0, comm_quant=mode)
+            comm = ex.ps_runtime.comm
+            for _ in range(warmup):
+                ex.run("train")
+            float(np.mean(ex.run("train")[0].asnumpy()))  # drain
+            cs0 = comm.ClientStats()
+            t0 = time.time()
+            for _ in range(steps - 1):
+                ex.run("train")
+            float(np.mean(ex.run("train")[0].asnumpy()))
+            dt = (time.time() - t0) / steps
+            ex.ps_runtime.drain()
+            cs1 = comm.ClientStats()
+            preds, labs = [], []
+            for _ in range(n_test // batch_size):
+                _, yv, lv = ex.run("validate", convert_to_numpy_ret_vals=True)
+                preds.append(yv)
+                labs.append(lv)
+            auc = float(ht_metrics.auc(np.concatenate(labs),
+                                       np.concatenate(preds)))
+            out[mode] = {
+                "step_ms": round(dt * 1000, 2),
+                "auc": round(auc, 4),
+                "raw_bytes": cs1["quant_raw_bytes"] - cs0["quant_raw_bytes"],
+                "wire_bytes": (cs1["quant_wire_bytes"]
+                               - cs0["quant_wire_bytes"]),
+            }
+            ex.close()
+        os.environ.pop("HETU_PS_ID_BASE", None)
+        # wire reduction = identical logical traffic (same model, steps,
+        # batches, seed) at each leg's wire encoding
+        out["bytes_wire_ratio"] = round(
+            out["off"]["wire_bytes"] / max(1, out["int8"]["wire_bytes"]), 2)
+        out["auc_off"] = out["off"]["auc"]
+        out["auc_int8"] = out["int8"]["auc"]
+        out["auc_delta"] = round(abs(out["off"]["auc"]
+                                     - out["int8"]["auc"]), 4)
+        return out
+
+
+def bench_comm_quant_dp(width=512, batch=512, steps=40, warmup=5):
+    """DP AllReduce on the 8-device virtual mesh: off vs int8 vs fp8 (same
+    seed/feeds), step time + final loss per mode, plus the analytic
+    raw-vs-wire ratio of the quantized decomposition (the executor's
+    comm_quant_report; the reduce-scatter half stays f32 by construction —
+    docs/COMM_QUANT.md)."""
+    import hetu_tpu as ht
+    from hetu_tpu.comm_quant import fp8_dtype
+    from hetu_tpu.utils import ensure_devices
+
+    ensure_devices(8)
+    rng = np.random.RandomState(0)
+    bx = rng.randn(batch, width).astype(np.float32)
+    by = np.eye(8, dtype=np.float32)[rng.randint(0, 8, batch)]
+
+    def run(mode):
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        h = x
+        for i in range(3):
+            w = ht.init.random_normal((width, width), stddev=0.05,
+                                      name=f"w{i}")
+            h = ht.relu_op(ht.matmul_op(h, w))
+        wo = ht.init.random_normal((width, 8), stddev=0.05, name="wo")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, wo), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         comm_mode="AllReduce", seed=0, comm_quant=mode)
+        feeds = {x: bx, y_: by}
+        for _ in range(warmup):
+            ex.run("train", feed_dict=feeds)
+        float(np.mean(ex.run("train", feed_dict=feeds)[0].asnumpy()))
+        t0 = time.time()
+        for _ in range(steps - 1):
+            ex.run("train", feed_dict=feeds)
+        last = ex.run("train", feed_dict=feeds)[0]
+        final = float(np.mean(last.asnumpy()))
+        dt = (time.time() - t0) / steps
+        return {"step_ms": round(dt * 1000, 2),
+                "final_loss": round(final, 6)}, ex.comm_quant_report
+
+    out = {}
+    report = None
+    modes = ["off", "int8"] + (["fp8"] if fp8_dtype() is not None else [])
+    for mode in modes:
+        out[mode], rep = run(mode)
+        report = rep or report
+    if fp8_dtype() is None:
+        out["fp8"] = {"error": "float8_e4m3fn unavailable in this jax build"}
+    if report:
+        out["wire_report"] = report
+    out["final_loss_off"] = out["off"]["final_loss"]
+    out["loss_delta_int8"] = round(
+        abs(out["int8"]["final_loss"] - out["off"]["final_loss"]), 6)
+    if "final_loss" in out.get("fp8", {}):
+        out["loss_delta_fp8"] = round(
+            abs(out["fp8"]["final_loss"] - out["off"]["final_loss"]), 6)
+    return out
+
+
 def bench_vit(batch=64, warmup=3, iters=15, **cfg_overrides):
     """ViT-base/16 image-classification fine-tune step (the vision side of
     the flagship trunk; same 6ND + attention-inclusive MFU accounting as
@@ -689,6 +836,14 @@ def _run_section(name):
                   feature_dim=1000) if smoke else {}
         out = bench_wdl_ps(**kw)
         out["servers"] = 2
+    elif name == "comm_quant_ps":
+        kw = (dict(batch_size=32, steps=12, feature_dim=1000, n_test=128,
+                   warmup=2, n_train=256) if smoke else {})
+        out = bench_comm_quant_ps(**kw)
+        out["servers"] = 2
+    elif name == "comm_quant_dp":
+        kw = (dict(width=64, batch=32, steps=8, warmup=2) if smoke else {})
+        out = bench_comm_quant_dp(**kw)
     else:
         raise SystemExit(f"unknown section {name}")
     import jax
@@ -706,6 +861,12 @@ SECTION_ENV = {
     # framework-overhead A/B: pinned off the tunneled chip so the delta
     # measures hetuscope, not tunnel jitter
     "introspect": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetuq A/Bs (docs/COMM_QUANT.md): bytes-on-wire and AUC/loss deltas
+    # are device-independent; determinism beats the tunneled chip. The DP
+    # cell additionally needs an 8-device mesh for a real dp axis.
+    "comm_quant_ps": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    "comm_quant_dp": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
 }
 
 
@@ -865,7 +1026,9 @@ class _Ledger:
             for k in ("samples_per_sec", "step_ms", "mfu", "mfu_6nd",
                       "mfu_attn_incl", "tokens_per_sec",
                       "introspect_overhead_pct", "step_ms_off",
-                      "step_ms_on"):
+                      "step_ms_on", "bytes_wire_ratio", "auc_off",
+                      "auc_int8", "auc_delta", "final_loss_off",
+                      "loss_delta_int8", "loss_delta_fp8"):
                 if result.get(k) is not None:
                     rec[k] = result[k]
         try:
@@ -1029,6 +1192,8 @@ def main():
                      ("vit_base_finetune", "vit", 600),
                      ("pipeline_gpipe_vs_1f1b", "pipeline", 600),
                      ("wdl_criteo_hybrid_ps", "wdl", 600),
+                     ("comm_quant_ps_wdl", "comm_quant_ps", 600),
+                     ("comm_quant_dp_mlp", "comm_quant_dp", 600),
                      ("introspect_overhead", "introspect", 420)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent

@@ -79,7 +79,7 @@ class AnalysisConfig:
 
     def __init__(self, comm_mode=None, mesh=None, dp_size=None,
                  dp_axis="dp", mp_axis="tp", compute_dtype=np.float32,
-                 gpipe=False):
+                 gpipe=False, comm_quant_policy=None):
         self.comm_mode = comm_mode
         self.mesh = mesh
         self._dp_size = dp_size
@@ -87,6 +87,9 @@ class AnalysisConfig:
         self.mp_axis = mp_axis
         self.compute_dtype = np.dtype(compute_dtype)
         self.gpipe = gpipe
+        # hetuq policy for the comm_quant lints (a comm_quant.QuantPolicy);
+        # None = quantization off, the lints are skipped
+        self.comm_quant_policy = comm_quant_policy
 
     @property
     def dp_size(self) -> int:

@@ -15,6 +15,7 @@ comm       : ps-op-without-ps-mode(E) ps-push-ignored(W)
              dispatch-no-mp-axis(E) dispatch-grad-unpaired(W)
              pipeline-send-unconsumed(W) pipeline-recv-source(N)
              pipeline-stage-loop(W)
+comm_quant : comm-quant-forced-small(W) comm-quant-no-error-feedback(N)
 dce        : dead-subgraph(W) common-subexpression(N)
 """
 from __future__ import annotations
@@ -304,6 +305,58 @@ def comm_pass(ctx) -> list:
 
 
 # ---------------------------------------------------------------------------
+# comm quantization (hetuq, docs/COMM_QUANT.md)
+# ---------------------------------------------------------------------------
+
+def comm_quant_pass(ctx) -> list:
+    """Quantized-communication placement lints: a forced override that
+    quantizes a below-threshold param (the exemption exists to protect
+    exactly those biases/norms — a force-listed one is usually a
+    misconfiguration), and int8 AllReduce running without error feedback
+    (compression error then accumulates in the params over a long run)."""
+    out = []
+    cfg = ctx.config
+    pol = getattr(cfg, "comm_quant_policy", None) if cfg is not None else None
+    if pol is None or not getattr(pol, "active", False):
+        return out
+    ag = ctx.abstract
+    noted_ef = False
+    for node in ctx.topo:
+        if not isinstance(node, AllReduceCommunicateOp):
+            continue
+        pn = node.param_node
+        if pn is None:
+            continue
+        # param_node is an association, not a graph input — fall back to
+        # the placeholder's declared shape when abstract eval never saw it.
+        # Unknown size => can't tell whether the policy quantizes this
+        # param at all; skip rather than lint speculatively.
+        shape = ag.shape_of(pn) or getattr(pn, "shape", None)
+        size = int(np.prod(shape)) if shape else None
+        if size is None or not pol.applies(pn, size):
+            continue
+        if size < pol.min_size:
+            # applies() said yes on a below-threshold param => force-listed
+            out.append(Finding.at(
+                node, "comm-quant-forced-small", WARN,
+                f"comm_quant force-quantizes {pn.name!r} ({size} elements, "
+                f"below the {pol.min_size}-element exemption threshold) — "
+                "small/sensitive params (biases, norm scales) are exempt by "
+                "design; drop the override unless the wire saving was "
+                "measured to matter", "comm_quant"))
+        if pol.mode == "int8" and not pol.error_feedback and not noted_ef:
+            noted_ef = True
+            out.append(Finding.at(
+                node, "comm-quant-no-error-feedback", NOTE,
+                "int8 AllReduce with error feedback disabled — per-step "
+                "quantization error accumulates in the parameters instead "
+                "of being carried forward and cancelled; enable "
+                "comm_quant_error_feedback unless A/B-verified harmless "
+                "(docs/COMM_QUANT.md)", "comm_quant"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # dead subgraphs + common subexpressions
 # ---------------------------------------------------------------------------
 
@@ -361,4 +414,5 @@ def _has_closure_params(node) -> bool:
     return bool(closure) or bool(defaults)
 
 
-TIER_A_PASSES = (structure_pass, shapes_pass, comm_pass, dce_pass)
+TIER_A_PASSES = (structure_pass, shapes_pass, comm_pass, comm_quant_pass,
+                 dce_pass)

@@ -349,8 +349,27 @@ void QueryServerStats(int server, long long* out, int n) {
   });
 }
 
-// Worker-side RPC counters: fills up to n of [rpcs, retries, failovers]
-// (worker.h client_stats — the telemetry twin of QueryServerStats).
+// hetuq: toggle quantized value payloads (ArgType::kQI8) for this worker's
+// push/pull traffic. mode != 0 enables; the env default is HETU_COMM_QUANT.
+void SetCommQuant(int mode) {
+  guard([&] { worker().set_quant(mode != 0); });
+}
+
+// hetuq test hook (inert without HETU_TEST_MODE): corrupt the scale bytes
+// of the next quantized payload (node < 0 = any tensor) to prove the
+// server's validation rejects malformed quantized args.
+void TestCorruptNextQuant(int node) {
+  guard([&] {
+    if (!hetups::env_test_mode())
+      throw std::runtime_error(
+          "TestCorruptNextQuant requires HETU_TEST_MODE");
+    worker().arm_quant_corrupt(node);
+  });
+}
+
+// Worker-side RPC counters: fills up to n of [rpcs, retries, failovers,
+// quant raw value bytes, quant wire value bytes] (worker.h client_stats —
+// the telemetry twin of QueryServerStats).
 void QueryClientStats(long long* out, int n) {
   guard([&] {
     auto v = worker().client_stats();

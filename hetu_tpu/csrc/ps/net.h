@@ -14,7 +14,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -80,7 +82,10 @@ struct MsgHeader {
   int32_t pad = 0;
 };
 
-enum class ArgType : int32_t { kF32 = 0, kI64 = 1, kF64 = 2, kBytes = 3, kI32 = 4, kU64 = 5 };
+enum class ArgType : int32_t { kF32 = 0, kI64 = 1, kF64 = 2, kBytes = 3, kI32 = 4, kU64 = 5,
+                               // hetuq: blockwise-quantized f32 payload
+                               // (int8 + one f32 scale per block)
+                               kQI8 = 6 };
 
 struct ArgHeader {
   int32_t dtype = 0;
@@ -121,6 +126,114 @@ struct Message {
   MsgHeader head;
   std::vector<Arg> args;
 };
+
+// ---------------------------------------------------------------------------
+// hetuq wire container (ArgType::kQI8): a quantized stand-in for an f32
+// value arg. Layout: u64 n_values | u64 block | f32 scales[ceil(n/block)]
+// | int8 q[n]. Sparse row payloads use block == row width (one scale per
+// row); dense payloads use a fixed block (kQuantWireBlock). Scheme:
+// symmetric linear — scale = max(|block|)/127, q = lrintf(v/scale) clipped
+// to [-127,127]; an all-zero block stores scale 0 (exact zeros). Matched
+// bit-for-bit by hetu_tpu.comm_quant.np_quantize_blocks.
+// ---------------------------------------------------------------------------
+
+constexpr size_t kQuantWireBlock = 256;
+// request-header flag: "quantize the value payloads of YOUR response"
+// (pull rows / push-pull return legs). Responses self-describe via the
+// arg dtype, so no response-side flag exists; flags == -1 stays the error
+// marker.
+constexpr int32_t kFlagQuantRsp = 1;
+
+struct QI8Header {
+  uint64_t n = 0;
+  uint64_t block = 0;
+};
+
+inline Arg make_qi8_arg(const float* vals, size_t n, size_t block) {
+  if (block == 0) block = 1;
+  const size_t nb = (n + block - 1) / block;
+  Arg a;
+  a.dtype = ArgType::kQI8;
+  a.buf.resize(sizeof(QI8Header) + nb * 4 + n);
+  QI8Header h{n, block};
+  std::memcpy(a.buf.data(), &h, sizeof(h));
+  float* scales = reinterpret_cast<float*>(a.buf.data() + sizeof(h));
+  int8_t* q = reinterpret_cast<int8_t*>(a.buf.data() + sizeof(h) + nb * 4);
+  for (size_t b = 0; b < nb; ++b) {
+    const size_t lo = b * block, hi = std::min(n, lo + block);
+    float amax = 0.0f;
+    for (size_t i = lo; i < hi; ++i) {
+      // per-element: NaN compares false against everything, so a plain
+      // running max would silently drop it and quantize garbage. Fail at
+      // the SENDER with a numeric diagnosis instead — letting a NaN/Inf
+      // through would either corrupt the scale (receiver rejects it as
+      // "malformed scale", a misleading wire-corruption error for what is
+      // a numeric-gradient problem) or quantize NaN to an arbitrary int.
+      if (!std::isfinite(vals[i]))
+        throw std::runtime_error(
+            "hetuq: non-finite value at element " + std::to_string(i) +
+            " of quantized payload — the gradient/value itself is NaN/Inf");
+      const float av = std::fabs(vals[i]);
+      if (av > amax) amax = av;
+    }
+    const float scale = amax / 127.0f;
+    scales[b] = scale;
+    const float inv = scale > 0.0f ? scale : 1.0f;
+    for (size_t i = lo; i < hi; ++i) {
+      long v = lrintf(vals[i] / inv);
+      if (v > 127) v = 127;
+      if (v < -127) v = -127;
+      q[i] = static_cast<int8_t>(v);
+    }
+  }
+  return a;
+}
+
+// Validate + dequantize a kQI8 arg into `out`. `expect_n` > 0 enforces the
+// element count the handler derived from its OTHER args (row count x
+// width, shard length): a mismatch, a torn container, or a non-finite /
+// negative scale is a protocol error — the server answers with an error
+// response instead of applying garbage.
+inline void dequant_qi8(const Arg& a, std::vector<float>* out,
+                        size_t expect_n) {
+  if (a.buf.size() < sizeof(QI8Header))
+    throw std::runtime_error("quantized arg: truncated header");
+  QI8Header h;
+  std::memcpy(&h, a.buf.data(), sizeof(h));
+  if (h.block == 0 || h.block > (1u << 20))
+    throw std::runtime_error("quantized arg: bad block size " +
+                             std::to_string(h.block));
+  const size_t nb = (h.n + h.block - 1) / h.block;
+  if (a.buf.size() != sizeof(QI8Header) + nb * 4 + h.n)
+    throw std::runtime_error(
+        "quantized arg: length mismatch (" + std::to_string(a.buf.size()) +
+        " bytes for " + std::to_string(h.n) + " values x block " +
+        std::to_string(h.block) + ")");
+  if (expect_n > 0 && h.n != expect_n)
+    throw std::runtime_error(
+        "quantized arg: carries " + std::to_string(h.n) + " values, " +
+        std::to_string(expect_n) + " expected");
+  const float* scales =
+      reinterpret_cast<const float*>(a.buf.data() + sizeof(h));
+  const int8_t* q =
+      reinterpret_cast<const int8_t*>(a.buf.data() + sizeof(h) + nb * 4);
+  for (size_t b = 0; b < nb; ++b)
+    if (!(scales[b] >= 0.0f) || !std::isfinite(scales[b]))
+      throw std::runtime_error(
+          "quantized arg: malformed scale in block " + std::to_string(b));
+  out->resize(h.n);
+  for (size_t i = 0; i < h.n; ++i)
+    (*out)[i] = static_cast<float>(q[i]) * scales[i / h.block];
+}
+
+// Element count of an f32-or-quantized value arg (what n_f32 is to kF32).
+inline size_t value_count(const Arg& a) {
+  if (a.dtype != ArgType::kQI8) return a.n_f32();
+  if (a.buf.size() < sizeof(QI8Header)) return 0;
+  QI8Header h;
+  std::memcpy(&h, a.buf.data(), sizeof(h));
+  return h.n;
+}
 
 // ---------------------------------------------------------------------------
 // Socket helpers

@@ -283,6 +283,34 @@ class PsServer {
     update_count_.fetch_add(1, std::memory_order_relaxed);
   }
 
+  // hetuq: f32 view of a value arg that may ride the wire quantized
+  // (ArgType::kQI8). Dequantizes into `scratch` with full length/scale
+  // validation — a malformed quantized payload becomes an error response
+  // (the param untouched), never an applied-garbage write. `expect_n` > 0
+  // pins the element count the handler derived from its other args.
+  static const float* value_f32(const Arg& a, std::vector<float>* scratch,
+                                size_t expect_n) {
+    if (a.dtype == ArgType::kQI8) {
+      dequant_qi8(a, scratch, expect_n);
+      return scratch->data();
+    }
+    if (expect_n > 0 && a.n_f32() != expect_n)
+      throw std::runtime_error(
+          "value arg carries " + std::to_string(a.n_f32()) + " f32s, " +
+          std::to_string(expect_n) + " expected");
+    return a.as_f32();
+  }
+
+  // hetuq: response value payload, quantized iff the request asked for it
+  // (kFlagQuantRsp). `block` is the scale granularity — row width for
+  // sparse rows, kQuantWireBlock for dense payloads.
+  static Arg rsp_value(const Message& req, const float* vals, size_t n,
+                       size_t block) {
+    if (req.head.flags & kFlagQuantRsp)
+      return make_qi8_arg(vals, n, block ? block : kQuantWireBlock);
+    return Arg::f32(vals, n);
+  }
+
   // `skip_apply`: re-execution of a request whose write already landed in
   // the restored snapshot (dedup-ledger duplicate) — perform reads, answer
   // normally, but never mutate. `write_seq` (when non-null) receives the
@@ -291,6 +319,7 @@ class PsServer {
               uint64_t* write_seq = nullptr) {
     const auto type = static_cast<PsfType>(req.head.type);
     const int32_t key = req.head.tensor_id;
+    std::vector<float> qscratch;  // dequant buffer for quantized value args
     // stamp an applied write while the param's exclusive lock is held —
     // the lock is what orders the stamp against save_param_file's read of
     // last_write_seq, making the snapshot's ledger filter race-free
@@ -344,9 +373,14 @@ class PsServer {
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
         if (skip_apply) break;
+        const size_t n = value_count(req.args[0]);
+        if (n > p->data.size())
+          throw std::runtime_error(
+              "DensePush carries " + std::to_string(n) + " values for a " +
+              std::to_string(p->data.size()) + "-element shard");
+        const float* v = value_f32(req.args[0], &qscratch, n);
         begin_req(*p);
-        apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
-                     parse_opts(req, 1));
+        apply_update(*p, 0, v, n, parse_opts(req, 1));
         mark(*p);
         break;
       }
@@ -362,12 +396,18 @@ class PsServer {
         check(p, key);
         std::unique_lock<std::shared_mutex> g(p->mu);
         if (!skip_apply) {
+          const size_t n = value_count(req.args[0]);
+          if (n > p->data.size())
+            throw std::runtime_error(
+                "DDPushPull carries " + std::to_string(n) + " values for a " +
+                std::to_string(p->data.size()) + "-element shard");
+          const float* v = value_f32(req.args[0], &qscratch, n);
           begin_req(*p);
-          apply_update(*p, 0, req.args[0].as_f32(), req.args[0].n_f32(),
-                       parse_opts(req, 1));
+          apply_update(*p, 0, v, n, parse_opts(req, 1));
           mark(*p);
         }
-        rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
+        rsp->args.push_back(rsp_value(req, p->data.data(), p->data.size(),
+                                      kQuantWireBlock));
         break;
       }
       case PsfType::kSparsePush: {
@@ -379,9 +419,12 @@ class PsServer {
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
         if (skip_apply) break;
+        // length/scale validation BEFORE begin_req: a rejected quantized
+        // payload must leave the param (and the update counter) untouched
+        const float* vals = value_f32(req.args[1], &qscratch,
+                                      nidx * p->width);
         begin_req(*p);
         const UpdateOpts uo = parse_opts(req, 2);
-        const float* vals = req.args[1].as_f32();
         for (size_t i = 0; i < nidx; ++i)
           apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
                        vals + i * p->width, p->width, uo);
@@ -400,7 +443,8 @@ class PsServer {
           std::memcpy(out.data() + i * p->width,
                       p->data.data() + static_cast<size_t>(idx[i]) * p->width,
                       p->width * 4);
-        rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        rsp->args.push_back(rsp_value(req, out.data(), out.size(),
+                                      p->width));
         break;
       }
       case PsfType::kSDPushPull: {
@@ -412,15 +456,17 @@ class PsServer {
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
         if (!skip_apply) {
+          const float* vals = value_f32(req.args[1], &qscratch,
+                                        nidx * p->width);
           begin_req(*p);
           const UpdateOpts uo = parse_opts(req, 2);
-          const float* vals = req.args[1].as_f32();
           for (size_t i = 0; i < nidx; ++i)
             apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
                          vals + i * p->width, p->width, uo);
           mark(*p);
         }
-        rsp->args.push_back(Arg::f32(p->data.data(), p->data.size()));
+        rsp->args.push_back(rsp_value(req, p->data.data(), p->data.size(),
+                                      kQuantWireBlock));
         break;
       }
       case PsfType::kSSPushPull: {
@@ -437,9 +483,10 @@ class PsServer {
         check_rows(*p, idx, nidx);
         check_rows(*p, oidx, no);
         if (!skip_apply) {
+          const float* vals = value_f32(req.args[1], &qscratch,
+                                        nidx * p->width);
           begin_req(*p);
           const UpdateOpts uo = parse_opts(req, 3);
-          const float* vals = req.args[1].as_f32();
           for (size_t i = 0; i < nidx; ++i)
             apply_update(*p, static_cast<size_t>(idx[i]) * p->width,
                          vals + i * p->width, p->width, uo);
@@ -450,7 +497,8 @@ class PsServer {
           std::memcpy(out.data() + i * p->width,
                       p->data.data() + static_cast<size_t>(oidx[i]) * p->width,
                       p->width * 4);
-        rsp->args.push_back(Arg::f32(out.data(), out.size()));
+        rsp->args.push_back(rsp_value(req, out.data(), out.size(),
+                                      p->width));
         break;
       }
       case PsfType::kParamAssign: {
@@ -537,7 +585,8 @@ class PsServer {
           }
         }
         rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
-        rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
+        rsp->args.push_back(rsp_value(req, rows.data(), rows.size(),
+                                      p->width));
         rsp->args.push_back(Arg::i64(vers.data(), vers.size()));
         break;
       }
@@ -550,17 +599,18 @@ class PsServer {
         const int64_t* idx = req.args[0].as_i64();
         size_t nidx = req.args[0].n_i64();
         check_rows(*p, idx, nidx);  // before any mutation
-        if (req.args[1].n_f32() != nidx * p->width ||
+        if (value_count(req.args[1]) != nidx * p->width ||
             req.args[2].n_i64() != nidx)
           throw std::runtime_error(
               "kPushEmbedding arg length mismatch: " +
-              std::to_string(req.args[1].n_f32()) + " grads / " +
+              std::to_string(value_count(req.args[1])) + " grads / " +
               std::to_string(req.args[2].n_i64()) + " ups for " +
               std::to_string(nidx) + " rows x width " +
               std::to_string(p->width));
         if (skip_apply) break;
+        const float* grads = value_f32(req.args[1], &qscratch,
+                                       nidx * p->width);
         begin_req(*p);
-        const float* grads = req.args[1].as_f32();
         const int64_t* ups = req.args[2].as_i64();
         for (size_t i = 0; i < nidx; ++i) {
           size_t r = static_cast<size_t>(idx[i]);
@@ -594,17 +644,18 @@ class PsServer {
         // validate BOTH sides before any mutation (rejected => untouched)
         check_rows(*p, idx, nidx);
         check_rows(*p, sidx, ns);
-        if (req.args[1].n_f32() != nidx * p->width ||
+        if (value_count(req.args[1]) != nidx * p->width ||
             req.args[2].n_i64() != nidx)
           throw std::runtime_error(
               "kPushSyncEmbedding arg length mismatch: " +
-              std::to_string(req.args[1].n_f32()) + " grads / " +
+              std::to_string(value_count(req.args[1])) + " grads / " +
               std::to_string(req.args[2].n_i64()) + " ups for " +
               std::to_string(nidx) + " rows x width " +
               std::to_string(p->width));
         if (!skip_apply) {
+          const float* grads = value_f32(req.args[1], &qscratch,
+                                         nidx * p->width);
           begin_req(*p);
-          const float* grads = req.args[1].as_f32();
           const int64_t* ups = req.args[2].as_i64();
           for (size_t i = 0; i < nidx; ++i) {
             size_t r = static_cast<size_t>(idx[i]);
@@ -635,7 +686,8 @@ class PsServer {
           }
         }
         rsp->args.push_back(Arg::i32(sel.data(), sel.size()));
-        rsp->args.push_back(Arg::f32(rows.data(), rows.size()));
+        rsp->args.push_back(rsp_value(req, rows.data(), rows.size(),
+                                      p->width));
         rsp->args.push_back(Arg::i64(vers.data(), vers.size()));
         break;
       }

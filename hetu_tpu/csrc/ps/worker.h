@@ -131,6 +131,12 @@ class PsWorker {
         sched_port_(sched_port), pool_(n_threads) {
     recv_timeout_ms_ = env_int_or("DMLC_PS_RECV_TIMEOUT_MS", 15000);
     max_retry_ = env_int_or("DMLC_PS_MAX_RETRY", 3);
+    // hetuq: quantize push/pull value payloads (ArgType::kQI8 — row-wise
+    // int8 for sparse, kQuantWireBlock blocks for dense). Env default so a
+    // bare PSClient inherits the run's knob; SetCommQuant overrides.
+    if (const char* q = std::getenv("HETU_COMM_QUANT"))
+      quant_ = (std::string(q) == "int8" || std::string(q) == "fp8" ||
+                std::string(q) == "1");
     // opt-in failover: after the fast retries exhaust, block-with-deadline
     // for a replacement server to register instead of throwing (0 = off)
     failover_ms_ = env_int_or("DMLC_PS_FAILOVER_DEADLINE_MS", 0);
@@ -289,6 +295,19 @@ class PsWorker {
     return true;
   }
 
+  // -- hetuq quantized wire (docs/COMM_QUANT.md) --------------------------
+  void set_quant(bool on) { quant_.store(on); }
+  bool quant_enabled() const { return quant_.load(); }
+
+  // test hook (capi gates it on HETU_TEST_MODE): corrupt the scale bytes of
+  // the NEXT quantized value payload (optionally only for `tensor`), to
+  // prove the server's length/scale validation rejects the message instead
+  // of applying garbage. One-shot.
+  void arm_quant_corrupt(int32_t tensor) {
+    corrupt_tensor_.store(tensor);
+    corrupt_armed_.store(true);
+  }
+
   const TensorMeta& meta(int32_t key) {
     std::lock_guard<std::mutex> g(meta_mu_);
     auto it = metas_.find(key);
@@ -322,7 +341,8 @@ class PsWorker {
           Message req;
           req.head.type = static_cast<int32_t>(PsfType::kDensePush);
           req.head.tensor_id = key;
-          req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          req.args.push_back(value_arg(key, grad + lo, hi - lo,
+                                       kQuantWireBlock));
           if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           rpc(s, req);
           record("push", (hi - lo) * 4);
@@ -363,10 +383,14 @@ class PsWorker {
           Message req;
           req.head.type = static_cast<int32_t>(PsfType::kDDPushPull);
           req.head.tensor_id = key;
-          req.args.push_back(Arg::f32(grad + lo, hi - lo));
+          mark_quant_rsp(&req);
+          req.args.push_back(value_arg(key, grad + lo, hi - lo,
+                                       kQuantWireBlock));
           if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           Message rsp = rpc(s, req);
-          std::memcpy(out + lo, rsp.args[0].as_f32(), (hi - lo) * 4);
+          std::vector<float> scratch;
+          std::memcpy(out + lo, rsp_view(rsp.args[0], &scratch),
+                      (hi - lo) * 4);
           record("ddpushpull", (hi - lo) * 8);
         });
       });
@@ -448,7 +472,8 @@ class PsWorker {
           req.head.type = static_cast<int32_t>(PsfType::kSparsePush);
           req.head.tensor_id = key;
           req.args.push_back(Arg::i64(loc.data(), loc.size()));
-          req.args.push_back(Arg::f32(shard_vals.data(), shard_vals.size()));
+          req.args.push_back(value_arg(key, shard_vals.data(),
+                                       shard_vals.size(), m.width));
           if (has_uo) req.args.push_back(Arg::f32(uo.data(), 3));
           rpc(s, req);
           record("sparse_push", shard_vals.size() * 4);
@@ -475,9 +500,11 @@ class PsWorker {
             Message req;
             req.head.type = static_cast<int32_t>(PsfType::kSparsePull);
             req.head.tensor_id = key;
+            mark_quant_rsp(&req);
             req.args.push_back(Arg::i64(loc.data(), loc.size()));
             Message rsp = rpc(s, req);
-            const float* rows = rsp.args[0].as_f32();
+            std::vector<float> scratch;
+            const float* rows = rsp_view(rsp.args[0], &scratch);
             for (size_t i = 0; i < loc.size(); ++i)
               std::memcpy(uniq_vals->data() + sk_p->positions[s][i] * m.width,
                           rows + i * m.width, m.width * 4);
@@ -590,13 +617,15 @@ class PsWorker {
       Message req;
       req.head.type = static_cast<int32_t>(PsfType::kSyncEmbedding);
       req.head.tensor_id = key;
+      mark_quant_rsp(&req);
       req.args.push_back(Arg::i64(loc.data(), loc.size()));
       req.args.push_back(Arg::i64(shard_vers.data(), shard_vers.size()));
       req.args.push_back(Arg::i64(&bound, 1));
       Message rsp = rpc(s, req);
       const int32_t* sel = rsp.args[0].as_i32();
       size_t nsel = rsp.args[0].size() / 4;
-      const float* rows = rsp.args[1].as_f32();
+      std::vector<float> scratch;
+      const float* rows = rsp_view(rsp.args[1], &scratch);
       const int64_t* vers = rsp.args[2].as_i64();
       for (size_t i = 0; i < nsel; ++i) {
         out_pos->push_back(sk.positions[s][sel[i]]);
@@ -627,7 +656,8 @@ class PsWorker {
       req.head.type = static_cast<int32_t>(PsfType::kPushEmbedding);
       req.head.tensor_id = key;
       req.args.push_back(Arg::i64(loc.data(), loc.size()));
-      req.args.push_back(Arg::f32(shard_grads.data(), shard_grads.size()));
+      req.args.push_back(value_arg(key, shard_grads.data(),
+                                   shard_grads.size(), m.width));
       req.args.push_back(Arg::i64(shard_ups.data(), shard_ups.size()));
       rpc(s, req);
       record("push_embedding", shard_grads.size() * 4);
@@ -668,8 +698,10 @@ class PsWorker {
       Message req;
       req.head.type = static_cast<int32_t>(PsfType::kPushSyncEmbedding);
       req.head.tensor_id = key;
+      mark_quant_rsp(&req);
       req.args.push_back(Arg::i64(locp.data(), locp.size()));
-      req.args.push_back(Arg::f32(shard_grads.data(), shard_grads.size()));
+      req.args.push_back(value_arg(key, shard_grads.data(),
+                                   shard_grads.size(), m.width));
       req.args.push_back(Arg::i64(shard_ups.data(), shard_ups.size()));
       req.args.push_back(Arg::i64(locs.data(), locs.size()));
       req.args.push_back(Arg::i64(shard_vers.data(), shard_vers.size()));
@@ -677,7 +709,8 @@ class PsWorker {
       Message rsp = rpc(s, req);
       const int32_t* sel = rsp.args[0].as_i32();
       size_t nsel = rsp.args[0].size() / 4;
-      const float* rows = rsp.args[1].as_f32();
+      std::vector<float> scratch;
+      const float* rows = rsp_view(rsp.args[1], &scratch);
       const int64_t* vers = rsp.args[2].as_i64();
       for (size_t i = 0; i < nsel; ++i) {
         out_pos->push_back(sks.positions[s][sel[i]]);
@@ -710,12 +743,17 @@ class PsWorker {
 
   // Worker-side RPC counters (telemetry: kServerStats' client-side twin):
   // [rpc round trips issued, fast-retry attempts, successful failover
-  // re-issues]. Relaxed atomics bumped on the rpc path — counting costs
-  // nothing whether or not anyone ever reads them.
+  // re-issues, raw value-payload bytes, wire value-payload bytes]. The two
+  // byte counters cover every quantizable payload leg in BOTH modes
+  // (raw == wire with quantization off), so raw/wire is the measured
+  // compression ratio. Relaxed atomics bumped on the rpc path — counting
+  // costs nothing whether or not anyone ever reads them.
   std::vector<int64_t> client_stats() const {
     return {static_cast<int64_t>(rpc_count_.load()),
             static_cast<int64_t>(retry_count_.load()),
-            static_cast<int64_t>(failover_count_.load())};
+            static_cast<int64_t>(failover_count_.load()),
+            static_cast<int64_t>(val_raw_bytes_.load()),
+            static_cast<int64_t>(val_wire_bytes_.load())};
   }
 
   // Per-server HA counters (kServerStats; rides the fast channel):
@@ -808,6 +846,56 @@ class PsWorker {
   }
 
  private:
+  // One value payload of a push-side RPC: quantized (kQI8) when the knob is
+  // on, plain f32 otherwise — with raw-vs-wire byte accounting either way,
+  // so an off-vs-int8 A/B reads its compression ratio straight from
+  // client_stats. `block` is the scale granularity (row width for sparse
+  // payloads, kQuantWireBlock for dense).
+  Arg value_arg(int32_t key, const float* vals, size_t n, size_t block) {
+    val_raw_bytes_.fetch_add(n * 4, std::memory_order_relaxed);
+    if (!quant_.load(std::memory_order_relaxed)) {
+      val_wire_bytes_.fetch_add(n * 4, std::memory_order_relaxed);
+      return Arg::f32(vals, n);
+    }
+    Arg a = make_qi8_arg(vals, n, block);
+    if (corrupt_armed_.load(std::memory_order_relaxed)) {
+      const int32_t t = corrupt_tensor_.load();
+      bool mine = t < 0 || t == key;
+      bool expected = true;
+      if (mine && corrupt_armed_.compare_exchange_strong(expected, false) &&
+          a.buf.size() >= sizeof(QI8Header) + 4) {
+        // 0xFF-fill the first block's scale -> NaN: must be REJECTED by
+        // the server's scale validation (see net.h dequant_qi8)
+        std::memset(a.buf.data() + sizeof(QI8Header), 0xFF, 4);
+      }
+    }
+    val_wire_bytes_.fetch_add(a.buf.size(), std::memory_order_relaxed);
+    return a;
+  }
+
+  // f32 view of a response value payload (dequantizes kQI8 into `scratch`
+  // — the bounded-staleness cache and every pull consumer see plain f32
+  // rows, so caching/staleness semantics are untouched), with the same
+  // raw/wire accounting as value_arg.
+  const float* rsp_view(const Arg& a, std::vector<float>* scratch) {
+    if (a.dtype == ArgType::kQI8) {
+      dequant_qi8(a, scratch, 0);
+      val_raw_bytes_.fetch_add(scratch->size() * 4,
+                               std::memory_order_relaxed);
+      val_wire_bytes_.fetch_add(a.buf.size(), std::memory_order_relaxed);
+      return scratch->data();
+    }
+    val_raw_bytes_.fetch_add(a.buf.size(), std::memory_order_relaxed);
+    val_wire_bytes_.fetch_add(a.buf.size(), std::memory_order_relaxed);
+    return a.as_f32();
+  }
+
+  // request flag asking the server to quantize ITS response value payloads
+  void mark_quant_rsp(Message* req) {
+    if (quant_.load(std::memory_order_relaxed))
+      req->head.flags |= kFlagQuantRsp;
+  }
+
   int connect_addr(const std::string& addr, int retries = 600,
                    int wait_ms = 100) {
     auto colon = addr.rfind(':');
@@ -1090,6 +1178,14 @@ class PsWorker {
   std::atomic<uint64_t> rpc_count_{0};       // telemetry (client_stats)
   std::atomic<uint64_t> retry_count_{0};
   std::atomic<uint64_t> failover_count_{0};
+  // hetuq: quantized-wire state + raw-vs-wire accounting over every
+  // quantizable value payload (pushes and pull responses; counted in BOTH
+  // modes so off==raw is the A/B denominator)
+  std::atomic<bool> quant_{false};
+  std::atomic<bool> corrupt_armed_{false};
+  std::atomic<int32_t> corrupt_tensor_{-1};
+  std::atomic<uint64_t> val_raw_bytes_{0};
+  std::atomic<uint64_t> val_wire_bytes_{0};
   std::unique_ptr<Conn> sched_;
   std::mutex sched_mu_;
   std::mutex addr_mu_;   // guards server_addrs_ (both channels' retries)

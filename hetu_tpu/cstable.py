@@ -5,6 +5,14 @@ selection :25-33, async lookup/update/push-pull returning wait handles
 :47-119, perf counters and miss-rate/data-rate helpers :126-187). The backing
 store is the C++ cache in ``hetu_tpu/csrc/cache`` via ctypes (the reference
 uses a pybind11 ``hetu_cache`` module).
+
+hetuq interplay (docs/COMM_QUANT.md): with ``comm_quant`` active the
+kSyncEmbedding/kPushSyncEmbedding wire payloads the cache's server traffic
+rides are quantized, but the worker agent dequantizes every pulled row
+BEFORE it reaches the cache (``worker.h rsp_view``) and the server applies
+pushed grads in f32 — cached lines are always plain f32 rows and the
+bounded-staleness version algebra is untouched; quantization exists only on
+the wire between them.
 """
 from __future__ import annotations
 

@@ -99,7 +99,10 @@ class HetuConfig:
                  cache_bound=100, log_path=None, gpipe=False,
                  gpipe_microbatches=None, dtype=np.float32,
                  dp_axis="dp", mp_axis="tp", anomaly_guard=False,
-                 telemetry=None, introspect=None, **kwargs):
+                 telemetry=None, introspect=None, comm_quant=None,
+                 comm_quant_block=None, comm_quant_min_size=None,
+                 comm_quant_error_feedback=None, comm_quant_force=(),
+                 **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -144,6 +147,22 @@ class HetuConfig:
         # trips. Env default: HETU_INTROSPECT (+ HETU_INTROSPECT_EVERY).
         from ..telemetry.scope import resolve_introspect
         self.introspect = resolve_introspect(introspect)
+        # hetuq (docs/COMM_QUANT.md): quantized communication policy. "off"
+        # (default) leaves every comm path bit-identical to pre-hetuq
+        # behavior; "int8"/"fp8" compresses the DP AllReduce broadcast half
+        # in-trace (per-block scaling, optional error-feedback residual as
+        # executor state, small params exempt by min_size) and arms the PS
+        # worker's int8 wire container. Env default: HETU_COMM_QUANT (+
+        # _BLOCK/_MIN/_EF).
+        from ..comm_quant import resolve_policy
+        self.comm_quant_policy = resolve_policy(
+            comm_quant, comm_quant_block, comm_quant_min_size,
+            comm_quant_error_feedback, comm_quant_force)
+        self.comm_quant = self.comm_quant_policy.mode
+        if self.comm_quant != "off" and gpipe:
+            raise ValueError(
+                "comm_quant is not supported with gpipe=True: the pipeline "
+                "executor owns its own cross-stage transfers")
         if self.anomaly_guard and comm_mode in ("PS", "Hybrid"):
             raise ValueError(
                 "anomaly_guard gates the on-device state commit, but PS-"
@@ -257,6 +276,11 @@ class TraceContext:
         self.param_updates: dict[int, Any] = {}
         self.slot_updates: dict[int, Any] = {}
         self.ps_grad_outputs: dict[int, Any] = {}
+        # hetuq error-feedback residuals: executor-threaded state keyed by
+        # quantized AllReduce op id (in: previous step's residual; updates:
+        # this step's quantization error, committed like slots)
+        self.qresid_in: dict[int, Any] = {}
+        self.qresid_updates: dict[int, Any] = {}
         self.grad_cache: dict[int, dict[int, Any]] = {}
         self._in_grad_retrace = False
         # f32 master copies of params when compute_dtype is lower precision
@@ -279,7 +303,7 @@ class TraceContext:
             self.rng_key, self._node_index.get(id(node), node.id))
 
     # -- collectives (GSPMD) ----------------------------------------------
-    def allreduce(self, x, param_node=None):
+    def allreduce(self, x, param_node=None, op=None):
         mesh = self.config.mesh
         if mesh is None:
             return x
@@ -288,6 +312,22 @@ class TraceContext:
         # reference); a tp-sharded parameter's gradient stays tp-sharded.
         spec = (self.config.param_specs.get(id(param_node), P())
                 if param_node is not None else P())
+        # hetuq: ops the Executor marked (comm_quant policy, eligibility by
+        # size/override) lower as reduce-scatter(f32) -> blockwise quantize
+        # -> all-gather(int8/fp8) -> dequantize, with the error-feedback
+        # residual threaded through executor state (docs/COMM_QUANT.md)
+        if op is not None and getattr(op, "comm_quant", False) \
+                and self.config.comm_quant_policy.active \
+                and hasattr(x, "dtype") \
+                and jnp.issubdtype(x.dtype, jnp.floating) \
+                and self.config.dp_axis in mesh.axis_names:
+            from .. import comm_quant as _cq
+            out, new_resid = _cq.quantized_allreduce(
+                x, self.qresid_in.get(id(op)), mesh, self.config.dp_axis,
+                NamedSharding(mesh, spec), self.config.comm_quant_policy)
+            if new_resid is not None and not self._in_grad_retrace:
+                self.qresid_updates[id(op)] = new_resid
+            return out
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
     def apply_dispatch(self, op: DispatchOp, x):
@@ -433,6 +473,13 @@ class SubExecutor:
         self.dataloader_nodes = [n for n in self.topo if n.is_dataloader]
         self.stateful_nodes = [n for n in self.topo if n.stateful]
         self.optimizer_nodes = [n for n in self.topo if n.is_optimizer]
+        # hetuq: quantized-AllReduce ops appearing in this target's topo and
+        # the subset carrying error-feedback residual state — the residuals
+        # ride through the jitted step like optimizer slots
+        _qids = {id(n) for n in getattr(executor, "qar_ops", ())}
+        self.qar_nodes = [n for n in self.topo if id(n) in _qids]
+        self.qresid_nodes = [n for n in self.qar_nodes
+                             if id(n) in executor.state.get("qresid", {})]
         # finite-check + gated commit only makes sense where state commits
         self.anomaly_guard = self.training and self.config.anomaly_guard
         self._compiled: dict[tuple, Any] = {}
@@ -588,6 +635,7 @@ class SubExecutor:
         ps_sparse_vars = self.ps_sparse_vars
         ps_dense_vars = self.ps_dense_vars
         ps_comm_ops = self.ps_comm_ops
+        qresid_nodes = self.qresid_nodes
 
         host_dl_nodes = self.host_dl_nodes
         res_dl_specs = [(n,) + self.resident_dl[id(n)][1:]
@@ -608,7 +656,7 @@ class SubExecutor:
 
         def step_fn(params_t, slots_t, opstate_t, rng_root, step, feeds_t,
                     batches_t, dl_cursors_t, res_data_t, ps_staged_t,
-                    ps_dense_t, inject_nan_t):
+                    ps_dense_t, inject_nan_t, qresid_t):
             # fold the step into the rng INSIDE the trace: doing it eagerly
             # costs ~5 dispatched host ops per step (measured ~3ms over the
             # tunneled chip; free here)
@@ -646,6 +694,7 @@ class SubExecutor:
             tc = TraceContext(config, topo, training, env, rng, step, op_state_in)
             tc.master_params = masters
             tc.poison_scope = poison_scope
+            tc.qresid_in = {id(n): v for n, v in zip(qresid_nodes, qresid_t)}
             slots_in = {id(n): s for n, s in zip(opt_nodes, slots_t)}
             for node in topo:
                 if id(node) in env:
@@ -669,6 +718,8 @@ class SubExecutor:
             new_opstate = tuple(tc.op_state_updates.get(id(n), op_state_in[id(n)])
                                 for n in stateful_nodes)
             ps_grads = tuple(tc.ps_grad_outputs[id(op)] for op in ps_comm_ops)
+            new_qresid = tuple(tc.qresid_updates.get(id(n), tc.qresid_in[id(n)])
+                               for n in qresid_nodes)
             scope_stats = ()
             if introspect_now:
                 # -- hetuscope in-graph stats (one extra fetch) ------------
@@ -766,13 +817,21 @@ class SubExecutor:
                 new_opstate = tuple(
                     keep(s, op_state_in[id(n)])
                     for s, n in zip(new_opstate, stateful_nodes))
+                # error-feedback residuals roll back with the params: a
+                # rolled-back step must not leave a phantom residual behind
+                new_qresid = tuple(
+                    jnp.where(finite, a, b)
+                    for a, b in zip(new_qresid, qresid_t))
             return outputs, new_params, new_slots, new_opstate, ps_grads, \
-                finite, scope_stats
+                new_qresid, finite, scope_stats
 
         # HETU_NO_DONATE=1: bisect knob for the bench wedge harness
         # (tools/wedge_bisect.py) — donation changes XLA's buffer
-        # assignment, one of the suspects for the bf16 bs>=256 hang
-        donate = ((0, 1, 2) if training and donate_ok
+        # assignment, one of the suspects for the bf16 bs>=256 hang.
+        # qresid (arg 12) donates like the state it is: the hetuq residuals
+        # are full-size param copies, and without donation each step would
+        # transiently double their HBM footprint
+        donate = ((0, 1, 2, 12) if training and donate_ok
                   and os.environ.get("HETU_NO_DONATE") != "1" else ())
         return jax.jit(step_fn, donate_argnums=donate)
 
@@ -969,7 +1028,8 @@ class SubExecutor:
         args = (params_t, slots_t, opstate_t, ex.rng_root, np.int32(step),
                 tuple(feed_vals), tuple(batch_vals), tuple(dl_cursors),
                 res_data, tuple(ps_staged_vals), tuple(ps_dense_vals),
-                np.bool_(inject_nan))
+                np.bool_(inject_nan),
+                tuple(ex.state["qresid"][id(n)] for n in self.qresid_nodes))
         from ..telemetry import scope as _scope
         *_rest, stats_t = fn(*args)
         order, inputs_map, spec = self._scope_meta
@@ -1178,6 +1238,7 @@ class SubExecutor:
         params_t = tuple(ex.state["params"][id(n)] for n in ex.param_nodes)
         slots_t = tuple(ex.state["slots"][id(n)] for n in self.optimizer_nodes)
         opstate_t = tuple(ex.state["op_state"][id(n)] for n in self.stateful_nodes)
+        qresid_t = tuple(ex.state["qresid"][id(n)] for n in self.qresid_nodes)
 
         res_data = tuple(self.resident_dl[id(n)][0]
                          for n in self.res_dl_nodes)
@@ -1186,7 +1247,7 @@ class SubExecutor:
         args = (params_t, slots_t, opstate_t, ex.rng_root, np.int32(step),
                 tuple(feed_vals), tuple(batch_vals), tuple(dl_cursors),
                 res_data, tuple(ps_staged_vals), tuple(ps_dense_vals),
-                np.bool_(inject_nan))
+                np.bool_(inject_nan), qresid_t)
         self._last_call = (fn, args)
         if tel is not None and tel.xla_window is not None and self.training:
             # env-gated deep dive: HETU_XLA_TRACE=dir[:start[:n]] opens a
@@ -1198,10 +1259,10 @@ class SubExecutor:
             # trace is active (the XLA window above, or an external capture)
             with _XW.step_annotation(step):
                 outputs, new_params, new_slots, new_opstate, ps_grads, \
-                    finite_t, scope_stats_t = fn(*args)
+                    qresid_out, finite_t, scope_stats_t = fn(*args)
         else:
-            outputs, new_params, new_slots, new_opstate, ps_grads, finite_t, \
-                scope_stats_t = fn(*args)
+            outputs, new_params, new_slots, new_opstate, ps_grads, \
+                qresid_out, finite_t, scope_stats_t = fn(*args)
         t_d1 = time.perf_counter() if timed else 0.0
         if prof is not None:
             prof["dispatch_s"] += t_d1 - t_d0
@@ -1262,6 +1323,8 @@ class SubExecutor:
                 ex.state["slots"][id(node)] = val
             for node, val in zip(self.stateful_nodes, new_opstate):
                 ex.state["op_state"][id(node)] = val
+            for node, val in zip(self.qresid_nodes, qresid_out):
+                ex.state["qresid"][id(node)] = val
             ex.state["step"] = step + 1
 
         finite = True
@@ -1506,6 +1569,53 @@ class Executor:
             params[id(node)] = value
             config.placeholder_to_arr_map[node] = value
 
+        # -- hetuq: quantized DP AllReduce eligibility (docs/COMM_QUANT.md) -
+        # Marks the AllReduce ops whose gradient sync the policy compresses:
+        # device-resident f32 params at/above the size threshold (or force-
+        # listed), pure-DP only — tp-sharded params keep the exact path, as
+        # does everything when comm_quant="off" (the marked-op check in
+        # TraceContext.allreduce is the single branch point, so off mode is
+        # bit-identical to pre-hetuq behavior). Error-feedback residuals are
+        # executor state, committed/rolled back like optimizer slots.
+        qpol = config.comm_quant_policy
+        self.qar_ops = []
+        qresid = {}
+        for node in full_topo:
+            if not isinstance(node, AllReduceCommunicateOp):
+                continue
+            # ALWAYS reset first: graph nodes are shared between executors
+            # (A/B legs reuse a built graph), and a stale mark from a
+            # previous quantized executor must never leak into this one —
+            # off mode re-asserts the exact path on every node
+            node.comm_quant = False
+            if not qpol.active or config.mesh is None:
+                continue
+            pn = node.param_node
+            val = params.get(id(pn)) if pn is not None else None
+            if val is None or id(pn) in config.param_specs:
+                continue
+            if not jnp.issubdtype(val.dtype, jnp.floating):
+                continue
+            if qpol.applies(pn, int(np.prod(val.shape))):
+                node.comm_quant = True
+                self.qar_ops.append(node)
+                if qpol.error_feedback:
+                    qresid[id(node)] = jnp.zeros_like(
+                        val, dtype=jnp.float32)
+        self.comm_quant_report = None
+        if self.qar_ops:
+            from .. import comm_quant as _cq
+            sizes = {n.param_node.name: int(np.prod(params[id(n.param_node)].shape))
+                     for n in self.qar_ops}
+            self.comm_quant_report = _cq.allreduce_wire_report(
+                sizes, qpol, config.dp_size)
+            if self.telemetry is not None:
+                g = self.telemetry.metrics.gauge
+                g("hetu_comm_quant_raw_bytes").set(
+                    float(self.comm_quant_report["raw_bytes"]))
+                g("hetu_comm_quant_wire_bytes").set(
+                    float(self.comm_quant_report["wire_bytes"]))
+
         slots = {}
         op_state = {}
         for node in full_topo:
@@ -1517,7 +1627,7 @@ class Executor:
             if node.stateful:
                 op_state[id(node)] = jax.tree.map(jnp.asarray, node.state_init())
         self.state = {"params": params, "slots": slots, "op_state": op_state,
-                      "step": 0,
+                      "qresid": qresid, "step": 0,
                       # resilience counters (anomaly_guard):
                       "anomaly_streak": 0, "anomaly_total": 0,
                       "last_step_finite": True}
@@ -1752,6 +1862,11 @@ class Executor:
                       for i, n in enumerate(self._opt_nodes())},
             "op_state": {str(i): jax.tree.map(np.asarray, self.state["op_state"][id(n)])
                          for i, n in enumerate(self._stateful_nodes())},
+            # hetuq error-feedback residuals: without them a resumed run's
+            # first quantized steps would re-pay the cold-start compression
+            # error the residual had already absorbed
+            "qresid": {str(i): np.asarray(self.state["qresid"][id(n)])
+                       for i, n in enumerate(self._qresid_ordered())},
         }
         with open(os.path.join(file_path, "executor_state.pkl"), "wb") as f:
             pickle.dump(aux, f)
@@ -1788,6 +1903,18 @@ class Executor:
                 if str(i) in aux.get("op_state", {}):
                     self.state["op_state"][id(n)] = jax.tree.map(
                         jnp.asarray, aux["op_state"][str(i)])
+            for i, n in enumerate(self._qresid_ordered()):
+                if str(i) in aux.get("qresid", {}):
+                    v = jnp.asarray(aux["qresid"][str(i)], jnp.float32)
+                    if self.config.mesh is not None:
+                        v = jax.device_put(
+                            v, NamedSharding(self.config.mesh, P()))
+                    self.state["qresid"][id(n)] = v
+
+    def _qresid_ordered(self):
+        """Stable checkpoint order for the error-feedback residuals (the
+        quantized-AllReduce op scan order)."""
+        return [n for n in self.qar_ops if id(n) in self.state["qresid"]]
 
     def _opt_nodes(self):
         seen, out = set(), []

@@ -28,13 +28,19 @@ class AllReduceCommunicateOp(Op):
     stay split over tp) — ``param_node`` carries that association.
     """
 
+    # hetuq (docs/COMM_QUANT.md): the Executor flips this on per op when the
+    # comm_quant policy quantizes the target parameter's gradient sync —
+    # TraceContext.allreduce then lowers the marker as reduce-scatter(f32)
+    # -> blockwise quantize -> all-gather(int8/fp8) -> dequantize
+    comm_quant = False
+
     def __init__(self, node, comm=None, ctx=None, param_node=None):
         super().__init__([node], ctx)
         self.comm = comm
         self.param_node = param_node
 
     def compute(self, input_vals, tc):
-        return tc.allreduce(input_vals[0], self.param_node)
+        return tc.allreduce(input_vals[0], self.param_node, op=self)
 
 
 def allreduceCommunicate_op(node, comm=None, ctx=None, param_node=None):

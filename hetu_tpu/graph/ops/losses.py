@@ -41,7 +41,10 @@ def binarycrossentropy_op(node_A, node_B, ctx=None):
     (reference BinaryCrossEntropy.py)."""
 
     def _bce(pred, label):
-        eps = 1e-12
+        # 1e-7, not the reference's 1e-12: in f32, 1.0 - 1e-12 rounds to
+        # exactly 1.0, so a saturated sigmoid still reached log(0) and one
+        # fully-confident wrong example NaN'd the whole training run
+        eps = 1e-7
         pred = jnp.clip(pred, eps, 1.0 - eps)
         return -(label * jnp.log(pred) + (1.0 - label) * jnp.log(1.0 - pred))
 
@@ -50,7 +53,7 @@ def binarycrossentropy_op(node_A, node_B, ctx=None):
 
 def binarycrossentropy_gradient_op(node_A, node_B, node_C, ctx=None):
     def _grad(pred, label, dl):
-        eps = 1e-12
+        eps = 1e-7  # f32-meaningful clip (see binarycrossentropy_op)
         pred = jnp.clip(pred, eps, 1.0 - eps)
         return (pred - label) / (pred * (1.0 - pred)) * dl
 

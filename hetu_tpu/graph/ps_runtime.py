@@ -131,6 +131,13 @@ class PSRuntime:
             ps_pkg.worker_init()
         self.comm = ps_pkg.get_worker_communicate()
         self.bsp = bool(config.bsp)
+        # hetuq (docs/COMM_QUANT.md): arm/disarm the worker's quantized wire
+        # explicitly — the communicator is a process singleton, so an A/B of
+        # two executors must not inherit the other leg's setting. The PS
+        # wire container is int8 either way (fp8 is an AllReduce-only mode).
+        self.comm_quant = getattr(config, "comm_quant", "off") or "off"
+        if hasattr(self.comm, "SetCommQuant"):
+            self.comm.SetCommQuant(self.comm_quant != "off")
 
         # -- identify PS-hosted params (reference context.py:146-148) -------
         embed_vars = set()
@@ -586,6 +593,17 @@ class PSRuntime:
             reg.gauge("hetu_ps_rpcs_total").set(cs["rpcs"])
             reg.gauge("hetu_ps_retries_total").set(cs["retries"])
             reg.gauge("hetu_ps_failovers_total").set(cs["failovers"])
+            # hetuq raw-vs-wire accounting (worker.h value payloads; with
+            # quantization off raw == wire) — what hetutop's PS panel shows
+            # as the measured compression ratio
+            raw = cs.get("quant_raw_bytes", 0)
+            wire = cs.get("quant_wire_bytes", 0)
+            if raw or wire:
+                reg.gauge("hetu_comm_quant_raw_bytes_total").set(raw)
+                reg.gauge("hetu_comm_quant_wire_bytes_total").set(wire)
+                if wire:
+                    reg.gauge("hetu_comm_quant_ratio").set(
+                        round(raw / wire, 4))
         except Exception:  # noqa: BLE001
             pass
         for p in self.params.values():

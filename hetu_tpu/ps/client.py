@@ -147,13 +147,37 @@ class PSClient:
 
     def ClientStats(self) -> dict:
         """This worker's RPC counters: round trips issued, fast-retry
-        attempts, successful failover re-issues (worker.h client_stats)."""
-        out = np.zeros(3, np.int64)
+        attempts, successful failover re-issues, plus the hetuq raw-vs-wire
+        byte counters over every quantizable value payload (pushes and pull
+        responses; with quantization off raw == wire, so an off-vs-int8 A/B
+        reads its compression ratio straight from here — worker.h
+        client_stats, docs/COMM_QUANT.md)."""
+        out = np.zeros(5, np.int64)
         self._lib.QueryClientStats(out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(3))
+                                   ctypes.c_int(5))
         self._check()
         return {"rpcs": int(out[0]), "retries": int(out[1]),
-                "failovers": int(out[2])}
+                "failovers": int(out[2]),
+                "quant_raw_bytes": int(out[3]),
+                "quant_wire_bytes": int(out[4])}
+
+    def SetCommQuant(self, mode):
+        """hetuq: quantize this worker's PS value payloads on the wire
+        (row-wise int8 + one f32 scale per row for sparse traffic, ~256-
+        element blocks for dense — docs/COMM_QUANT.md). ``mode``: truthy /
+        "int8" / "fp8" enables, falsy / "off" disables. The server always
+        dequantizes and applies in f32, so dedup-sums, snapshots, and
+        lost-update accounting are untouched."""
+        on = mode not in (0, False, None, "", "off")
+        self._lib.SetCommQuant(ctypes.c_int(1 if on else 0))
+        self._check()
+
+    def TestCorruptNextQuant(self, node=-1):
+        """Test hook (requires HETU_TEST_MODE): flip the scale bytes of the
+        next quantized payload (``node`` < 0 = any tensor) — the server's
+        length/scale validation must reject it as an error response."""
+        self._lib.TestCorruptNextQuant(ctypes.c_int(int(node)))
+        self._check()
 
     # -- tensor init (reference InitTensor binding) -------------------------
     def InitTensor(self, node, sparse, length, width, init_type, init_a,

@@ -132,6 +132,13 @@ class FaultInjector:
       this process's ``ps.local_cluster`` (exercises the PS
       snapshot/respawn/failover stack end to end; bounds-checked in
       ``local_cluster.kill_live_server`` like ``resolve_test_kill_index``).
+    - ``quant_corrupt@S[:NODE]`` — flip the scale bytes of the next
+      quantized PS message this worker sends (``NODE`` = tensor id filter,
+      default any; requires ``HetuConfig(comm_quant=...)`` traffic) — the
+      server's length/scale validation must reject the malformed payload
+      as an error response instead of applying garbage
+      (docs/COMM_QUANT.md; the C++ hook is additionally gated on
+      HETU_TEST_MODE in capi.cc).
 
     ``from_env()`` (the only path wired into the executor by default) returns
     None unless :func:`test_mode_enabled` — direct construction is itself an
@@ -139,7 +146,7 @@ class FaultInjector:
     """
 
     KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
-             "ps_kill")
+             "ps_kill", "quant_corrupt")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -193,6 +200,12 @@ class FaultInjector:
         if e is not None:
             from .ps.local_cluster import kill_live_server
             kill_live_server(0 if e["arg"] is None else int(e["arg"]))
+        e = self.take("quant_corrupt", step)
+        if e is not None:
+            from . import ps as ps_pkg
+            comm = ps_pkg.get_worker_communicate()
+            comm.TestCorruptNextQuant(-1 if e["arg"] is None
+                                      else int(e["arg"]))
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:

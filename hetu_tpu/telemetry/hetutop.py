@@ -381,8 +381,10 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
                 ("hetu_ps_pull_ms", "_p50", "ps pull p50"),
                 ("hetu_ps_push_ms", "_p50", "ps push p50"),
                 ("hetu_cache_hit_rate", "", "cache hit"),
-                ("hetu_comm_fraction", "", "comm frac")):
-            unit = "" if base.endswith(("rate", "fraction")) else "ms"
+                ("hetu_comm_fraction", "", "comm frac"),
+                ("hetu_comm_quant_ratio", "", "quant ratio")):
+            unit = "" if base.endswith(("rate", "fraction", "ratio")) \
+                else "ms"
             for child, v in _metric_children(m, base, suffix):
                 tag = f"[{child}]" if child else ""
                 extras.append(f"{label}{tag} {v:.3g}{unit}")
@@ -435,6 +437,19 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
                 f"snap v{r.get('snapshot_version')} "
                 f"age={_fmt(r.get('snapshot_age_ms'), '.0f')}ms "
                 f"dedup_clients={r.get('dedup_clients')}")
+        # hetuq wire accounting (docs/COMM_QUANT.md): worker-side raw-vs-
+        # wire byte counters over every quantizable value payload — with
+        # quantization off raw == wire and the ratio reads 1.00x
+        qraw = qwire = 0.0
+        for rk in state["ranks"].values():
+            m = rk["metrics"]
+            qraw += _defloat(m.get("hetu_comm_quant_raw_bytes_total")) or 0.0
+            qwire += _defloat(m.get("hetu_comm_quant_wire_bytes_total")) \
+                or 0.0
+        if qwire:
+            lines.append(
+                f"  comm quant: raw {qraw / 2**20:.1f}MiB -> wire "
+                f"{qwire / 2**20:.1f}MiB  ratio {qraw / qwire:.2f}x")
     if state["events"]:
         lines.append("recent events:")
         for e in state["events"]:

@@ -139,6 +139,53 @@ def test_allreduce_degenerate():
     assert lints_of(fs, "allreduce-degenerate")
 
 
+def test_comm_quant_forced_small():
+    """Seeded defect: a force-listed param below the exemption threshold is
+    quantized anyway — the comm_quant lint must warn, with provenance on
+    the AllReduce marker (docs/COMM_QUANT.md exemption policy)."""
+    from hetu_tpu.comm_quant import QuantPolicy
+    w = ht.Variable(name="w_small_q", value=np.ones((4, 2), np.float32))
+    g = feed("gq", (4, 2))
+    ar = ht.allreduceCommunicate_op(g, param_node=w)
+    cfg = analysis.AnalysisConfig(
+        comm_mode="AllReduce", dp_size=8,
+        comm_quant_policy=QuantPolicy("int8", force=("w_small_q",)))
+    fs = analysis.analyze_graph([ar], config=cfg)
+    warns = lints_of(fs, "comm-quant-forced-small")
+    assert warns and warns[0].severity == "warn"
+    assert warns[0].op_name == ar.name
+    assert "w_small_q" in warns[0].message
+    # without the override the small param is exempt: no finding
+    cfg2 = analysis.AnalysisConfig(
+        comm_mode="AllReduce", dp_size=8,
+        comm_quant_policy=QuantPolicy("int8"))
+    assert not lints_of(analysis.analyze_graph([ar], config=cfg2),
+                        "comm-quant-forced-small")
+
+
+def test_comm_quant_no_error_feedback():
+    """Seeded defect: int8 AllReduce with error feedback disabled notes the
+    accumulating-compression-error hazard (once per graph)."""
+    from hetu_tpu.comm_quant import QuantPolicy
+    w = ht.Variable(name="w_big_q",
+                    value=np.ones((64, 64), np.float32))
+    g = feed("gq2", (64, 64))
+    ar = ht.allreduceCommunicate_op(g, param_node=w)
+    cfg = analysis.AnalysisConfig(
+        comm_mode="AllReduce", dp_size=8,
+        comm_quant_policy=QuantPolicy("int8", min_size=1024,
+                                      error_feedback=False))
+    fs = analysis.analyze_graph([ar], config=cfg)
+    notes = lints_of(fs, "comm-quant-no-error-feedback")
+    assert len(notes) == 1 and notes[0].severity == "note"
+    # with EF on (the default) the note disappears
+    cfg2 = analysis.AnalysisConfig(
+        comm_mode="AllReduce", dp_size=8,
+        comm_quant_policy=QuantPolicy("int8", min_size=1024))
+    assert not lints_of(analysis.analyze_graph([ar], config=cfg2),
+                        "comm-quant-no-error-feedback")
+
+
 def test_dispatch_rank_mismatch():
     w = ht.Variable(name="wd", value=np.ones((4, 4), np.float32))
     d = ht.dispatch(w, (1, 2, 1))  # rank 3 parts on a rank 2 input

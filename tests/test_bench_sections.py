@@ -18,7 +18,8 @@ import bench  # noqa: E402
 
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
-            "vit", "pipeline", "wdl", "introspect"]
+            "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
+            "introspect"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
