@@ -349,6 +349,28 @@ void QueryServerStats(int server, long long* out, int n) {
   });
 }
 
+// -- hetu-elastic membership (docs/FAULT_TOLERANCE.md) ----------------------
+
+// Stamp this worker's committed membership epoch onto every subsequent
+// request (servers armed via kSetWorldVersion reject mismatches).
+void SetWorldVersion(unsigned long long v) {
+  guard([&] { worker().set_world_version(static_cast<uint64_t>(v)); });
+}
+
+unsigned long long GetWorldVersion() {
+  return g_worker ? worker().world_version() : 0ull;
+}
+
+// Re-sync the server connection set + partitioner denominator with the
+// scheduler's address book after a committed resize (caller must have
+// drained all in-flight traffic). Returns the new server count, -1 on
+// error (stashed in LastError).
+int RefreshServers() {
+  int n = -1;
+  guard([&] { n = static_cast<int>(worker().refresh_servers()); });
+  return n;
+}
+
 // hetuq: toggle quantized value payloads (ArgType::kQI8) for this worker's
 // push/pull traffic. mode != 0 enables; the env default is HETU_COMM_QUANT.
 void SetCommQuant(int mode) {

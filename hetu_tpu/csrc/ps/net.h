@@ -68,6 +68,17 @@ enum class PsfType : int32_t {
   // arbitrary-length data blobs (reference PushData/PullData)
   kDataPush = 50,
   kDataPull = 51,
+  // hetu-elastic: live membership changes (docs/FAULT_TOLERANCE.md
+  // "Elastic membership"). Scheduler-side two-phase resize handshake:
+  kProposeResize = 60,  // coordinator -> scheduler: pending world + capacity
+  kResizeState = 61,    // any -> scheduler: world/pending/drain progress
+  kCommitResize = 62,   // worker -> scheduler: drain barrier (parks until
+                        // the coordinator finishes or aborts)
+  kFinishResize = 63,   // coordinator -> scheduler: flip/abort the world
+  kResizeLog = 64,      // any -> scheduler: committed era history
+  // server-side membership surface:
+  kListParams = 65,       // any -> server: param key/meta inventory
+  kSetWorldVersion = 66,  // coordinator -> server: arm stale-epoch rejection
 };
 
 struct MsgHeader {
@@ -79,7 +90,12 @@ struct MsgHeader {
   int32_t client_id = -1; // rank*2 + channel (bulk=0/fast=1) — the server's
                           // resend-dedup slot key; ids must be monotonic
                           // PER client_id stream. -1 = untracked
-  int32_t pad = 0;
+  int32_t world_ver = 0;  // hetu-elastic membership epoch stamp: servers
+                          // armed via kSetWorldVersion reject a mismatched
+                          // non-zero stamp (a straggler that missed a
+                          // resize commit). 0 = unversioned legacy
+                          // traffic, always accepted. Occupies the former
+                          // pad slot — the wire layout is unchanged.
 };
 
 enum class ArgType : int32_t { kF32 = 0, kI64 = 1, kF64 = 2, kBytes = 3, kI32 = 4, kU64 = 5,

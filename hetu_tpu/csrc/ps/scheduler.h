@@ -10,7 +10,9 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <map>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <utility>
 #include <vector>
@@ -40,6 +42,14 @@ class Scheduler {
       listen_fd_ = -1;
     }
     if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      // release any workers parked in the kCommitResize drain barrier —
+      // their conn threads otherwise wait on resize_cv_ forever and
+      // join_all() below never returns
+      std::lock_guard<std::mutex> g(mu_);
+      ++resize_gen_;
+      resize_cv_.notify_all();
+    }
     {
       std::lock_guard<std::mutex> g(fds_mu_);
       for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
@@ -85,7 +95,10 @@ class Scheduler {
     std::string sv, wk;
     for (int i = 0; i < num_servers_; ++i)
       if (!seen(0, i)) sv += (sv.empty() ? "" : ",") + std::to_string(i);
-    for (int i = 0; i < num_workers_; ++i)
+    // after an elastic resize the live worker ranks are members_, not
+    // necessarily 0..num_workers_-1
+    ensure_members_locked();
+    for (int32_t i : members_)
       if (!seen(1, i)) wk += (wk.empty() ? "" : ",") + std::to_string(i);
     throw std::runtime_error(
         "hetups scheduler: teardown wait timed out after " +
@@ -120,17 +133,21 @@ class Scheduler {
           std::unique_lock<std::mutex> g(mu_);
           int32_t epoch = 0;
           if (meta[0] == 0) {
-            if (meta[1] < 0 || meta[1] >= num_servers_) {
+            // capacity may exceed num_servers_ while a grow is pending
+            // (kProposeResize resizes the book so joining servers can
+            // register before the world flips)
+            const int cap = std::max(
+                num_servers_, pending_version_ ? pending_ns_ : 0);
+            if (meta[1] < 0 || meta[1] >= cap) {
               std::fprintf(stderr,
                            "[hetups scheduler] SERVER_ID %d out of range "
                            "[0, %d) — check DMLC_NUM_SERVER\n",
-                           meta[1], num_servers_);
+                           meta[1], cap);
               break;
             }
-            if (server_addrs_.size() <
-                static_cast<size_t>(num_servers_)) {
-              server_addrs_.resize(num_servers_);
-              last_hb_.resize(num_servers_);
+            if (server_addrs_.size() < static_cast<size_t>(cap)) {
+              server_addrs_.resize(cap);
+              last_hb_.resize(cap);
             }
             bool readd = !server_addrs_[meta[1]].empty();
             server_addrs_[meta[1]] = host + ":" + std::to_string(meta[2]);
@@ -252,6 +269,313 @@ class Scheduler {
           }
           break;
         }
+        case PsfType::kProposeResize: {
+          // phase 1 (hetu-elastic): record the pending world and grow the
+          // registry CAPACITY so joining servers can register/restore —
+          // nothing else changes until kFinishResize.
+          // args: i32[new_nw, new_ns, removed_ranks...],
+          //       optional i64 removed_last_steps (-1 = unknown progress)
+          // (size-guarded: these PSFs are reachable from hand-packed raw
+          // sockets, and a short frame must not index past empty args)
+          if (req.args.empty() || req.args[0].size() < 8) {
+            Message rsp = error_reply(req.head.req_id,
+                                      "kProposeResize needs at least "
+                                      "[new_n_workers, new_n_servers]");
+            try {
+              send_msg(fd, rsp);
+            } catch (...) {
+              goto out;
+            }
+            break;
+          }
+          const int32_t* a = req.args[0].as_i32();
+          const size_t n = req.args[0].size() / 4;
+          std::unique_lock<std::mutex> g(mu_);
+          ensure_members_locked();
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+          rsp.head.req_id = req.head.req_id;
+          if (n < 2) {
+            rsp = error_reply(req.head.req_id, "kProposeResize needs at "
+                              "least [new_n_workers, new_n_servers]");
+          } else {
+            const int nw = a[0], ns = a[1];
+            std::vector<int32_t> removed(a + 2, a + n);
+            if (pending_version_ != 0) {
+              if (nw == pending_nw_ && ns == pending_ns_ &&
+                  removed == pending_removed_) {
+                // idempotent re-propose of the identical resize
+                int64_t v = static_cast<int64_t>(pending_version_);
+                rsp.args.push_back(Arg::i64(&v, 1));
+              } else {
+                rsp = error_reply(
+                    req.head.req_id,
+                    "a different resize (world v" +
+                    std::to_string(pending_version_) +
+                    ") is already pending — finish or abort it first");
+              }
+            } else if (ns < num_servers_) {
+              rsp = error_reply(
+                  req.head.req_id,
+                  "server scale-down is not supported (a lost server is a "
+                  "FAULT — the HA snapshot/respawn path owns it)");
+            } else if (nw < 1) {
+              rsp = error_reply(req.head.req_id,
+                                "a world needs at least one worker");
+            } else {
+              pending_version_ = world_version_ + 1;
+              pending_nw_ = nw;
+              pending_ns_ = ns;
+              pending_removed_ = std::move(removed);
+              pending_removed_steps_.assign(pending_removed_.size(), -1);
+              if (req.args.size() > 1) {
+                const int64_t* st = req.args[1].as_i64();
+                const size_t ns_ = req.args[1].n_i64();
+                for (size_t i = 0;
+                     i < ns_ && i < pending_removed_steps_.size(); ++i)
+                  pending_removed_steps_[i] = st[i];
+              }
+              drained_.clear();
+              if (server_addrs_.size() < static_cast<size_t>(ns)) {
+                server_addrs_.resize(ns);
+                last_hb_.resize(ns);
+              }
+              if (worker_incarnations_.size() < static_cast<size_t>(nw))
+                worker_incarnations_.resize(nw, 0);
+              std::fprintf(stderr,
+                           "[hetups scheduler] resize proposed: world v%llu "
+                           "-> %dw/%ds\n",
+                           (unsigned long long)pending_version_, nw, ns);
+              int64_t v = static_cast<int64_t>(pending_version_);
+              rsp.args.push_back(Arg::i64(&v, 1));
+            }
+          }
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;
+          }
+          break;
+        }
+        case PsfType::kResizeState: {
+          std::unique_lock<std::mutex> g(mu_);
+          ensure_members_locked();
+          const auto survivors = survivors_locked();
+          int64_t vals[10] = {
+              static_cast<int64_t>(world_version_),
+              static_cast<int64_t>(pending_version_),
+              num_workers_,
+              num_servers_,
+              pending_nw_,
+              pending_ns_,
+              static_cast<int64_t>(drained_survivors_locked(survivors)),
+              pending_version_ ? static_cast<int64_t>(survivors.size()) : 0,
+              new_servers_ready_locked() ? 1 : 0,
+              static_cast<int64_t>(members_.size())};
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+          rsp.head.req_id = req.head.req_id;
+          rsp.args.push_back(Arg::i64(vals, 10));
+          rsp.args.push_back(Arg::i32(members_.data(), members_.size()));
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;
+          }
+          break;
+        }
+        case PsfType::kCommitResize: {
+          // the drain barrier: a surviving worker reports its current step
+          // and PARKS here until the coordinator finishes (or aborts) the
+          // pending resize. With no resize pending it returns the current
+          // world immediately (covers retried commits after a finish).
+          if (req.args.empty() || req.args[0].size() < 8) {
+            Message rsp = error_reply(req.head.req_id,
+                                      "kCommitResize needs [role, rank]");
+            try {
+              send_msg(fd, rsp);
+            } catch (...) {
+              goto out;
+            }
+            break;
+          }
+          const int32_t* who = req.args[0].as_i32();
+          const int32_t rank = who[1];
+          const int64_t step =
+              (req.args.size() > 1 && req.args[1].n_i64() >= 1)
+                  ? req.args[1].as_i64()[0]
+                  : 0;
+          std::unique_lock<std::mutex> g(mu_);
+          ensure_members_locked();
+          if (pending_version_ != 0) {
+            drained_[rank] = step;
+            const uint64_t my_gen = resize_gen_;
+            resize_cv_.wait(g, [this, my_gen] {
+              return resize_gen_ > my_gen;
+            });
+          }
+          Message rsp = world_reply_locked(req.head.req_id, rank);
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;
+          }
+          break;
+        }
+        case PsfType::kFinishResize: {
+          // phase 2: flip the world atomically (or abort — the safety
+          // valve after a failed migration / drain timeout: the pending
+          // proposal clears and every parked worker is released under the
+          // OLD world, state untouched).
+          const bool abort =
+              !req.args.empty() && req.args[0].size() >= 4 &&
+              req.args[0].as_i32()[0] != 0;
+          std::unique_lock<std::mutex> g(mu_);
+          ensure_members_locked();
+          Message rsp;
+          if (pending_version_ == 0) {
+            rsp = error_reply(req.head.req_id, "no resize is pending");
+          } else if (abort) {
+            std::fprintf(stderr,
+                         "[hetups scheduler] resize v%llu ABORTED; world "
+                         "v%llu continues\n",
+                         (unsigned long long)pending_version_,
+                         (unsigned long long)world_version_);
+            pending_version_ = 0;
+            pending_removed_.clear();
+            pending_removed_steps_.clear();
+            drained_.clear();
+            ++resize_gen_;
+            resize_cv_.notify_all();
+            rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+            rsp.head.req_id = req.head.req_id;
+            int64_t v = static_cast<int64_t>(world_version_);
+            rsp.args.push_back(Arg::i64(&v, 1));
+          } else {
+            const auto survivors = survivors_locked();
+            const size_t sdrained = drained_survivors_locked(survivors);
+            if (sdrained < survivors.size()) {
+              rsp = error_reply(
+                  req.head.req_id,
+                  "drain barrier incomplete (" +
+                  std::to_string(sdrained) + "/" +
+                  std::to_string(survivors.size()) + " survivors parked)");
+            } else if (!new_servers_ready_locked()) {
+              rsp = error_reply(req.head.req_id,
+                                "joining server(s) not yet registered");
+            } else {
+              // close the open era with per-member end steps: survivors
+              // reported theirs at drain; removed ranks ride the
+              // proposal's progress records (-1 = unknown -> max survivor
+              // step, which may LOSE the dead rank's in-era tail but
+              // never double-applies it)
+              int64_t max_step = 0;
+              for (auto& kv : drained_) max_step = std::max(max_step,
+                                                            kv.second);
+              if (!world_log_.empty()) {
+                for (auto& m : world_log_.back().members) {
+                  auto it = drained_.find(m.rank);
+                  if (it != drained_.end()) {
+                    m.end_step = it->second;
+                    continue;
+                  }
+                  // a rank that never drained (removed, or vanished):
+                  // with a progress record its exact tail redistributes;
+                  // WITHOUT one the only end step that can never
+                  // double-apply is "assume it consumed its whole chunk"
+                  // (-2 sentinel; era_partitions treats the chunk as
+                  // fully consumed) — its unconsumed tail is LOST, which
+                  // is the documented at-most-once fallback. Guessing the
+                  // max survivor step would replay batches a fast dead
+                  // rank already pushed.
+                  m.end_step = -2;
+                  for (size_t i = 0; i < pending_removed_.size(); ++i)
+                    if (pending_removed_[i] == m.rank &&
+                        pending_removed_steps_[i] >= 0)
+                      m.end_step = pending_removed_steps_[i];
+                }
+              }
+              members_ = survivors;
+              // joiners take the lowest free ranks (dedup-safe: the
+              // per-rank incarnation epoch covers rank reuse)
+              while (static_cast<int>(members_.size()) < pending_nw_) {
+                int32_t cand = 0;
+                while (std::find(members_.begin(), members_.end(), cand) !=
+                       members_.end())
+                  ++cand;
+                members_.push_back(cand);
+              }
+              std::sort(members_.begin(), members_.end());
+              if (static_cast<int>(members_.size()) > pending_nw_)
+                members_.resize(pending_nw_);  // unnamed shrink: drop
+                                               // the highest ranks
+              num_workers_ = pending_nw_;
+              num_servers_ = pending_ns_;
+              world_version_ = pending_version_;
+              Era e{world_version_, num_workers_, num_servers_, {}};
+              for (int32_t r : members_) {
+                auto it = drained_.find(r);
+                e.members.push_back(
+                    {r, it != drained_.end() ? it->second : max_step, -1});
+              }
+              world_log_.push_back(std::move(e));
+              pending_version_ = 0;
+              pending_removed_.clear();
+              pending_removed_steps_.clear();
+              drained_.clear();
+              ++resize_gen_;
+              resize_cv_.notify_all();
+              std::fprintf(stderr,
+                           "[hetups scheduler] world v%llu committed: "
+                           "%dw/%ds\n",
+                           (unsigned long long)world_version_, num_workers_,
+                           num_servers_);
+              rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+              rsp.head.req_id = req.head.req_id;
+              int64_t v = static_cast<int64_t>(world_version_);
+              rsp.args.push_back(Arg::i64(&v, 1));
+            }
+          }
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;
+          }
+          break;
+        }
+        case PsfType::kResizeLog: {
+          // flat i64 rows: per era {version, nw, ns, n_members,
+          // (rank, start_step, end_step) * n_members}
+          std::unique_lock<std::mutex> g(mu_);
+          ensure_members_locked();
+          std::vector<int64_t> flat;
+          for (const auto& e : world_log_) {
+            flat.push_back(static_cast<int64_t>(e.version));
+            flat.push_back(e.nw);
+            flat.push_back(e.ns);
+            flat.push_back(static_cast<int64_t>(e.members.size()));
+            for (const auto& m : e.members) {
+              flat.push_back(m.rank);
+              flat.push_back(m.start_step);
+              flat.push_back(m.end_step);
+            }
+          }
+          Message rsp;
+          rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+          rsp.head.req_id = req.head.req_id;
+          rsp.args.push_back(Arg::i64(flat.data(), flat.size()));
+          g.unlock();
+          try {
+            send_msg(fd, rsp);
+          } catch (...) {
+            goto out;
+          }
+          break;
+        }
         case PsfType::kShutdown: {
           // optional args: i32[role, id] — who is checking out (lets the
           // bounded wait() name the ranks that never did)
@@ -297,6 +621,105 @@ class Scheduler {
   int hb_timeout_ms_ = env_int_or("DMLC_PS_HEARTBEAT_TIMEOUT_MS", 10000);
   int servers_seen_ = 0, workers_seen_ = 0;
   std::vector<uint32_t> worker_incarnations_;  // per-rank kRegister count
+
+  // -- hetu-elastic membership registry (guarded by mu_) ------------------
+  // The world log: one era per committed membership, with PER-MEMBER
+  // start/end steps — survivors drain at different local steps, and the
+  // per-member bounds are what keep the exactly-once dataloader
+  // accounting honest (hetu_tpu/elastic.py era_partitions).
+  struct EraMember {
+    int32_t rank;
+    int64_t start_step;
+    int64_t end_step;  // -1 while the era is open
+  };
+  struct Era {
+    uint64_t version;
+    int32_t nw, ns;
+    std::vector<EraMember> members;
+  };
+  uint64_t world_version_ = 1;
+  std::vector<int32_t> members_;  // current worker ranks (sorted)
+  std::vector<Era> world_log_;
+  uint64_t pending_version_ = 0;  // 0 = no resize pending
+  int pending_nw_ = 0, pending_ns_ = 0;
+  std::vector<int32_t> pending_removed_;
+  std::vector<int64_t> pending_removed_steps_;  // -1 = unknown progress
+  std::map<int32_t, int64_t> drained_;  // rank -> step at drain commit
+  uint64_t resize_gen_ = 0;             // bumps at finish/abort
+  std::condition_variable resize_cv_;   // parks kCommitResize callers
+
+  // members_/world_log_ materialize lazily — the launch world is fixed by
+  // config, so this is valid whether it runs before or after assembly
+  void ensure_members_locked() {
+    if (members_.empty() && num_workers_ > 0)
+      for (int i = 0; i < num_workers_; ++i) members_.push_back(i);
+    if (world_log_.empty() && !members_.empty()) {
+      Era e{1, num_workers_, num_servers_, {}};
+      for (int32_t r : members_) e.members.push_back({r, 0, -1});
+      world_log_.push_back(std::move(e));
+    }
+  }
+
+  std::vector<int32_t> survivors_locked() {
+    ensure_members_locked();
+    std::vector<int32_t> out;
+    for (int32_t r : members_)
+      if (std::find(pending_removed_.begin(), pending_removed_.end(), r) ==
+          pending_removed_.end())
+        out.push_back(r);
+    return out;
+  }
+
+  // drained SURVIVORS only: a removed-but-alive rank that parks must not
+  // satisfy the barrier while a true survivor still has traffic in flight
+  size_t drained_survivors_locked(const std::vector<int32_t>& survivors) {
+    size_t n = 0;
+    for (int32_t r : survivors)
+      if (drained_.count(r)) ++n;
+    return n;
+  }
+
+  bool new_servers_ready_locked() const {
+    if (pending_version_ == 0) return true;
+    for (int i = num_servers_;
+         i < pending_ns_ && i < static_cast<int>(server_addrs_.size()); ++i)
+      if (server_addrs_[i].empty()) return false;
+    return pending_ns_ <= static_cast<int>(server_addrs_.size());
+  }
+
+  // shared reply body for kCommitResize (and its no-pending fast path):
+  // the released worker learns the now-current world in one message
+  Message world_reply_locked(uint64_t req_id, int32_t rank) {
+    Message rsp;
+    rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+    rsp.head.req_id = req_id;
+    int64_t dp_rank = -1, start_step = 0;
+    if (!world_log_.empty()) {
+      const Era& cur = world_log_.back();
+      for (size_t j = 0; j < cur.members.size(); ++j)
+        if (cur.members[j].rank == rank) {
+          dp_rank = static_cast<int64_t>(j);
+          start_step = cur.members[j].start_step;
+        }
+    }
+    int64_t vals[5] = {static_cast<int64_t>(world_version_), num_workers_,
+                       num_servers_, dp_rank, start_step};
+    rsp.args.push_back(Arg::i64(vals, 5));
+    rsp.args.push_back(Arg::i32(members_.data(), members_.size()));
+    std::string book;
+    for (auto& a : server_addrs_) book += a + "\n";
+    rsp.args.push_back(Arg::str(book));
+    return rsp;
+  }
+
+  static Message error_reply(uint64_t req_id, const std::string& what) {
+    Message rsp;
+    rsp.head.type = static_cast<int32_t>(PsfType::kAck);
+    rsp.head.req_id = req_id;
+    rsp.head.flags = -1;
+    rsp.args.push_back(Arg::str(what));
+    return rsp;
+  }
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
   int shutdowns_ = 0;

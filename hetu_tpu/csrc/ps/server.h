@@ -161,6 +161,37 @@ class PsServer {
     while (recv_msg(fd, &req)) {
       if (static_cast<PsfType>(req.head.type) == PsfType::kShutdown) break;
       req_count_.fetch_add(1, std::memory_order_relaxed);
+      // hetu-elastic stale-epoch rejection: once armed (kSetWorldVersion),
+      // a request stamped with a DIFFERENT non-zero world version comes
+      // from a worker that missed a resize commit — its view of the key
+      // ranges is stale, so applying it would scatter updates across the
+      // old partition. Rejected the same way resend-dedup rejects
+      // duplicates: an error response, counters and params untouched.
+      // world_ver == 0 is unversioned legacy traffic, always accepted.
+      {
+        const uint64_t wv = world_version_.load(std::memory_order_relaxed);
+        const uint64_t rv = static_cast<uint64_t>(
+            static_cast<uint32_t>(req.head.world_ver));
+        if (wv != 0 && rv != 0 && rv != wv &&
+            static_cast<PsfType>(req.head.type) !=
+                PsfType::kSetWorldVersion) {
+          Message rej;
+          rej.head.type = static_cast<int32_t>(PsfType::kAck);
+          rej.head.tensor_id = req.head.tensor_id;
+          rej.head.req_id = req.head.req_id;
+          rej.head.flags = -1;
+          rej.args.push_back(Arg::str(
+              "stale world epoch " + std::to_string(rv) +
+              " (server at world v" + std::to_string(wv) +
+              ") — re-sync membership before issuing traffic"));
+          try {
+            send_msg(fd, rej);
+          } catch (...) {
+            break;
+          }
+          continue;
+        }
+      }
       ClientSlot* slot =
           (req.head.client_id >= 0 && req.head.req_id > 0)
               ? client_slot(req.head.client_id)
@@ -725,6 +756,35 @@ class PsServer {
         rsp->args.push_back(Arg::f32(out.data(), out.size()));
         break;
       }
+      case PsfType::kListParams: {
+        // hetu-elastic migration inventory: flat i64 rows of
+        // {key, kind, rows|len, width, otype} per stored param — what the
+        // coordinator iterates to kParamSave/kParamLoad every key across
+        // a key-range move
+        std::vector<int64_t> flat;
+        store_.for_each([&](int32_t k, Param& p) {
+          std::shared_lock<std::shared_mutex> pg(p.mu);
+          flat.push_back(k);
+          flat.push_back(static_cast<int64_t>(p.kind));
+          flat.push_back(static_cast<int64_t>(
+              p.kind == ParamKind::kDense ? p.len : p.rows));
+          flat.push_back(static_cast<int64_t>(p.width));
+          flat.push_back(static_cast<int64_t>(p.otype));
+        });
+        rsp->args.push_back(Arg::i64(flat.data(), flat.size()));
+        break;
+      }
+      case PsfType::kSetWorldVersion: {
+        // arm/advance stale-epoch rejection (see serve_conn): the
+        // coordinator stamps every server inside the drain window, before
+        // workers resume traffic under the new membership
+        if (req.args.empty() || req.args[0].size() < 8)
+          throw std::runtime_error("kSetWorldVersion needs i64[version]");
+        world_version_.store(
+            static_cast<uint64_t>(req.args[0].as_i64()[0]),
+            std::memory_order_relaxed);
+        break;
+      }
       case PsfType::kServerStats: {
         // reply: i64[updates applied, updates covered by latest snapshot,
         // update counter restored from (-1 = fresh start), snapshot version,
@@ -1234,6 +1294,9 @@ class PsServer {
   std::atomic<int64_t> last_snapshot_steady_ms_{0};  // 0 = none yet
   long test_exit_after_updates_ = -1;              // test hook (gated)
   bool test_exit_snap_ = false;
+  // hetu-elastic membership epoch (0 = rejection unarmed); set via
+  // kSetWorldVersion, compared against MsgHeader::world_ver in serve_conn
+  std::atomic<uint64_t> world_version_{0};
   ConnThreads conn_threads_;
   std::mutex fds_mu_;
   std::vector<int> live_fds_;

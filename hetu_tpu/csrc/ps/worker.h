@@ -299,6 +299,59 @@ class PsWorker {
   void set_quant(bool on) { quant_.store(on); }
   bool quant_enabled() const { return quant_.load(); }
 
+  // -- hetu-elastic membership (docs/FAULT_TOLERANCE.md) ------------------
+  void set_world_version(uint64_t v) { world_version_.store(v); }
+  uint64_t world_version() const { return world_version_.load(); }
+
+  // Re-sync the server set with the scheduler's address book after a
+  // committed resize: joined servers get fresh bulk+fast connections and
+  // the partitioner denominator (servers_.size()) grows to match.
+  // PRECONDITION: the caller drained — no RPCs in flight on any channel
+  // (the ElasticAgent calls this between kCommitResize returning and the
+  // first post-resize push). Relocated servers reconnect lazily via the
+  // existing retry path, so only NEW entries connect here.
+  size_t refresh_servers() {
+    Conn c(connect_to(sched_host_, sched_port_, /*retries=*/50,
+                      /*wait_ms=*/100));
+    set_recv_timeout(c.fd(), recv_timeout_ms_);
+    Message q;
+    q.head.type = static_cast<int32_t>(PsfType::kQueryServers);
+    c.send(q);
+    Message rsp;
+    if (!c.recv(&rsp) || rsp.args.empty())
+      throw std::runtime_error(
+          "refresh_servers: scheduler at " + sched_host_ + ":" +
+          std::to_string(sched_port_) + " returned no address book");
+    std::vector<std::string> addrs;
+    std::istringstream ss(rsp.args[0].as_str());
+    std::string line;
+    while (std::getline(ss, line))
+      if (!line.empty()) addrs.push_back(line);
+    if (addrs.size() > kMaxServers)
+      throw std::runtime_error(
+          "refresh_servers: " + std::to_string(addrs.size()) +
+          " servers exceed the per-worker connection table (" +
+          std::to_string(kMaxServers) + ")");
+    std::lock_guard<std::mutex> g(addr_mu_);
+    if (addrs.size() < server_addrs_.size())
+      throw std::runtime_error(
+          "refresh_servers: the address book shrank (" +
+          std::to_string(addrs.size()) + " < " +
+          std::to_string(server_addrs_.size()) +
+          ") — server scale-down is not supported");
+    for (size_t i = 0; i < addrs.size(); ++i) {
+      if (i < server_addrs_.size()) {
+        server_addrs_[i] = addrs[i];  // relocations reconnect on retry
+      } else {
+        server_addrs_.push_back(addrs[i]);
+        servers_.push_back(std::make_unique<Conn>(connect_addr(addrs[i])));
+        servers_fast_.push_back(
+            std::make_unique<Conn>(connect_addr(addrs[i])));
+      }
+    }
+    return servers_.size();
+  }
+
   // test hook (capi gates it on HETU_TEST_MODE): corrupt the scale bytes of
   // the NEXT quantized value payload (optionally only for `tensor`), to
   // prove the server's length/scale validation rejects the message instead
@@ -1009,6 +1062,11 @@ class PsWorker {
     // monotonic req_ids per client, which holds per channel but not across
     // the two interleaved channels
     req.head.client_id = rank_ * 2 + ch;
+    // hetu-elastic membership stamp: an armed server rejects a mismatched
+    // non-zero epoch (a straggler that missed a resize commit); 0 (the
+    // default, non-elastic runs) is always accepted
+    req.head.world_ver = static_cast<int32_t>(
+        world_version_.load(std::memory_order_relaxed));
     std::string last_err;
     Message rsp;
     // phase 1: bounded fast retries (the pre-failover semantics)
@@ -1183,6 +1241,9 @@ class PsWorker {
   // modes so off==raw is the A/B denominator)
   std::atomic<bool> quant_{false};
   std::atomic<bool> corrupt_armed_{false};
+  // hetu-elastic: this worker's committed membership epoch (stamped onto
+  // every request header; 0 until an ElasticAgent arms it)
+  std::atomic<uint64_t> world_version_{0};
   std::atomic<int32_t> corrupt_tensor_{-1};
   std::atomic<uint64_t> val_raw_bytes_{0};
   std::atomic<uint64_t> val_wire_bytes_{0};

@@ -72,6 +72,25 @@ class Dataloader:
 
     _peeked: Optional[np.ndarray] = None
 
+    # -- elastic membership (hetu_tpu/elastic.py) --------------------------
+    def load_elastic_partition(self, indices) -> None:
+        """Re-point this loader at an explicit sample subset of
+        ``raw_data`` (the exactly-once remaining-sample partition
+        ``elastic.era_partitions`` computed at a resize commit). Cursor and
+        any peeked batch reset — the new partition starts from its first
+        batch; ``state_dict``/``load_state_dict`` keep working against the
+        new partition's shape."""
+        idx = np.asarray(indices, dtype=np.int64)
+        self._data = self.raw_data[idx]
+        self._order = np.arange(self._data.shape[0])
+        n = self._data.shape[0]
+        if self.drop_last:
+            self.batch_num = n // self.batch_size
+        else:
+            self.batch_num = int(np.ceil(n / self.batch_size))
+        self._cursor = 0
+        self._peeked = None
+
     # -- resume support (resilience layer) ---------------------------------
     def state_dict(self) -> dict:
         """Epoch position as a flat dict of numpy arrays (checkpointable by

@@ -1,0 +1,1127 @@
+"""hetu-elastic: live worker/PS membership changes without a job restart.
+
+The robustness stack through PR 4/8 survives *faults* (server SIGKILL →
+snapshot respawn, worker crash → supervised restart) but any *planned*
+membership change — a preempted host leaving, capacity arriving — still
+meant killing and relaunching the whole job. This module closes that gap
+(SURVEY.md "no elastic training"; ROADMAP item 4): a running job can lose
+or gain workers and PS servers at a step boundary with exact accounting.
+
+Three cooperating legs (docs/FAULT_TOLERANCE.md "Elastic membership"):
+
+1. **Membership epochs in the scheduler** (``csrc/ps/scheduler.h``): the
+   registry that already tracks per-rank incarnation epochs grows a
+   *world-version* counter and a two-phase resize handshake —
+   ``kProposeResize`` (phase 1: capacity grows immediately so joining
+   servers can register; nothing else changes) → surviving workers park in
+   ``kCommitResize`` at their next step boundary (the drain barrier: all
+   in-flight PS traffic completed first) → the coordinator migrates state
+   → ``kFinishResize`` (phase 2: the world atomically flips and every
+   parked worker is released with the new membership). Requests stamped
+   with an old world version are rejected at the server the same way
+   resend-dedup rejects duplicates (``MsgHeader.world_ver``; 0 =
+   unversioned legacy traffic, always accepted).
+
+2. **dp re-partition in the trainer**: at the commit boundary each
+   survivor recomputes its data-parallel position from the scheduler's
+   world log and re-partitions every ``Dataloader`` over the *remaining*
+   (unconsumed) samples — :func:`era_partitions` proves each retained
+   sample is consumed exactly once across any sequence of resizes. Device
+   state re-shards through the existing checkpoint capture/restore path
+   (``Executor.remesh``; no new serialization format).
+
+3. **Live PS key-range split/migration**: a joining server registers
+   empty; donors stream the affected rows using the v2 snapshot shard
+   format as the transfer medium (``kParamSave`` under the per-param
+   shared locks — serving never pauses during the save), this module
+   re-partitions rows/optimizer-slots/version-counters into the new
+   key-ranges (:func:`repartition_key`), and every server loads its
+   new shard. Update-counter stamps (``kServerStats`` slot 0) give exact
+   lost-update accounting across the move: a clean migration preserves
+   the sum bit-for-bit.
+
+Everything here is stdlib + numpy over raw sockets (the wire mirror the
+PSSupervisor already speaks), so the coordinator can live in the jax-free
+launcher parent (``heturun --elastic``).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+# ONE wire mirror of csrc/ps/net.h for the whole Python control plane: the
+# supervisor owns the header structs + recv loop (it predates this module
+# and stays stdlib-only); this module reuses them rather than growing a
+# second copy that could drift. MsgHeader is 32 bytes; the last i32 is the
+# hetu-elastic world-version stamp (0 = unversioned).
+from .ps.supervisor import (SchedulerUnreachable, _ARG_HDR, _MSG_HDR,
+                            _recv_exact as _recv_exact_sock)
+
+# PsfType values (net.h)
+K_QUERY_SERVERS = 6
+K_SERVER_STATS = 7
+K_PARAM_SAVE = 32
+K_PARAM_LOAD = 33
+K_PROPOSE_RESIZE = 60
+K_RESIZE_STATE = 61
+K_COMMIT_RESIZE = 62
+K_FINISH_RESIZE = 63
+K_RESIZE_LOG = 64
+K_LIST_PARAMS = 65
+K_SET_WORLD_VERSION = 66
+
+# ArgType values (net.h)
+_AT_F32, _AT_I64, _AT_F64, _AT_BYTES, _AT_I32, _AT_U64 = 0, 1, 2, 3, 4, 5
+
+
+def _arg_bytes(dtype: int, payload: bytes) -> bytes:
+    return _ARG_HDR.pack(dtype, 0, len(payload)) + payload
+
+
+def _arg_i32(vals) -> bytes:
+    return _arg_bytes(_AT_I32, np.asarray(vals, np.int32).tobytes())
+
+
+def _arg_i64(vals) -> bytes:
+    return _arg_bytes(_AT_I64, np.asarray(vals, np.int64).tobytes())
+
+
+def _arg_str(s: str) -> bytes:
+    return _arg_bytes(_AT_BYTES, s.encode())
+
+
+_recv_exact = _recv_exact_sock  # tests/tools address it under this name too
+
+
+def _rpc(host: str, port: int, msg_type: int, args: Sequence[bytes] = (),
+         timeout: Optional[float] = 5.0, who: str = "scheduler",
+         tensor_id: int = 0):
+    """One request/response round trip on a fresh connection. Returns
+    ``(head, [arg_bytes, ...])``. ``tensor_id`` rides the header for the
+    per-key param PSFs (save/load). An error response (flags == -1) raises
+    RuntimeError with the server's message; transport failures raise
+    :class:`SchedulerUnreachable` naming the address."""
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            payload = _MSG_HDR.pack(msg_type, int(tensor_id), 0, len(args),
+                                    0, -1, 0)
+            s.sendall(payload + b"".join(args))
+            head = _MSG_HDR.unpack(_recv_exact(s, _MSG_HDR.size))
+            out = []
+            for _ in range(head[3]):
+                _, _, nbytes = _ARG_HDR.unpack(_recv_exact(s, _ARG_HDR.size))
+                out.append(_recv_exact(s, int(nbytes)))
+    except (socket.timeout, OSError) as e:
+        raise SchedulerUnreachable(
+            f"{who} at {host}:{port} unreachable ({e!r})") from e
+    if head[4] == -1:  # flags == -1: application-level error response
+        raise RuntimeError(
+            f"{who} at {host}:{port}: "
+            f"{out[0].decode(errors='replace') if out else 'error'}")
+    return head, out
+
+
+def _i64s(buf: bytes) -> np.ndarray:
+    return np.frombuffer(buf, np.int64)
+
+
+def _i32s(buf: bytes) -> np.ndarray:
+    return np.frombuffer(buf, np.int32)
+
+
+def _split_addr(addr: str):
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+def sched_addr_from_env() -> tuple[str, int]:
+    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", "13200")))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler control plane (the two-phase resize handshake)
+# ---------------------------------------------------------------------------
+
+def propose_resize(host, port, new_n_workers: int, new_n_servers: int,
+                   removed: Sequence[int] = (),
+                   removed_steps: Sequence[int] = ()) -> int:
+    """Phase 1: record a pending resize with the scheduler and grow the
+    registry's capacity so joining servers can register. Idempotent for an
+    identical pending proposal; a conflicting one is an error. Returns the
+    proposed world version.
+
+    ``removed_steps[i]`` is removed rank ``removed[i]``'s last COMPLETED
+    step (from its progress file / checkpoint cursor) — what makes the
+    departed rank's unconsumed samples redistributable exactly once. -1 =
+    unknown: the scheduler records the rank as having consumed its WHOLE
+    chunk, so its unconsumed tail is LOST but nothing is ever trained
+    twice — the honest at-most-once semantics when a rank crashes
+    without a progress record."""
+    args = [_arg_i32([int(new_n_workers), int(new_n_servers),
+                      *map(int, removed)])]
+    if removed:
+        steps = list(removed_steps) + [-1] * (len(removed)
+                                              - len(removed_steps))
+        args.append(_arg_i64(steps))
+    _, out = _rpc(host, port, K_PROPOSE_RESIZE, args)
+    return int(_i64s(out[0])[0])
+
+
+def resize_state(host, port, timeout: float = 5.0) -> dict:
+    """Current world + pending-resize drain progress (coordinator's poll
+    surface and the workers' cheap per-step pending check)."""
+    _, out = _rpc(host, port, K_RESIZE_STATE, timeout=timeout)
+    v = _i64s(out[0])
+    members = _i32s(out[1]).tolist() if len(out) > 1 else []
+    return {"world_version": int(v[0]), "pending_version": int(v[1]),
+            "n_workers": int(v[2]), "n_servers": int(v[3]),
+            "pending_n_workers": int(v[4]), "pending_n_servers": int(v[5]),
+            "drain_count": int(v[6]), "drain_needed": int(v[7]),
+            "new_servers_ready": bool(v[8]), "members": members}
+
+
+def commit_resize(host, port, rank: int, step: int,
+                  timeout: Optional[float] = 120.0) -> dict:
+    """Drain barrier (BLOCKS): parks this worker with the scheduler until
+    the coordinator finishes (or aborts) the pending resize, then returns
+    the now-current world: version, counts, the member rank list, this
+    worker's dp position among them, and the era's global start step."""
+    _, out = _rpc(host, port, K_COMMIT_RESIZE,
+                  [_arg_i32([1, int(rank)]), _arg_i64([int(step)])],
+                  timeout=timeout)
+    v = _i64s(out[0])
+    return {"world_version": int(v[0]), "n_workers": int(v[1]),
+            "n_servers": int(v[2]), "dp_rank": int(v[3]),
+            "start_step": int(v[4]),
+            "members": _i32s(out[1]).tolist() if len(out) > 1 else [],
+            "book": out[2].decode() if len(out) > 2 else ""}
+
+
+def finish_resize(host, port, abort: bool = False) -> int:
+    """Phase 2: atomically flip the world (or abort the pending proposal)
+    and release every parked worker. Requires the drain barrier to be
+    complete unless aborting. Returns the now-current world version."""
+    _, out = _rpc(host, port, K_FINISH_RESIZE,
+                  [_arg_i32([1 if abort else 0])])
+    return int(_i64s(out[0])[0])
+
+
+def resize_log(host, port) -> list[dict]:
+    """The committed world history: one era per row with PER-MEMBER step
+    accounting — ``{version, n_workers, n_servers, members, start_steps,
+    end_steps}``. ``start_steps[j]`` is member ``members[j]``'s global step
+    when it entered the era (survivor: its drain-commit step; joiner: the
+    era's assigned start; era 0: 0); ``end_steps[j]`` is its step when the
+    era closed (-1 while the era is still open). Survivors may drain at
+    DIFFERENT local steps — per-member bounds are what keep the
+    exactly-once sample accounting honest (see :func:`era_partitions`).
+    Era 0 is the launch world. This is also what lets a late-joining
+    worker reconstruct exactly which samples every earlier era consumed."""
+    _, out = _rpc(host, port, K_RESIZE_LOG)
+    v = _i64s(out[0])
+    eras, i = [], 0
+    while i + 4 <= len(v):
+        ver, nw, ns, nm = (int(v[i]), int(v[i + 1]), int(v[i + 2]),
+                           int(v[i + 3]))
+        rows = v[i + 4:i + 4 + 3 * nm].reshape(nm, 3)
+        eras.append({"version": ver, "n_workers": nw, "n_servers": ns,
+                     "members": [int(r[0]) for r in rows],
+                     "start_steps": [int(r[1]) for r in rows],
+                     "end_steps": [int(r[2]) for r in rows]})
+        i += 4 + 3 * nm
+    return eras
+
+
+# ---------------------------------------------------------------------------
+# Server control plane (key-range migration + stale-epoch arming)
+# ---------------------------------------------------------------------------
+
+def server_list_params(addr: str) -> list[dict]:
+    """Param inventory of one server shard: key, kind (0 dense / 1 sparse /
+    2 cache table), rows-or-len, width, optimizer type."""
+    host, port = _split_addr(addr)
+    _, out = _rpc(host, port, K_LIST_PARAMS, who=f"ps server {addr}")
+    v = _i64s(out[0])
+    return [{"key": int(v[i]), "kind": int(v[i + 1]), "rows": int(v[i + 2]),
+             "width": int(v[i + 3]), "otype": int(v[i + 4])}
+            for i in range(0, len(v), 5)]
+
+
+def server_param_save(addr: str, key: int, directory: str) -> None:
+    """kParamSave for one key (the key rides in the header's tensor_id):
+    the server writes ``param_<key>_shard<rank>.bin`` in v2 format under
+    the param's shared lock — serving never pauses."""
+    _rpc_with_tensor(addr, K_PARAM_SAVE, key, [_arg_str(directory)])
+
+
+def server_param_load(addr: str, key: int, directory: str) -> None:
+    """kParamLoad for one key: the server rebuilds the param (data +
+    optimizer slots + row versions) from its rank's v2 shard file — the
+    param need not pre-exist, which is exactly what lets a joining server
+    come up empty and receive its key range."""
+    _rpc_with_tensor(addr, K_PARAM_LOAD, key, [_arg_str(directory)])
+
+
+def server_set_world(addr: str, version: int) -> None:
+    """Arm (or advance) a server's stale-epoch rejection: requests stamped
+    with a DIFFERENT non-zero world version are answered with an error
+    response instead of being applied — the membership analogue of
+    resend-dedup's duplicate rejection."""
+    host, port = _split_addr(addr)
+    _rpc(host, port, K_SET_WORLD_VERSION, [_arg_i64([int(version)])],
+         who=f"ps server {addr}")
+
+
+def server_stats_raw(addr: str, timeout: float = 3.0) -> list[int]:
+    """kServerStats over a raw socket (no native lib): the 10 HA/health
+    slots — [updates, snapshot_updates, restored_updates, snapshot_version,
+    n_params, requests, apply_ns, apply_count, snapshot_age_ms,
+    dedup_clients]. The jax-free twin of ``PSClient.ServerStats`` for
+    supervisor-side scale policies."""
+    host, port = _split_addr(addr)
+    _, out = _rpc(host, port, K_SERVER_STATS, timeout=timeout,
+                  who=f"ps server {addr}")
+    return [int(x) for x in _i64s(out[0])]
+
+
+def _rpc_with_tensor(addr: str, msg_type: int, tensor_id: int,
+                     args: Sequence[bytes], timeout: float = 30.0):
+    """Per-key param PSF (save/load) to one server: _rpc with the key in
+    the header's tensor_id slot."""
+    host, port = _split_addr(addr)
+    return _rpc(host, port, msg_type, args, timeout=timeout,
+                who=f"ps server {addr}", tensor_id=tensor_id)
+
+
+# ---------------------------------------------------------------------------
+# v2 shard format IO (csrc/ps/server.h save_param_file / load_param_file)
+# ---------------------------------------------------------------------------
+
+_SHARD_MAGIC_V2 = -2
+# accum/accum2 sizing per OptType (store.h alloc_slots): sgd none,
+# momentum/nesterov/adagrad one slot, adam two
+_SLOT_COUNTS = {0: 0, 1: 1, 2: 1, 3: 1, 4: 2}
+
+
+def read_v2_shard(path: str) -> dict:
+    """Parse one v2 shard file into numpy arrays. Layout: i64 meta[8] =
+    {MAGIC(-2), kind, rows|len, width, otype, step, n_lrs, n_versions},
+    f32 lrs[], f32 data[], f32 accum[], f32 accum2[], i64 versions[]."""
+    with open(path, "rb") as f:
+        meta = np.fromfile(f, np.int64, 8)
+        if meta.size != 8 or meta[0] != _SHARD_MAGIC_V2:
+            raise ValueError(f"{path}: not a v2 shard file")
+        kind, n0, width, otype, step, n_lrs, n_ver = (
+            int(meta[1]), int(meta[2]), int(meta[3]), int(meta[4]),
+            int(meta[5]), int(meta[6]), int(meta[7]))
+        length = n0 if kind == 0 else n0 * width
+        lrs = np.fromfile(f, np.float32, n_lrs)
+        data = np.fromfile(f, np.float32, length)
+        nslots = _SLOT_COUNTS.get(otype, 0)
+        accum = np.fromfile(f, np.float32, length if nslots >= 1 else 0)
+        accum2 = np.fromfile(f, np.float32, length if nslots >= 2 else 0)
+        versions = np.fromfile(f, np.int64, n_ver)
+    # validate EVERY section, not just data: np.fromfile short-reads
+    # silently, and a shard truncated inside accum/accum2/versions would
+    # otherwise re-split into shards whose meta disagrees with their
+    # payload — exactly the silent corruption migration must fail loud on
+    for name, arr, want in (("data", data, length),
+                            ("lrs", lrs, n_lrs),
+                            ("accum", accum,
+                             length if nslots >= 1 else 0),
+                            ("accum2", accum2,
+                             length if nslots >= 2 else 0),
+                            ("versions", versions, n_ver)):
+        if arr.size != want:
+            raise ValueError(f"{path}: truncated shard ({name} carries "
+                             f"{arr.size}/{want} entries)")
+    return {"kind": kind, "rows": 0 if kind == 0 else n0,
+            "len": length, "width": width if kind != 0 else 1,
+            "otype": otype, "step": step, "lrs": lrs, "data": data,
+            "accum": accum, "accum2": accum2, "versions": versions}
+
+
+def write_v2_shard(path: str, d: dict) -> None:
+    """Inverse of :func:`read_v2_shard` (bit-compatible with the server's
+    load_param_file)."""
+    n0 = d["rows"] if d["kind"] != 0 else d["len"]
+    meta = np.asarray([_SHARD_MAGIC_V2, d["kind"], n0,
+                       d["width"] if d["kind"] != 0 else 1, d["otype"],
+                       d.get("step", 0), len(d["lrs"]),
+                       len(d["versions"])], np.int64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        meta.tofile(f)
+        np.asarray(d["lrs"], np.float32).tofile(f)
+        np.asarray(d["data"], np.float32).tofile(f)
+        np.asarray(d["accum"], np.float32).tofile(f)
+        np.asarray(d["accum2"], np.float32).tofile(f)
+        np.asarray(d["versions"], np.int64).tofile(f)
+    os.replace(tmp, path)
+
+
+def _range_split(total: int, n_shards: int) -> list[tuple[int, int]]:
+    """The worker partitioner's exact split (worker.h dense_range /
+    row_range): shard s covers [s*total/S, (s+1)*total/S)."""
+    return [(s * total // n_shards, (s + 1) * total // n_shards)
+            for s in range(n_shards)]
+
+
+def repartition_key(shards: list[dict], new_n: int) -> list[dict]:
+    """Re-split one param's old shard set (server order) into ``new_n``
+    shards under the same partitioner formula. Rows move WITH their
+    optimizer slots and version counters — a migrated Adam row keeps its
+    m/v state bit-for-bit, so training dynamics are unchanged by the
+    move."""
+    first = shards[0]
+    kind, width, otype = first["kind"], first["width"], first["otype"]
+    data = np.concatenate([s["data"] for s in shards])
+    accum = np.concatenate([s["accum"] for s in shards])
+    accum2 = np.concatenate([s["accum2"] for s in shards])
+    versions = np.concatenate([s["versions"] for s in shards])
+    step = max(int(s.get("step", 0)) for s in shards)
+    lrs = first["lrs"]
+    if kind == 0:
+        total = int(data.size)
+        ranges = [(lo, hi) for lo, hi in _range_split(total, new_n)]
+        unit = 1
+    else:
+        total = sum(int(s["rows"]) for s in shards)
+        ranges = _range_split(total, new_n)
+        unit = width
+    out = []
+    for lo, hi in ranges:
+        sl = slice(lo * unit, hi * unit)
+        out.append({"kind": kind, "rows": 0 if kind == 0 else hi - lo,
+                    "len": (hi - lo) * unit, "width": width,
+                    "otype": otype, "step": step, "lrs": lrs,
+                    "data": data[sl],
+                    "accum": accum[sl] if accum.size else accum,
+                    "accum2": accum2[sl] if accum2.size else accum2,
+                    "versions": versions[lo:hi] if versions.size
+                    else versions})
+    return out
+
+
+def migrate_key_ranges(server_addrs: list[str], old_n: int, new_n: int,
+                       workdir: str, log=None) -> dict:
+    """Move PS state from ``old_n`` to ``new_n`` key-range shards using the
+    v2 shard format as the transfer medium. MUST run inside the drain
+    window (workers parked in ``kCommitResize``): donors save under the
+    per-param shared locks (serving never pauses), rows+slots+versions are
+    re-split host-side, and every new-world server loads its new shard.
+
+    Returns an accounting report: per-key element counts and the summed
+    server update counters before/after (equal for a clean migration —
+    the exact "zero lost updates" proof)."""
+    log = log or (lambda m: print(f"# hetu elastic: {m}", file=sys.stderr,
+                                  flush=True))
+    stage = os.path.join(workdir, "stage")
+    commit = os.path.join(workdir, "commit")
+    os.makedirs(stage, exist_ok=True)
+    os.makedirs(commit, exist_ok=True)
+    params = server_list_params(server_addrs[0])
+    updates_before = sum(server_stats_raw(a)[0]
+                        for a in server_addrs[:old_n])
+    # donors stream their shards (tmp+rename server-side; shared locks)
+    for key in (p["key"] for p in params):
+        for s in range(old_n):
+            server_param_save(server_addrs[s], key, stage)
+    # per-donor inventories: kListParams reports each server's SHARD meta,
+    # so staged shards verify against their own donor, not donor 0
+    inventories = [
+        {q["key"]: q for q in server_list_params(server_addrs[s])}
+        for s in range(old_n)]
+    report_keys = {}
+    for p in params:
+        key = p["key"]
+        shards = [read_v2_shard(os.path.join(
+            stage, f"param_{key}_shard{s}.bin")) for s in range(old_n)]
+        # every staged shard must match its donor's live inventory: a
+        # mismatch means the stage holds something other than this world's
+        # param (torn write, stale file, racing membership change) — fail
+        # LOUD here so the coordinator aborts with state untouched,
+        # instead of loading a silently-corrupted split
+        for s, sh in enumerate(shards):
+            inv = inventories[s].get(key)
+            got = sh["rows"] if sh["kind"] != 0 else sh["len"]
+            want = None if inv is None else inv["rows"]
+            if want != got:
+                raise RuntimeError(
+                    f"migration staging mismatch for param {key} shard "
+                    f"{s}: staged file carries {got} rows/elements, the "
+                    f"donor's inventory says {want} — aborting the resize")
+        new_shards = repartition_key(shards, new_n)
+        for s, sh in enumerate(new_shards):
+            write_v2_shard(os.path.join(
+                commit, f"param_{key}_shard{s}.bin"), sh)
+        report_keys[key] = {"elements": int(sum(s["data"].size
+                                                for s in shards)),
+                            "kind": p["kind"]}
+    keys = [p["key"] for p in params]
+    # JOINING servers load first: a failure here aborts with every donor
+    # still holding its full old-world shard — the abort is truly safe
+    for key in keys:
+        for s in range(old_n, new_n):
+            server_param_load(server_addrs[s], key, commit)
+    # donors last, with rollback: once a donor holds a re-split shard the
+    # OLD world's key ranges no longer match it, so a mid-loop failure
+    # reloads every touched donor from the stage dir (which IS the exact
+    # pre-migration state) before the coordinator aborts
+    attempted = 0
+    try:
+        for key in keys:
+            attempted += 1
+            for s in range(old_n):
+                server_param_load(server_addrs[s], key, commit)
+    except Exception:
+        rollback_failed = []
+        for key in keys[:attempted]:
+            for s in range(old_n):
+                try:
+                    server_param_load(server_addrs[s], key, stage)
+                except Exception:  # noqa: BLE001
+                    rollback_failed.append((key, s))
+        if rollback_failed:
+            raise RuntimeError(
+                "migration failed AND donor rollback failed for "
+                f"{rollback_failed} — old-world PS state is inconsistent; "
+                f"restore donors manually from {stage} (v2 shard files) "
+                "before resuming") from None
+        log(f"donor load failed; rolled {attempted} key(s) back from "
+            f"{stage} — old world intact")
+        raise
+    updates_after = sum(server_stats_raw(a)[0]
+                       for a in server_addrs[:old_n])
+    log(f"migrated {len(report_keys)} param(s) {old_n} -> {new_n} shards; "
+        f"update counters {updates_before} -> {updates_after}")
+    return {"keys": report_keys, "n_keys": len(report_keys),
+            "updates_before": int(updates_before),
+            "updates_after": int(updates_after)}
+
+
+# ---------------------------------------------------------------------------
+# Exact-once dataloader accounting across resizes
+# ---------------------------------------------------------------------------
+
+def _chunk_bounds(n: int, m: int, batch_size: int) -> list[tuple[int, int]]:
+    """Contiguous per-member chunk bounds over ``n`` remaining samples:
+    whole batches distributed as evenly as possible (first ``nb % m``
+    members get one extra batch). Splitting on raw ``n // m`` instead
+    would strand up to ``m * batch_size`` samples per resize behind
+    drop_last — found live by the demo's exact-accounting check."""
+    nb = n // batch_size
+    base, extra = divmod(nb, m)
+    bounds, lo = [], 0
+    for j in range(m):
+        hi = lo + (base + (1 if j < extra else 0)) * batch_size
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def _era_bounds(n: int, m: int, batch_size: int,
+                first_era: bool) -> list[tuple[int, int]]:
+    """Per-member chunk bounds for one era. The LAUNCH era must model what
+    ``Dataloader.init_states`` actually did — a plain ``n // nrank`` split
+    (not batch-aligned) — while post-resize eras use the batch-aligned
+    bounds ``load_elastic_partition`` was handed. Mixing the formulas on a
+    non-divisible dataset would double-consume the straddle samples."""
+    if first_era:
+        per = n // m
+        return [(j * per, (j + 1) * per) for j in range(m)]
+    return _chunk_bounds(n, m, batch_size)
+
+
+def era_partitions(n_samples: int, batch_size: int, eras: list[dict]):
+    """Partition the samples a sequence of worlds has NOT yet consumed.
+
+    ``eras`` is the scheduler's resize log: within a closed era, member j
+    consumed the first ``(end_steps[j] - start_steps[j]) * batch_size``
+    entries of its contiguous chunk — exactly what a sequential
+    (no-shuffle, drop_last) Dataloader does, with PER-MEMBER bounds
+    because survivors drain at different local steps. Returns
+    ``(per_member_chunks, unassigned_tail)`` for the LAST (open) era, or
+    ``None`` when any era wrapped its epoch (consumption is no longer a
+    prefix and exact-once no longer holds; callers fall back to plain
+    rank re-sharding).
+
+    The union of what every closed era consumed, the returned chunks, and
+    the tail is exactly ``arange(n_samples)`` with no overlaps — the
+    exactly-once invariant ``tests/test_elastic.py`` pins.
+    """
+    remaining = np.arange(n_samples, dtype=np.int64)
+    for i, era in enumerate(eras[:-1]):
+        m = len(era["members"])
+        if m <= 0:
+            return None
+        bounds = _era_bounds(remaining.size, m, batch_size, i == 0)
+        keep = []
+        for j, (lo, hi) in enumerate(bounds):
+            end = int(era["end_steps"][j])
+            if end == -2:
+                # unknown progress (scheduler sentinel): assume the whole
+                # chunk was consumed — its tail is LOST, never re-applied
+                k = hi - lo
+            else:
+                k = max(0, end - int(era["start_steps"][j])) \
+                    * int(batch_size)
+            if k > hi - lo:
+                return None  # epoch wrapped inside this era
+            keep.append(remaining[lo + k:hi])
+        keep.append(remaining[bounds[-1][1]:])  # sub-batch tail rides along
+        remaining = np.concatenate(keep)
+    m = len(eras[-1]["members"])
+    if m <= 0:
+        return None
+    bounds = _era_bounds(remaining.size, m, batch_size, len(eras) == 1)
+    return ([remaining[lo:hi] for lo, hi in bounds],
+            remaining[bounds[-1][1]:])
+
+
+def consumed_samples(n_samples: int, batch_size: int, eras: list[dict],
+                     final_steps: dict):
+    """The set of sample indices consumed by ALL members across every era
+    (the closed-form companion of :func:`era_partitions`; tests state the
+    exactly-once oracle with it). ``final_steps`` maps each LAST-era
+    member rank to its final global step."""
+    closed = [dict(e) for e in eras]
+    closed[-1] = dict(closed[-1], end_steps=[
+        int(final_steps[r]) for r in closed[-1]["members"]])
+    out = []
+    remaining = np.arange(n_samples, dtype=np.int64)
+    for i, era in enumerate(closed):
+        m = len(era["members"])
+        bounds = _era_bounds(remaining.size, m, batch_size, i == 0)
+        keep = []
+        for j, (lo, hi) in enumerate(bounds):
+            end = int(era["end_steps"][j])
+            if end == -2:
+                k = hi - lo
+            else:
+                k = max(0, end - int(era["start_steps"][j])) \
+                    * int(batch_size)
+            if k > hi - lo:
+                return None
+            out.append(remaining[lo:lo + k])
+            keep.append(remaining[lo + k:hi])
+        keep.append(remaining[bounds[-1][1]:])
+        remaining = np.concatenate(keep)
+    return np.concatenate(out) if out else np.empty(0, np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator (launcher parent / test harness side)
+# ---------------------------------------------------------------------------
+
+class ElasticCoordinator:
+    """Drives one membership change end to end against the scheduler:
+    propose → (spawn joining servers so they can register) → wait for the
+    drain barrier + server registration → migrate PS key-ranges if the
+    server count changed → finish → (spawn joining workers). The caller
+    owns process management via the ``spawn_*`` callbacks — the same class
+    serves ``heturun --elastic``, the ``ps_join`` fault kind, and tests."""
+
+    def __init__(self, sched_host: str, sched_port: int,
+                 workdir: Optional[str] = None, log=None,
+                 drain_timeout_s: float = 120.0):
+        self.host, self.port = sched_host, int(sched_port)
+        self.workdir = workdir
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.log = log or (lambda m: print(f"# hetu elastic: {m}",
+                                           file=sys.stderr, flush=True))
+        self.last_report: Optional[dict] = None
+
+    def resize(self, new_n_workers: int, new_n_servers: int,
+               removed: Sequence[int] = (), removed_steps: Sequence[int] = (),
+               spawn_server=None, spawn_worker=None) -> dict:
+        t0 = time.perf_counter()
+        st0 = resize_state(self.host, self.port)
+        old_ns = st0["n_servers"]
+        version = propose_resize(self.host, self.port, new_n_workers,
+                                 new_n_servers, removed, removed_steps)
+        self.log(f"resize proposed: world v{version} "
+                 f"({st0['n_workers']}w/{old_ns}s -> "
+                 f"{new_n_workers}w/{new_n_servers}s, removed "
+                 f"{list(removed)})")
+        new_server_ids = list(range(old_ns, new_n_servers))
+        if spawn_server is not None:
+            for sid in new_server_ids:
+                spawn_server(sid)
+        deadline = time.monotonic() + self.drain_timeout_s
+        while True:
+            st = resize_state(self.host, self.port)
+            if st["drain_count"] >= st["drain_needed"] \
+                    and st["new_servers_ready"]:
+                break
+            if time.monotonic() > deadline:
+                finish_resize(self.host, self.port, abort=True)
+                raise TimeoutError(
+                    f"resize v{version} drain timed out "
+                    f"({st['drain_count']}/{st['drain_needed']} workers "
+                    f"drained, servers_ready={st['new_servers_ready']}) — "
+                    "aborted; the old world continues")
+            time.sleep(0.05)
+        migration = None
+        try:
+            if new_n_servers != old_ns:
+                import tempfile
+                workdir = self.workdir or tempfile.mkdtemp(
+                    prefix="hetu_elastic_migr_")
+                addrs, _ = _query_book(self.host, self.port)
+                migration = migrate_key_ranges(addrs, old_ns, new_n_servers,
+                                               workdir, log=self.log)
+            # arm stale-epoch rejection under the NEW version everywhere
+            addrs, _ = _query_book(self.host, self.port)
+            for a in addrs[:new_n_servers]:
+                if a:
+                    server_set_world(a, version)
+            finish_resize(self.host, self.port)
+        except Exception:
+            # Abort: release the parked workers under the OLD world rather
+            # than leaving them waiting forever and the proposal wedged.
+            # ORDER MATTERS: servers already armed with the NEW version
+            # must be re-armed to the old epoch BEFORE the workers are
+            # released — a released worker's first push to a new-armed
+            # server is a no-retry stale-epoch error, crashing the
+            # survivor the abort exists to protect. Old-world PS state is
+            # intact: migrate_key_ranges loads joining servers first and
+            # rolls donors back from the stage dir on a donor-load
+            # failure. Best-effort throughout; if finish itself
+            # half-landed, the abort answers "no resize is pending" —
+            # fine, the workers are already released.
+            try:
+                addrs, _ = _query_book(self.host, self.port)
+                for a in addrs:
+                    if a:
+                        server_set_world(a, st0["world_version"])
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                finish_resize(self.host, self.port, abort=True)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        new_worker_ranks = []
+        st = resize_state(self.host, self.port)
+        if spawn_worker is not None:
+            prev = set(st0["members"]) - set(removed)
+            new_worker_ranks = [r for r in st["members"] if r not in prev]
+            for r in new_worker_ranks:
+                spawn_worker(r)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        self.last_report = {
+            "world_version": version, "duration_ms": round(dur_ms, 1),
+            "n_workers": new_n_workers, "n_servers": new_n_servers,
+            "members": st["members"], "removed": list(removed),
+            "joined_workers": new_worker_ranks, "migration": migration}
+        self.log(f"resize v{version} committed in {dur_ms:.0f} ms; "
+                 f"members {st['members']}")
+        return self.last_report
+
+
+def _query_book(host, port):
+    """kQueryServers: (addrs, alive) — the supervisor's implementation,
+    re-exported under the name the coordinator/tests use."""
+    from .ps.supervisor import query_servers
+    return query_servers(host, port, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Worker-side agent (step-boundary hook)
+# ---------------------------------------------------------------------------
+
+class ElasticAgent:
+    """Per-worker elastic membership agent, armed by ``HETU_ELASTIC``.
+
+    Checked at every training-step boundary (``SubExecutor.run`` calls
+    :meth:`step_boundary`): when the scheduler has a pending resize the
+    agent drains this worker's PS traffic, parks in the drain barrier
+    (``kCommitResize``), and on release applies the new world — native
+    world-version stamp, server-connection refresh (the partitioner
+    denominator), exact-once dataloader re-partition, telemetry gauges and
+    a flight-recorder event. Costs one small scheduler round trip every
+    ``poll_steps`` steps when idle."""
+
+    def __init__(self, executor, sched_host: str, sched_port: int,
+                 rank: int, poll_steps: Optional[int] = None):
+        self.ex = executor
+        self.host, self.port = sched_host, int(sched_port)
+        self.rank = int(rank)
+        self.poll_steps = max(1, int(
+            poll_steps if poll_steps is not None
+            else os.environ.get("HETU_ELASTIC_POLL_STEPS", "1")))
+        self.world_version = 1
+        self.dp_rank = self.rank
+        self.n_members = 1
+        self.eras: list[dict] = []
+        self.last_resize_ms: Optional[float] = None
+        self.resizes = 0
+        # progress file: the launcher reads a dead rank's last completed
+        # step from here (propose_resize removed_steps) so its unconsumed
+        # samples can be redistributed exactly once
+        d = os.environ.get("HETU_ELASTIC_DIR")
+        self._progress_path = (os.path.join(d, f"progress_r{self.rank}")
+                               if d else None)
+
+    @classmethod
+    def from_env(cls, executor) -> "ElasticAgent":
+        host, port = sched_addr_from_env()
+        return cls(executor, host, port,
+                   int(os.environ.get("WORKER_ID", "0")))
+
+    # -- lifecycle ---------------------------------------------------------
+    def bootstrap(self) -> None:
+        """Sync with the scheduler's current world at executor build time.
+        A late-joining worker (``HETU_ELASTIC_JOIN``) additionally aligns
+        its step counter with the era's global start step and loads its
+        exact-once dataloader partition from the world log."""
+        try:
+            eras = resize_log(self.host, self.port)
+        except SchedulerUnreachable as e:
+            print(f"# hetu elastic: bootstrap skipped ({e})",
+                  file=sys.stderr)
+            return
+        if not eras:
+            return
+        self.eras = eras
+        cur = eras[-1]
+        self.world_version = cur["version"]
+        self.n_members = len(cur["members"])
+        self.dp_rank = (cur["members"].index(self.rank)
+                        if self.rank in cur["members"] else self.rank)
+        comm = getattr(self.ex.ps_runtime, "comm", None) \
+            if self.ex is not None else None
+        if comm is not None and hasattr(comm, "SetWorldVersion"):
+            comm.SetWorldVersion(self.world_version)
+        if self.ex is not None and os.environ.get("HETU_ELASTIC_JOIN") \
+                and self.rank in cur["members"]:
+            # joiner: my batches count from my assigned era start step
+            self.ex.state["step"] = int(
+                cur["start_steps"][cur["members"].index(self.rank)])
+            self._repartition_dataloaders(cur)
+        self._export(None)
+
+    # -- the per-step hook --------------------------------------------------
+    def write_progress(self, completed_steps: int) -> None:
+        """Record this rank's completed-step count (= batches consumed)
+        for the launcher's departure accounting. Called at every step
+        boundary AND by the worker_lost fault right before the SIGKILL, so
+        a planned departure's tail is redistributed exactly."""
+        if not self._progress_path:
+            return
+        try:
+            tmp = self._progress_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(int(completed_steps)))
+            os.replace(tmp, self._progress_path)
+        except OSError:
+            pass  # progress is advisory; never take training down
+
+    def step_boundary(self, sub, step: int) -> None:
+        # progress EVERY boundary (a couple of µs): the poll cadence only
+        # throttles the scheduler round trip — a stale progress file would
+        # make a real preemption double-consume the dead rank's tail
+        self.write_progress(step)
+        if step % self.poll_steps:
+            return
+        try:
+            st = resize_state(self.host, self.port, timeout=3.0)
+        except SchedulerUnreachable as e:
+            print(f"# hetu elastic: membership poll failed ({e}); "
+                  "continuing under the current world", file=sys.stderr)
+            return
+        if st["pending_version"] == 0 \
+                or st["pending_version"] <= self.world_version:
+            return
+        self._do_resize(sub, step, st)
+
+    def _do_resize(self, sub, step: int, st: dict) -> None:
+        from .resilience import _tel_event
+        t0 = time.perf_counter()
+        ps = self.ex.ps_runtime if self.ex is not None else None
+        if ps is not None:
+            ps.drain()                 # every in-flight push/pull lands
+            ps._prefetched.clear()     # row locations may move
+        _tel_event("resize_drain", step=step,
+                   pending_version=st["pending_version"])
+        # the park can legitimately outlast a socket timeout (a large
+        # key-range migration runs while we wait), so the deadline is
+        # generous and a timeout RETRIES the commit: re-parking just
+        # overwrites our drain record, and if the resize finished while we
+        # were disconnected the retry returns the new world immediately
+        commit_timeout = float(os.environ.get(
+            "HETU_ELASTIC_COMMIT_TIMEOUT_S", "600"))
+        world = None
+        for attempt in range(3):
+            try:
+                world = commit_resize(self.host, self.port, self.rank,
+                                      step, timeout=commit_timeout)
+                break
+            except SchedulerUnreachable as e:
+                print(f"# hetu elastic: drain commit attempt "
+                      f"{attempt + 1}/3 failed ({e}); retrying",
+                      file=sys.stderr)
+        if world is None:
+            # scheduler gone: keep training under the current world — the
+            # next boundary re-polls, and a later-committed resize is
+            # caught by the servers' stale-epoch rejection
+            print(f"# hetu elastic: worker {self.rank} could not commit "
+                  "the resize; continuing under the current world",
+                  file=sys.stderr)
+            return
+        if world["world_version"] <= self.world_version:
+            # the coordinator ABORTED (drain timeout, failed migration):
+            # the old world continues — applying anything here would reset
+            # cursors and re-consume already-trained samples
+            print(f"# hetu elastic: worker {self.rank} released from an "
+                  f"aborted resize; world v{self.world_version} continues",
+                  file=sys.stderr)
+            _tel_event("resize_abort", step=step,
+                       world_version=self.world_version)
+            return
+        if world["members"] and self.rank not in world["members"]:
+            # this rank was DECOMMISSIONED by the resize (an unnamed
+            # shrink dropped it): its samples were redistributed to the
+            # survivors, so continuing to train would double-consume them
+            # under a perfectly valid epoch stamp. Leave like a preempted
+            # host — supervise() turns this into a clean exit-75, a bare
+            # loop exits nonzero, and either way the launcher records a
+            # departure that is already accounted for.
+            from .resilience import Preempted
+            print(f"# hetu elastic: worker {self.rank} is not a member of "
+                  f"world v{world['world_version']}; decommissioned — "
+                  "stopping", file=sys.stderr)
+            _tel_event("resize_decommissioned", flush=True, step=step,
+                       world_version=world["world_version"])
+            raise Preempted(step)
+        comm = getattr(ps, "comm", None)
+        if comm is not None and hasattr(comm, "SetWorldVersion"):
+            comm.SetWorldVersion(world["world_version"])
+        if comm is not None and hasattr(comm, "RefreshServers") \
+                and world["n_servers"] != comm.num_servers:
+            n = comm.RefreshServers()
+            print(f"# hetu elastic: worker {self.rank} now sees {n} "
+                  "server shard(s)", file=sys.stderr)
+        # the scheduler's log is the one authoritative era history (it
+        # merged every survivor's drain step and the removed ranks'
+        # progress) — re-fetch it rather than reconstructing locally
+        eras = None
+        for _ in range(3):  # it answered the commit moments ago; retry
+            try:
+                eras = resize_log(self.host, self.port)
+                break
+            except SchedulerUnreachable:
+                time.sleep(0.2)
+        if eras:
+            self.eras = eras
+            self._repartition_dataloaders(self.eras[-1])
+        else:
+            # WITHOUT the log there is no exact remaining-sample set, and
+            # resetting loaders (init_states) would replay consumed
+            # batches — keep the current partitions and say so loudly;
+            # the sample accounting degrades to at-most-once for the
+            # redistributed tails until the next successful resize
+            print(f"# hetu elastic: worker {self.rank} could not fetch "
+                  "the world log after the commit; dataloader partitions "
+                  "left unchanged (exact-once redistribution skipped)",
+                  file=sys.stderr)
+        self.world_version = world["world_version"]
+        self.n_members = len(world["members"])
+        self.dp_rank = world["dp_rank"] if world["dp_rank"] >= 0 \
+            else self.rank
+        self.resizes += 1
+        self.last_resize_ms = (time.perf_counter() - t0) * 1e3
+        self._export(sub)
+        _tel_event("resize_commit", step=step,
+                   world_version=self.world_version,
+                   n_workers=world["n_workers"],
+                   n_servers=world["n_servers"],
+                   dp_rank=self.dp_rank,
+                   duration_ms=round(self.last_resize_ms, 1))
+        intro = getattr(self.ex, "introspector", None) \
+            if self.ex is not None else None
+        if intro is not None:
+            # the resize shows up in the flight ring so hetuscope
+            # post-mortems carry the membership timeline — and the ring is
+            # flushed NOW: a membership change is exactly the kind of
+            # boundary a later post-mortem wants on disk, and the next
+            # abort-path flush would overwrite context otherwise
+            intro.record_step({"sub": getattr(sub, "name", "elastic"),
+                               "step": int(step), "event": "resize",
+                               "world_version": self.world_version,
+                               "members": world["members"],
+                               "n_servers": world["n_servers"],
+                               "duration_ms": round(self.last_resize_ms,
+                                                    1)})
+            try:
+                from .telemetry.scope import flush_flight
+                flush_flight("resize")
+            except Exception:  # noqa: BLE001 — observability only
+                pass
+        print(f"# hetu elastic: worker {self.rank} joined world "
+              f"v{self.world_version} as dp rank {self.dp_rank}/"
+              f"{self.n_members} in {self.last_resize_ms:.0f} ms",
+              file=sys.stderr)
+
+    # -- dataloader re-partition -------------------------------------------
+    def _repartition_dataloaders(self, era: dict) -> None:
+        if self.ex is None:
+            return
+        pos = (era["members"].index(self.rank)
+               if self.rank in era["members"] else None)
+        if pos is None:
+            return
+        m = len(era["members"])
+        for sub in self.ex.subexecutors.values():
+            for node in getattr(sub, "dataloader_nodes", []):
+                for dl in getattr(node, "dataloaders", {}).values():
+                    self._repartition_one(dl, pos, m)
+                # device-RESIDENT datasets slice on device from an uploaded
+                # copy — re-upload the new partition and reset the traced
+                # cursor, or the step would keep slicing the pre-resize
+                # data (jit retraces on the new data shape by itself)
+                nid = id(node)
+                if nid in getattr(sub, "resident_dl", {}):
+                    dl = node.dataloaders.get(sub.name)
+                    if dl is not None:
+                        sub.resident_dl[nid] = (
+                            self.ex._prepare_input(dl._data, batch=False),
+                            dl.batch_size, dl.batch_num)
+                        sub._dl_cursor[nid] = 0
+
+    def _repartition_one(self, dl, pos: int, m: int) -> None:
+        if not hasattr(dl, "load_elastic_partition"):
+            return
+        plan = None
+        if not dl.shuffle and dl.func is None and dl.drop_last \
+                and len(self.eras) > 0:
+            plan = era_partitions(int(dl.raw_data.shape[0]),
+                                  int(dl.batch_size), self.eras)
+        if plan is not None:
+            chunks, _tail = plan
+            dl.load_elastic_partition(chunks[pos])
+        else:
+            # shuffled/transformed loaders (or a wrapped epoch): exact-once
+            # prefix accounting does not apply — fall back to plain rank
+            # re-sharding, same semantics as a restart at this boundary
+            dl.init_states(pos, m)
+
+    # -- telemetry ----------------------------------------------------------
+    def _export(self, sub) -> None:
+        from . import telemetry as _telemetry
+        tel = _telemetry.get()
+        if tel is None:
+            return
+        g = tel.metrics.gauge
+        g("hetu_world_version").set(float(self.world_version))
+        g("hetu_world_workers").set(float(self.n_members))
+        g("hetu_world_servers").set(float(
+            self.eras[-1]["n_servers"] if self.eras else 1))
+        g("hetu_resizes_total").set(float(self.resizes))
+        if self.last_resize_ms is not None:
+            g("hetu_resize_duration_ms").set(
+                round(self.last_resize_ms, 2))
+
+
+# ---------------------------------------------------------------------------
+# local_cluster grow (the ps_join fault kind's executor)
+# ---------------------------------------------------------------------------
+
+def grow_local_cluster_server() -> threading.Thread:
+    """Add one PS server to THIS process's live ``local_cluster`` and run
+    the coordinator in a daemon thread (the worker side of the handshake
+    runs in the training loop's :class:`ElasticAgent`, so the coordinator
+    must not block it). Drives the ``ps_join@step`` fault kind."""
+    from .ps.local_cluster import get_live_cluster, spawn_light_server
+    live = get_live_cluster()
+    if not live:
+        raise RuntimeError("ps_join: no live local_cluster in this process")
+    port = live["port"]
+    st = resize_state("127.0.0.1", port)
+    old_ns = st["n_servers"]
+    new_ns = old_ns + 1
+
+    def spawn(sid: int):
+        base = dict(live.get("base_env") or {})
+        base["DMLC_NUM_SERVER"] = str(new_ns)
+        p = spawn_light_server(sid, base, live["stopfile"])
+        live["servers"][sid] = p
+        live.setdefault("procs", []).append(p)
+        live["n_servers"] = new_ns
+
+    coord = ElasticCoordinator("127.0.0.1", port)
+
+    def run():
+        try:
+            coord.resize(st["n_workers"], new_ns, spawn_server=spawn)
+        except Exception as e:  # noqa: BLE001 — surfaced via stderr; the
+            # training loop would otherwise hang parked in the drain
+            # barrier with no diagnosis
+            print(f"# hetu elastic: ps_join grow failed: {e!r}",
+                  file=sys.stderr, flush=True)
+
+    t = threading.Thread(target=run, name="hetu-elastic-grow", daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Scale policy (telemetry-driven resize decisions for PSSupervisor)
+# ---------------------------------------------------------------------------
+
+class ScalePolicy:
+    """Decide when the PS tier should grow, from the same kServerStats
+    rows the telemetry poll reads: sustained apply-latency pressure or
+    request-queue growth across ``sustain`` consecutive supervisor polls
+    recommends one more server (bounded by ``max_servers``). Deliberately
+    conservative — it recommends, the operator's ``on_scale`` hook (or
+    ``heturun --elastic``) acts."""
+
+    def __init__(self, max_servers: int, apply_ms_hi: float = 5.0,
+                 req_rate_hi: float = 2000.0, sustain: int = 3,
+                 cooldown_s: float = 30.0):
+        self.max_servers = int(max_servers)
+        self.apply_ms_hi = float(apply_ms_hi)
+        self.req_rate_hi = float(req_rate_hi)
+        self.sustain = max(1, int(sustain))
+        self.cooldown_s = float(cooldown_s)
+        self._hot_polls = 0
+        self._last = None  # (t, per-server [requests, apply_ns, applies])
+        self._last_decision_t = 0.0
+
+    def observe(self, stats_rows: list[list[int]],
+                now: Optional[float] = None) -> Optional[dict]:
+        now = time.monotonic() if now is None else now
+        cur = [(r[5], r[6], r[7]) for r in stats_rows if len(r) >= 8]
+        prev, self._last = self._last, (now, cur)
+        if not cur or prev is None or len(prev[1]) != len(cur):
+            self._hot_polls = 0
+            return None
+        dt = max(1e-6, now - prev[0])
+        hot = False
+        for (req0, ns0, ap0), (req1, ns1, ap1) in zip(prev[1], cur):
+            d_ap = ap1 - ap0
+            if d_ap > 0 and (ns1 - ns0) / d_ap / 1e6 > self.apply_ms_hi:
+                hot = True
+            if (req1 - req0) / dt > self.req_rate_hi:
+                hot = True
+        self._hot_polls = self._hot_polls + 1 if hot else 0
+        if self._hot_polls < self.sustain:
+            return None
+        if len(cur) >= self.max_servers:
+            return None
+        if now - self._last_decision_t < self.cooldown_s:
+            return None
+        self._hot_polls = 0
+        self._last_decision_t = now
+        return {"action": "grow_server", "n_servers": len(cur) + 1}

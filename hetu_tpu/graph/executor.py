@@ -1135,6 +1135,11 @@ class SubExecutor:
         sup = getattr(ex, "supervisor", None) if self.training else None
         if sup is not None:
             sup.pre_step(ex, self, step)
+        # hetu-elastic: pending-resize check AFTER fault injection (a
+        # ps_join fault proposes the resize this same boundary commits)
+        ela = getattr(ex, "elastic", None) if self.training else None
+        if ela is not None:
+            ela.step_boundary(self, step)
         feed_dict = feed_dict or {}
         feed_vals = []
         for node in self.feed_nodes:
@@ -1645,6 +1650,10 @@ class Executor:
                 float(self.n_params_total))
         # resilience.Supervisor hook point (attach_supervisor)
         self.supervisor = None
+        # hetu-elastic membership agent (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"): armed below for PS/Hybrid runs under HETU_ELASTIC;
+        # None otherwise — SubExecutor.run pays one None check per step
+        self.elastic = None
 
         self.subexecutors = {}
         for name, nodes in self.eval_node_dict.items():
@@ -1656,6 +1665,15 @@ class Executor:
                 self.subexecutors[name] = SubExecutor4Gpipe(name, nodes, self)
             else:
                 self.subexecutors[name] = SubExecutor(name, nodes, self)
+
+        if self.ps_runtime is not None:
+            from ..resilience import env_truthy
+            if env_truthy("HETU_ELASTIC"):
+                from ..elastic import ElasticAgent
+                self.elastic = ElasticAgent.from_env(self)
+                # after subexecutors exist: a late joiner's bootstrap
+                # re-partitions their dataloaders from the world log
+                self.elastic.bootstrap()
 
     # ------------------------------------------------------------------
     def _lint(self, lint):
@@ -1779,6 +1797,89 @@ class Executor:
         if self.config.device is not None:
             return jax.device_put(arr, self.config.device)
         return jnp.asarray(arr)
+
+    def remesh(self, new_mesh) -> dict:
+        """hetu-elastic leg 2: LIVE dp re-mesh — rebuild the device world
+        mid-run without losing a step. State round-trips through the
+        existing checkpoint capture/restore machinery
+        (``resilience.capture_executor_state`` — no new serialization
+        format): params, optimizer slots, op state, and hetuq
+        error-feedback residuals are captured to host, re-placed under the
+        new mesh's shardings, and every compiled step program is
+        invalidated (the shardings changed, so the old executables are
+        wrong, not just stale). The step counter, RNG folds, and
+        dataloader cursors survive, so training continues exactly where it
+        left off — ``tests/test_elastic_executor.py`` pins loss parity
+        against an uninterrupted run.
+
+        Pure data-parallel meshes only: dispatch-pinned (tensor-parallel)
+        parameter storage re-shards are not yet supported."""
+        cfg = self.config
+        if not isinstance(new_mesh, Mesh):
+            raise ValueError(
+                f"new_mesh must be a jax.sharding.Mesh, got "
+                f"{type(new_mesh).__name__}")
+        if cfg.gpipe:
+            raise NotImplementedError(
+                "remesh is not supported under gpipe: the pipeline "
+                "executor owns per-stage placement")
+        if cfg.mp_axis in new_mesh.axis_names or cfg.param_specs or (
+                cfg.mesh is not None
+                and cfg.mp_axis in cfg.mesh.axis_names):
+            raise NotImplementedError(
+                "remesh supports pure data-parallel meshes; model-parallel "
+                "(dispatch-pinned) parameter storage does not re-shard yet")
+        t0 = time.perf_counter()
+        from ..resilience import capture_executor_state, load_executor_state
+        state = capture_executor_state(self)
+        qresid_host = {id(n): np.asarray(self.state["qresid"][id(n)])
+                       for n in self._qresid_ordered()}
+        cfg.mesh = new_mesh
+
+        def place(x):
+            return jax.device_put(jnp.asarray(x),
+                                  NamedSharding(new_mesh, P()))
+
+        # params re-place through the same path init/load use
+        # (_place_param inside load_executor_state); slots/op-state/qresid
+        # re-place replicated explicitly — like_current's bare jnp.asarray
+        # would leave them on the default device, and donation across
+        # mismatched placements is what a half-moved world trips over
+        load_executor_state(self, state)
+        for n in self._opt_nodes():
+            self.state["slots"][id(n)] = jax.tree.map(
+                place, self.state["slots"][id(n)])
+        for n in self._stateful_nodes():
+            self.state["op_state"][id(n)] = jax.tree.map(
+                place, self.state["op_state"][id(n)])
+        for nid, v in qresid_host.items():
+            self.state["qresid"][nid] = place(v)
+        for sub in self.subexecutors.values():
+            sub._compiled.clear()
+            sub._replay_compiled.clear()
+            sub._exe_cache.clear()
+            sub._base_sigs.clear()
+            sub._last_call = None
+            sub._dev_prefetch.clear()
+            for nid in list(sub.resident_dl):
+                node = next(n for n in sub.res_dl_nodes if id(n) == nid)
+                dl = node.dataloaders.get(sub.name)
+                # re-place the resident dataset (old-mesh arrays are no
+                # longer addressable placements for the new programs) and
+                # refresh geometry — an elastic repartition may have
+                # changed it
+                sub.resident_dl[nid] = (
+                    self._prepare_input(dl._data, batch=False),
+                    dl.batch_size, dl.batch_num)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        if self.telemetry is not None:
+            g = self.telemetry.metrics.gauge
+            g("hetu_dp_size").set(float(cfg.dp_size))
+            g("hetu_resize_duration_ms").set(round(dur_ms, 2))
+            self.telemetry.event("remesh", dp_size=cfg.dp_size,
+                                 duration_ms=round(dur_ms, 1))
+        return {"dp_size": cfg.dp_size, "duration_ms": round(dur_ms, 2),
+                "step": int(self.state["step"])}
 
     def attach_supervisor(self, sup):
         """Attach a ``resilience.Supervisor``: its pre_step/post_step hooks

@@ -265,7 +265,15 @@ class PSRuntime:
         return float(o.lr_value(step))
 
     def _init_params(self):
+        import os
         cfg = self.config
+        # hetu-elastic late joiner: the PS tables already hold TRAINED
+        # state (InitTensor is idempotent server-side so declaring them is
+        # safe), but the host-side value push would DESTROY it, and the
+        # init barrier would park forever — the peers are training, not
+        # bootstrapping. Skip both; dense host_value pulls below fetch the
+        # live values.
+        joiner = bool(os.environ.get("HETU_ELASTIC_JOIN"))
         if cfg.cstable_policy and (not self._server_opt["prescale"]
                                    or self._server_opt["l2reg"] > 0.0):
             raise NotImplementedError(
@@ -294,7 +302,7 @@ class PSRuntime:
                 self.comm.InitTensor(p.ps_id, kind, rows, width, "constant",
                                      0.0, 1.0, seed=cfg.seed,
                                      opt_type=opt["otype"], lrs=opt["lrs"])
-                if self.comm.rank == 0:
+                if not joiner and self.comm.rank == 0:
                     import jax
                     # per-param key (fold in ps_id): same-shape derived-init
                     # params must not share initial values, matching the
@@ -311,7 +319,8 @@ class PSRuntime:
                             value.reshape(rows, width))
                     else:
                         self.comm.Assign(p.ps_id, value.ravel())
-                self.comm.BarrierWorker()
+                if not joiner:
+                    self.comm.BarrierWorker()
             if p.sparse and cfg.cstable_policy:
                 from ..cstable import CacheSparseTable
                 limit = max(1, int(rows * 0.1))

@@ -37,6 +37,9 @@ def _load_lib():
     lib.rank.restype = ctypes.c_int
     lib.nrank.restype = ctypes.c_int
     lib.num_servers.restype = ctypes.c_int
+    lib.SetWorldVersion.argtypes = [ctypes.c_ulonglong]
+    lib.GetWorldVersion.restype = ctypes.c_ulonglong
+    lib.RefreshServers.restype = ctypes.c_int
     return lib
 
 
@@ -160,6 +163,30 @@ class PSClient:
                 "failovers": int(out[2]),
                 "quant_raw_bytes": int(out[3]),
                 "quant_wire_bytes": int(out[4])}
+
+    def SetWorldVersion(self, version):
+        """hetu-elastic: stamp this worker's committed membership epoch
+        onto every subsequent request. Servers armed via the coordinator's
+        ``kSetWorldVersion`` reject a mismatched non-zero stamp (a
+        straggler that missed a resize commit) as an error response; 0
+        (the default) is unversioned legacy traffic, always accepted."""
+        self._lib.SetWorldVersion(ctypes.c_ulonglong(int(version)))
+        self._check()
+
+    def GetWorldVersion(self) -> int:
+        return int(self._lib.GetWorldVersion())
+
+    def RefreshServers(self) -> int:
+        """hetu-elastic: re-sync the native agent's server connections +
+        key-range partitioner with the scheduler's address book after a
+        committed resize. All in-flight traffic must be drained first
+        (PSRuntime.drain() — the ElasticAgent handles the ordering).
+        Returns the new server count."""
+        n = self._lib.RefreshServers()
+        self._check()
+        if n < 0:
+            raise RuntimeError("RefreshServers failed with no diagnostic")
+        return n
 
     def SetCommQuant(self, mode):
         """hetuq: quantize this worker's PS value payloads on the wire

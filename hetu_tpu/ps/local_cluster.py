@@ -208,7 +208,12 @@ def local_cluster(n_servers: int = 1, n_workers: int = 1, port: int = None,
         _LIVE.clear()
         _LIVE.update({"n_servers": n_servers, "servers": servers_by_id,
                       "supervisor": sup, "snapshot_dir": snapdir,
-                      "port": port})
+                      "port": port,
+                      # hetu-elastic (elastic.grow_local_cluster_server):
+                      # enough to spawn a JOINING server into this world
+                      # and have teardown reap it
+                      "base_env": dict(base), "stopfile": stopfile,
+                      "procs": procs})
         yield port
     finally:
         _LIVE.clear()

@@ -31,9 +31,18 @@ import time
 # the same assumption the native van makes). MsgHeader is 32 bytes with no
 # implicit padding; ArgHeader is 16.
 _MSG_HDR = struct.Struct("<iiQiiii")  # type, tensor_id, req_id, n_args,
-#                                       flags, client_id, pad
+#                                       flags, client_id, world_ver (0 =
+#                                       unversioned; hetu-elastic stamp)
 _ARG_HDR = struct.Struct("<iiQ")      # dtype, pad, nbytes
 _K_QUERY_SERVERS = 6
+
+
+class SchedulerUnreachable(ConnectionError):
+    """The scheduler did not answer (dead, unreachable, or timed out).
+    Replaces the opaque ``socket.timeout`` traceback a dead scheduler used
+    to produce with a message naming the address. Subclasses
+    ``ConnectionError`` (hence ``OSError``) so the supervisor's
+    keep-polling path still treats it as the transient it usually is."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -50,15 +59,20 @@ def query_servers(host: str, port: int, timeout: float = 2.0):
     """One ``kQueryServers`` round trip: returns ``(addrs, alive)`` where
     ``addrs[i]`` is server i's registered address ("" before registration)
     and ``alive[i]`` is 1 while its heartbeat is fresh. Empty lists until
-    the first server registers."""
-    with socket.create_connection((host, port), timeout=timeout) as s:
-        s.settimeout(timeout)
-        s.sendall(_MSG_HDR.pack(_K_QUERY_SERVERS, 0, 0, 0, 0, -1, 0))
-        head = _MSG_HDR.unpack(_recv_exact(s, _MSG_HDR.size))
-        args = []
-        for _ in range(head[3]):
-            _, _, nbytes = _ARG_HDR.unpack(_recv_exact(s, _ARG_HDR.size))
-            args.append(_recv_exact(s, nbytes))
+    the first server registers. Raises :class:`SchedulerUnreachable`
+    (naming the address) when the scheduler does not answer."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(_MSG_HDR.pack(_K_QUERY_SERVERS, 0, 0, 0, 0, -1, 0))
+            head = _MSG_HDR.unpack(_recv_exact(s, _MSG_HDR.size))
+            args = []
+            for _ in range(head[3]):
+                _, _, nbytes = _ARG_HDR.unpack(_recv_exact(s, _ARG_HDR.size))
+                args.append(_recv_exact(s, nbytes))
+    except (socket.timeout, OSError) as e:
+        raise SchedulerUnreachable(
+            f"scheduler at {host}:{port} unreachable ({e!r})") from e
     book = args[0].decode() if args else ""
     # one "addr\n" per server, "" before that server registered — keep the
     # empties (drop only the trailing terminator) so addrs[i] stays server i
@@ -176,7 +190,7 @@ class PSSupervisor(threading.Thread):
     def __init__(self, sched_host: str, sched_port: int, n_servers: int,
                  respawn, procs=None, *, poll_s: float = 0.5,
                  max_respawns: int = 3, grace_polls: int = 2,
-                 log=None):
+                 log=None, scale_policy=None, on_scale=None):
         super().__init__(name="hetu-ps-supervisor", daemon=True)
         self.sched_host = sched_host
         self.sched_port = int(sched_port)
@@ -198,6 +212,13 @@ class PSSupervisor(threading.Thread):
         self.events: list[tuple[float, str]] = []
         self._seen_alive = [False] * self.n_servers
         self._dead_polls = [0] * self.n_servers
+        # hetu-elastic scale hook: ``scale_policy.observe(stats_rows)`` is
+        # fed raw kServerStats rows from every live server each poll; a
+        # non-None recommendation goes to ``on_scale(decision)`` (e.g.
+        # heturun --elastic's grow-server path). The supervisor only
+        # RELAYS — it never resizes the world itself.
+        self.scale_policy = scale_policy
+        self.on_scale = on_scale
         self._stop_evt = threading.Event()
         # telemetry export: the supervisor lives in the (jax-free) launcher
         # parent, so it appends its own JSONL next to the workers' files
@@ -234,11 +255,64 @@ class PSSupervisor(threading.Thread):
                 except Exception:  # noqa: BLE001 — even logging may fail
                     pass
 
+    def watch_server(self, sid: int, proc) -> None:
+        """Extend supervision to a server that JOINED via an elastic grow:
+        it gets the same heartbeat watch + respawn budget as the launch
+        set."""
+        while len(self._seen_alive) <= sid:
+            self._seen_alive.append(False)
+            self._dead_polls.append(0)
+        self.procs[sid] = proc
+        self.n_servers = max(self.n_servers, sid + 1)
+
+    def unwatch_server(self, sid: int) -> None:
+        """Stop supervising a server whose elastic grow ABORTED: it never
+        became part of the committed world, so its death must not burn
+        respawn budget. (The never-registered + no-process combination
+        makes the poll skip the id.)"""
+        if sid < len(self._seen_alive):
+            self._seen_alive[sid] = False
+            self._dead_polls[sid] = 0
+        self.procs[sid] = None
+
+    _scale_poll_count = 0
+    SCALE_POLL_EVERY = 4  # stats cadence relative to the health poll
+
     def _poll_once(self) -> None:
         try:
-            _, alive = query_servers(self.sched_host, self.sched_port)
+            addrs, alive = query_servers(self.sched_host, self.sched_port)
         except OSError:
             return  # scheduler not up yet / transient — keep polling
+        self._run_liveness(alive)
+        # scale-policy stats LAST and on a reduced cadence with a short
+        # timeout: the collection is advisory, and a wedged server's 3s
+        # stats stall must not delay death-detection/respawn above
+        if self.scale_policy is not None and self.on_scale is not None:
+            self._scale_poll_count += 1
+            if self._scale_poll_count % self.SCALE_POLL_EVERY:
+                return
+            try:
+                from ..elastic import server_stats_raw
+                # one shared deadline across the sweep: several wedged
+                # servers must not stack their timeouts and stretch the
+                # NEXT liveness poll past its cadence
+                deadline = time.monotonic() + 2.0
+                rows = []
+                for a, al in zip(addrs, alive):
+                    if not (a and al):
+                        continue
+                    left = deadline - time.monotonic()
+                    if left <= 0.05:
+                        break  # partial sweep; the policy sees fewer rows
+                    rows.append(server_stats_raw(a, timeout=min(1.0, left)))
+                decision = self.scale_policy.observe(rows)
+                if decision:
+                    self._note(f"scale policy recommends {decision}")
+                    self.on_scale(decision)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                self._note(f"scale policy poll failed ({e!r}); continuing")
+
+    def _run_liveness(self, alive) -> None:
         # the scheduler's book only grows on kRegister, so a server that
         # died before ANY registration is invisible in `alive` — iterate
         # every expected id and treat the missing tail as not-alive, or

@@ -139,6 +139,16 @@ class FaultInjector:
       as an error response instead of applying garbage
       (docs/COMM_QUANT.md; the C++ hook is additionally gated on
       HETU_TEST_MODE in capi.cc).
+    - ``worker_lost@S[:RANK]`` — this process SIGKILLs ITSELF at step S
+      when its WORKER_ID matches RANK (default: any rank) — the
+      deterministic elastic scale-down trigger: under ``heturun
+      --elastic`` the launcher observes the death and proposes a world
+      shrink (docs/FAULT_TOLERANCE.md "Elastic membership").
+    - ``ps_join@S`` — grow this process's live ``ps.local_cluster`` by one
+      PS server at step S (spawns the server + runs the resize
+      coordinator in a daemon thread; the executor's ElasticAgent
+      drains/commits at the same boundary and the key ranges migrate
+      live).
 
     ``from_env()`` (the only path wired into the executor by default) returns
     None unless :func:`test_mode_enabled` — direct construction is itself an
@@ -146,7 +156,7 @@ class FaultInjector:
     """
 
     KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
-             "ps_kill", "quant_corrupt")
+             "ps_kill", "quant_corrupt", "worker_lost", "ps_join")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -189,10 +199,12 @@ class FaultInjector:
     def fires(self, kind: str, step: int) -> bool:
         return self.take(kind, step) is not None
 
-    def inject_host(self, step: int) -> None:
+    def inject_host(self, step: int, ex=None) -> None:
         """Host-side faults for this step boundary (stall / signals /
         crash). ``nan_grads`` is NOT handled here — it rides into the jitted
-        step as a scalar argument (see SubExecutor)."""
+        step as a scalar argument (see SubExecutor). ``ex`` (when the
+        Supervisor passes it) lets elastic faults reach the executor's
+        membership agent."""
         e = self.take("stall", step)
         if e is not None:
             time.sleep(e["arg"] if e["arg"] is not None else 3600.0)
@@ -206,6 +218,27 @@ class FaultInjector:
             comm = ps_pkg.get_worker_communicate()
             comm.TestCorruptNextQuant(-1 if e["arg"] is None
                                       else int(e["arg"]))
+        e = self.take("worker_lost", step)
+        if e is not None:
+            my_rank = int(os.environ.get("WORKER_ID", "0"))
+            if e["arg"] is None or int(e["arg"]) == my_rank:
+                # die like a preempted host: no checkout, no cleanup — the
+                # elastic launcher must absorb it as a planned departure.
+                # Progress flushes first (a real preemption's SIGTERM grace
+                # window gives the same guarantee), so the departed tail is
+                # redistributed exactly: `step` boundaries completed =
+                # `step` batches consumed.
+                ela = getattr(ex, "elastic", None) if ex is not None else None
+                if ela is not None:
+                    ela.write_progress(step)
+                print(f"# hetu fault: worker_lost — rank {my_rank} "
+                      f"SIGKILLing itself at step {step}", file=sys.stderr,
+                      flush=True)
+                os.kill(os.getpid(), _signal.SIGKILL)
+        e = self.take("ps_join", step)
+        if e is not None:
+            from .elastic import grow_local_cluster_server
+            grow_local_cluster_server()
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:
@@ -625,7 +658,7 @@ class Supervisor:
         if self.watchdog is not None:
             self.watchdog.beat(phase=f"{sub.name}:pre_step", step=step)
         if self.fault_injector is not None:
-            self.fault_injector.inject_host(step)
+            self.fault_injector.inject_host(step, ex=ex)
 
     def inject_nan(self, step: int) -> bool:
         """Whether this step's in-trace update should be NaN-poisoned

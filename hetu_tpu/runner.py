@@ -147,6 +147,17 @@ def main(argv=None):
                              "failover deadline (DMLC_PS_FAILOVER_DEADLINE_"
                              "MS) so in-flight requests re-issue instead of "
                              "failing (see docs/FAULT_TOLERANCE.md)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic membership (single-host PS mode): a "
+                             "worker that exits abnormally becomes a "
+                             "planned DEPARTURE (the launcher proposes a "
+                             "world shrink via the scheduler's two-phase "
+                             "resize instead of restarting it); SIGUSR1 "
+                             "grows the world by one worker, SIGUSR2 by "
+                             "one PS server (key ranges migrate live). "
+                             "Workers run with HETU_ELASTIC=1 and drain/"
+                             "commit at step boundaries (see 'Elastic "
+                             "membership' in docs/FAULT_TOLERANCE.md)")
     parser.add_argument("--telemetry-dir", default="",
                         help="shared telemetry directory: workers run with "
                              "HETU_TELEMETRY_DIR set (HETU_TELEMETRY "
@@ -199,6 +210,17 @@ def main(argv=None):
         # failover. Explicit env wins over the defaults.
         from hetu_tpu.ps.supervisor import apply_ha_env_defaults
         ps_snap_created = apply_ha_env_defaults(env)
+    elastic_on = args.elastic and enable_ps and len(hosts) == 1
+    elastic_dir = None
+    if args.elastic and not elastic_on:
+        # never let an operator believe elasticity is armed when it is not
+        print("# heturun: --elastic requires single-host PS mode; elastic "
+              "membership is OFF for this cluster", file=sys.stderr)
+    if elastic_on:
+        import tempfile
+        elastic_dir = tempfile.mkdtemp(prefix="hetu_elastic_")
+        env["HETU_ELASTIC"] = "1"
+        env["HETU_ELASTIC_DIR"] = elastic_dir
 
     ctx = multiprocessing.get_context("spawn")
     ps_sup = None
@@ -217,16 +239,125 @@ def main(argv=None):
                 ps_sup = start_mp_supervisor(
                     ctx, _server_entry, env, server_procs, _procs.append,
                     max_respawns=args.ps_max_respawns)
-        def spawn_worker(w):
+        def spawn_worker(w, join=False):
             wenv = dict(env)
             wenv["WORKER_ID"] = str(w)
             if enable_ps:
                 wenv["DMLC_ROLE"] = "worker"
+            if join:
+                # late joiner: skip init pushes/barriers, bootstrap step +
+                # data partition from the scheduler's world log
+                wenv["HETU_ELASTIC_JOIN"] = "1"
             # multi-chip single host: each worker is one jax process
             wenv["HETU_NUM_WORKER"] = str(num_workers)
             p = subprocess.Popen(args.command, env=wenv)
             _shells.append(p)   # visible to the signal handler
             return p
+
+        # -- elastic membership (docs/FAULT_TOLERANCE.md) -------------------
+        # The launcher parent IS the resize coordinator: worker deaths
+        # propose shrinks, SIGUSR1/SIGUSR2 (or the supervisor's scale
+        # policy) propose grows. All resizes run inline in the reap loop —
+        # the drain completes when the survivors reach their next step
+        # boundary, bounded by HETU_ELASTIC_DRAIN_TIMEOUT_S.
+        usr_grow = {"worker": 0, "server": 0}
+        if elastic_on:
+            signal.signal(signal.SIGUSR1,
+                          lambda *_: usr_grow.__setitem__(
+                              "worker", usr_grow["worker"] + 1))
+            signal.signal(signal.SIGUSR2,
+                          lambda *_: usr_grow.__setitem__(
+                              "server", usr_grow["server"] + 1))
+        # supervisor-thread grow requests ride their own queue: usr_grow's
+        # read-modify-write is only safe from the signal handlers (which
+        # run on the main thread); a cross-thread += would race the main
+        # loop's decrement and duplicate or drop a grow. list.append/pop
+        # are atomic under the GIL.
+        scale_requests: list = []
+        if elastic_on and ps_sup is not None:
+            # telemetry-driven scale policy: the supervisor feeds raw
+            # kServerStats rows each poll; a grow recommendation takes the
+            # same path as an operator SIGUSR2
+            from hetu_tpu.elastic import ScalePolicy
+            ps_sup.scale_policy = ScalePolicy(max_servers=int(os.environ.get(
+                "HETU_ELASTIC_MAX_SERVERS", str(num_servers + 2))))
+            ps_sup.on_scale = lambda d: scale_requests.append(d)
+
+        def elastic_coord():
+            from hetu_tpu.elastic import ElasticCoordinator
+            return ElasticCoordinator(
+                env.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                int(env.get("DMLC_PS_ROOT_PORT", "13200")),
+                drain_timeout_s=float(os.environ.get(
+                    "HETU_ELASTIC_DRAIN_TIMEOUT_S", "60")))
+
+        def elastic_world():
+            from hetu_tpu.elastic import resize_state
+            return resize_state(env.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                                int(env.get("DMLC_PS_ROOT_PORT", "13200")))
+
+        # ranks that left the world but are not yet removed from the
+        # scheduler's member set: abnormal exits resize immediately; clean
+        # (rc=0) completions defer to the next resize — their partitions
+        # are fully consumed, and resizing on every natural completion
+        # would stall teardown when the whole fleet finishes together
+        pending_departed: dict = {}
+
+        def note_departure(w):
+            step = -1
+            try:
+                with open(os.path.join(elastic_dir,
+                                       f"progress_r{w}")) as f:
+                    step = int(f.read().strip())
+            except (OSError, ValueError):
+                pass  # unknown progress: the scheduler falls back
+            pending_departed[w] = step
+
+        def elastic_resize(d_workers=0, d_servers=0):
+            """One membership change folding in every pending departure.
+            ``d_workers``/``d_servers`` grow the world by that many."""
+            st = elastic_world()
+            removed = [r for r in pending_departed if r in st["members"]]
+            steps = [pending_departed[r] for r in removed]
+            new_nw = len(st["members"]) - len(removed) + d_workers
+            new_ns = st["n_servers"] + d_servers
+            if new_nw < 1:
+                return None  # the last worker left: nothing to resize for
+
+            spawned_sids: list = []
+
+            def spawn_srv(sid):
+                p = ctx.Process(target=_server_entry, args=(sid, env))
+                p.start()
+                _procs.append(p)
+                server_procs[sid] = p
+                spawned_sids.append(sid)
+                if ps_sup is not None:
+                    ps_sup.watch_server(sid, p)
+
+            try:
+                report = elastic_coord().resize(
+                    new_nw, new_ns, removed=removed, removed_steps=steps,
+                    spawn_server=spawn_srv if d_servers else None,
+                    spawn_worker=(lambda r: running.__setitem__(
+                        r, spawn_worker(r, join=True)))
+                    if d_workers else None)
+            except Exception:
+                # an aborted grow must not leave the joining server as an
+                # orphan: it never became part of the committed world, so
+                # reap it and drop it from supervision (its death must not
+                # burn respawn budget)
+                for sid in spawned_sids:
+                    p = server_procs.pop(sid, None)
+                    if p is not None:
+                        p.terminate()
+                        p.join(timeout=10)
+                    if ps_sup is not None:
+                        ps_sup.unwatch_server(sid)
+                raise
+            for r in removed:
+                pending_departed.pop(r, None)
+            return report
 
         running = {w: spawn_worker(w) for w in range(num_workers)}
         respawn_at = {}   # worker id -> monotonic deadline (backoff pending)
@@ -239,6 +370,31 @@ def main(argv=None):
                 if rc is None:
                     continue
                 del running[w]
+                if elastic_on and running:
+                    # elastic: every exit is a membership event. Clean
+                    # completions defer (their partition is consumed);
+                    # abnormal exits — crash, SIGKILL, preemption — are
+                    # DEPARTURES: shrink the world so survivors
+                    # re-partition, instead of restarting
+                    note_departure(w)
+                    if rc == 0:
+                        continue
+                    if rc == EXIT_PREEMPTED:
+                        preempted = True
+                    print(f"# heturun: worker {w} exited rc={rc}; elastic: "
+                          "proposing shrink", file=sys.stderr, flush=True)
+                    try:
+                        elastic_resize()
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        # falling back to RESTART means this rank is not
+                        # departed after all — a stale pending_departed
+                        # entry would decommission the respawned worker at
+                        # the next resize and double-consume its samples
+                        pending_departed.pop(w, None)
+                        print(f"# heturun: elastic shrink failed ({e!r}); "
+                              "falling back to restart/fail handling",
+                              file=sys.stderr, flush=True)
                 if rc == 0:
                     continue
                 if rc == EXIT_PREEMPTED:
@@ -259,6 +415,27 @@ def main(argv=None):
                     # first failure wins: survivors killed by the teardown
                     # below exit -15, which must not mask the real code
                     rc_final = rc
+            while elastic_on and usr_grow["worker"] > 0 and running:
+                usr_grow["worker"] -= 1
+                try:
+                    print("# heturun: elastic: growing by one worker",
+                          file=sys.stderr, flush=True)
+                    elastic_resize(d_workers=1)
+                except Exception as e:  # noqa: BLE001
+                    print(f"# heturun: elastic worker grow failed ({e!r})",
+                          file=sys.stderr, flush=True)
+            while elastic_on and scale_requests and running:
+                scale_requests.pop()
+                usr_grow["server"] += 1  # main thread: safe to merge here
+            while elastic_on and usr_grow["server"] > 0 and running:
+                usr_grow["server"] -= 1
+                try:
+                    print("# heturun: elastic: growing by one PS server",
+                          file=sys.stderr, flush=True)
+                    elastic_resize(d_servers=1)
+                except Exception as e:  # noqa: BLE001
+                    print(f"# heturun: elastic server grow failed ({e!r})",
+                          file=sys.stderr, flush=True)
             now = time.monotonic()
             if ps_sup is not None and ps_sup.fatal and not rc_final:
                 # the PS tier is permanently down (respawn budget exhausted
@@ -307,6 +484,9 @@ def main(argv=None):
         if ps_snap_created:
             from hetu_tpu.ps.supervisor import cleanup_snapshot_root
             cleanup_snapshot_root(ps_snap_created)
+        if elastic_dir:
+            import shutil
+            shutil.rmtree(elastic_dir, ignore_errors=True)
         rc = rc_final if rc_final else (EXIT_PREEMPTED if preempted else 0)
         _write_telemetry_summary(rc, preempted, num_workers)
         sys.exit(rc)

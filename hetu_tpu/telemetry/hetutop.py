@@ -426,6 +426,38 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
             line += (f"  NONFINITE: {', '.join(nonfin[:4])}" if nonfin
                      else "  nonfinite ops: 0")
             lines.append(line)
+    # hetu-elastic membership (docs/FAULT_TOLERANCE.md): current world
+    # version, live workers/servers, last resize cost — fed by the
+    # ElasticAgent's gauges; absent (no line) for non-elastic runs
+    wv = None
+    memb = {}
+    for rk in state["ranks"].values():
+        m = rk["metrics"]
+        v = _defloat(m.get("hetu_world_version"))
+        if v is None:
+            continue
+        if wv is None or v > wv:
+            wv, memb = v, {}
+        if v == wv:
+            # ranks at the same world merge per-key maxima: a fresh
+            # JOINER reports resizes=0 next to a survivor's true count
+            for k in ("hetu_world_workers", "hetu_world_servers",
+                      "hetu_resizes_total", "hetu_resize_duration_ms"):
+                x = _defloat(m.get(k))
+                if x is not None and (memb.get(k) is None
+                                      or x > memb[k]):
+                    memb[k] = x
+    if wv is not None:
+        live_ranks = len(state["ranks"])
+        line = (f"membership: world v{int(wv)}  "
+                f"workers {_fmt(memb.get('hetu_world_workers'), '.0f')}"
+                f" ({live_ranks} reporting)  "
+                f"servers {_fmt(memb.get('hetu_world_servers'), '.0f')}  "
+                f"resizes {_fmt(memb.get('hetu_resizes_total'), '.0f')}")
+        if memb.get("hetu_resize_duration_ms") is not None:
+            line += (f"  last resize "
+                     f"{memb['hetu_resize_duration_ms']:.0f}ms")
+        lines.append(line)
     if state["ps"]:
         lines.append("PS servers:")
         for sid in sorted(state["ps"]):

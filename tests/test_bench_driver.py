@@ -528,8 +528,18 @@ def test_wedge_bisect_execute_side_verdict(monkeypatch, tmp_path):
 
 def test_subprocess_timeout_result_carries_hang_marker():
     # the structured marker is load-bearing for every triage path; pin the
-    # REAL timeout return shape: a 1s deadline kills the child during
-    # interpreter startup regardless of backend state
-    out = bench._section_subprocess("probe", 1)
+    # REAL timeout return shape: a 1s deadline usually kills the child
+    # during interpreter startup. On a warm OS page/compile cache the
+    # probe child can FINISH inside 1s (the historical flake) — that run
+    # proves nothing about the timeout shape, so retry a few times and
+    # skip (not fail) if the host is consistently that fast.
+    out = None
+    for _ in range(3):
+        out = bench._section_subprocess("probe", 1)
+        if "hang" in out or "error" in out:
+            break
+    if out is not None and "hang" not in out and "error" not in out:
+        pytest.skip("probe child finished inside the 1s deadline on every "
+                    "attempt (warm cache) — timeout shape not exercised")
     assert out.get("hang") is True
     assert "timed out after 1s" in out["error"]
