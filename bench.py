@@ -607,6 +607,149 @@ def bench_comm_quant_dp(width=512, batch=512, steps=40, warmup=5):
     return out
 
 
+def bench_kernels(vocab=1_000_000, dim=32, batch=4096, lookups=4,
+                  warmup=5, iters=30):
+    """hetukern cell (docs/KERNELS.md): (a) the per-kernel interpret-mode
+    equality smoke — force-mode Pallas vs the XLA fallback through the
+    real registry dispatch, under jit so both sides compile — and (b) the
+    fused-embed-grad A/B on the CTR shape: the pre-hetukern dense
+    ``(vocab, dim)`` zeros-table scatter vs the compact rows path
+    (sort/unique + segment-sum), step time AND compiled peak HBM from the
+    same executable handles hetuprof reads. The structural win (no
+    table-sized intermediate) is backend-independent; SECTION_ENV pins the
+    cell to CPU so the number is deterministic."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.kernels import registry, embed_grad, csr_spmm, \
+        quant_comm, fused_opt
+    from hetu_tpu import comm_quant
+
+    rng = np.random.RandomState(0)
+    out = {"equality": {}}
+
+    # -- (a) registry dispatch + one equality check per kernel -------------
+    def check(name, force_fn, oracle_fn, *args, exact=False, atol=1e-4):
+        @jax.jit
+        def _force(*a):
+            with registry.active("force"):
+                return force_fn(*a)
+
+        @jax.jit
+        def _off(*a):
+            with registry.active("off"):
+                return oracle_fn(*a)
+
+        got = jax.tree.map(np.asarray, _force(*args))
+        want = jax.tree.map(np.asarray, _off(*args))
+        flat_g = jax.tree.leaves(got)
+        flat_w = jax.tree.leaves(want)
+        # structure must match too — zip would silently truncate a
+        # mismatched tree and report a never-checked equivalence
+        ok = len(flat_g) == len(flat_w) and all(
+            (np.array_equal(a, b) if exact
+             else np.allclose(a, b, atol=atol))
+            for a, b in zip(flat_g, flat_w))
+        out["equality"][name] = "ok" if ok else "MISMATCH"
+        return ok
+
+    ev = jnp.asarray(rng.randn(256, 128).astype(np.float32))
+    ei = jnp.asarray(rng.randint(0, 40, 256))
+    check("fused_embed_grad",
+          lambda v, i: embed_grad.embed_grad_rows(v, i, 1000),
+          lambda v, i: embed_grad.embed_grad_rows(v, i, 1000), ev, ei)
+    sv = jnp.asarray(rng.randn(300).astype(np.float32))
+    sr = jnp.asarray(rng.randint(0, 8, 300).astype(np.int32))
+    sc = jnp.asarray(rng.randint(0, 16, 300).astype(np.int32))
+    sb = jnp.asarray(rng.randn(16, 128).astype(np.float32))
+    check("csr_spmm",
+          lambda v, r, c, b: csr_spmm.coo_matmat(v, r, c, 8, b),
+          lambda v, r, c, b: csr_spmm.coo_matmat(v, r, c, 8, b),
+          sv, sr, sc, sb)
+    qx = jnp.asarray(rng.randn(4096).astype(np.float32))
+    check("quant_blocks",
+          lambda x: quant_comm.quantize_blocks(x, 256, "int8"),
+          lambda x: comm_quant.quantize_blocks(x, 256, "int8"),
+          qx, exact=True)   # wire payloads must be bit-identical
+    qq, qs, qn = comm_quant.quantize_blocks(qx, 256, "int8")
+    check("dequant_blocks",
+          lambda q, s: quant_comm.dequantize_blocks(q, s, 4096, 256),
+          lambda q, s: comm_quant.dequantize_blocks(q, s, 4096, 256),
+          qq, qs, exact=True)
+
+    class _O:
+        beta1, beta2, epsilon, weight_decay, l2reg = 0.9, 0.999, 1e-7, 0.0, 0.0
+
+    op_ = jnp.asarray(rng.randn(8, 128).astype(np.float32))
+    og = jnp.asarray(rng.randn(8, 128).astype(np.float32))
+    slot = {"m": jnp.zeros((8, 128), jnp.float32),
+            "v": jnp.zeros((8, 128), jnp.float32),
+            "t": jnp.zeros((), jnp.float32)}
+    check("fused_adam",
+          lambda p, g: fused_opt.adam_step(_O, p, g, slot, 0.01),
+          lambda p, g: fused_opt.adam_step(_O, p, g, slot, 0.01),
+          op_, og, exact=True)
+    check("fused_sgd",
+          lambda p, g: fused_opt.sgd_step(_O, p, g, 0.01),
+          lambda p, g: fused_opt.sgd_step(_O, p, g, 0.01),
+          op_, og, exact=True)
+
+    # -- (b) fused embed-grad A/B on the CTR shape -------------------------
+    # lookups-per-example x batch row grads into a (vocab, dim) table: the
+    # dense path writes the whole table per step to carry ~batch live rows
+    vec = jnp.asarray(rng.randn(batch, lookups, dim).astype(np.float32))
+    idx = jnp.asarray(
+        # duplicate-heavy, like CTR hash features (power-law-ish)
+        (rng.zipf(1.3, size=(batch, lookups)) % vocab).astype(np.int64))
+
+    dense_fn = jax.jit(
+        lambda v, i: embed_grad.embed_grad_dense_xla(v, i, (vocab, dim)))
+    rows_fn = jax.jit(
+        lambda v, i: embed_grad.embed_grad_rows(v, i, vocab))
+
+    def timed(fn):
+        # AOT: compile ONCE and reuse the executable for both the timing
+        # loop and memory_analysis (a fresh .lower().compile() after the
+        # timed calls would recompile the whole program a second time)
+        exe = fn.lower(vec, idx).compile()
+        jax.block_until_ready(exe(vec, idx))
+        for _ in range(warmup):
+            jax.block_until_ready(exe(vec, idx))
+        t0 = time.time()
+        for _ in range(iters):
+            r = exe(vec, idx)
+        jax.block_until_ready(r)
+        ms = (time.time() - t0) / iters * 1000
+        mem = None
+        try:
+            ma = exe.memory_analysis()
+            mem = (int(ma.argument_size_in_bytes)
+                   + int(ma.output_size_in_bytes)
+                   + int(ma.temp_size_in_bytes)
+                   - int(getattr(ma, "alias_size_in_bytes", 0) or 0))
+        except Exception:  # noqa: BLE001 — backend may expose no analysis
+            pass
+        return ms, mem
+
+    ms_dense, mem_dense = timed(dense_fn)
+    ms_rows, mem_rows = timed(rows_fn)
+    out["embed_grad"] = {
+        "vocab": vocab, "dim": dim, "rows_pushed": batch * lookups,
+        "dense_step_ms": round(ms_dense, 3),
+        "rows_step_ms": round(ms_rows, 3),
+        "speedup_rows": round(ms_dense / ms_rows, 2) if ms_rows else None,
+    }
+    if mem_dense and mem_rows:
+        out["embed_grad"]["dense_peak_mib"] = round(mem_dense / 2**20, 2)
+        out["embed_grad"]["rows_peak_mib"] = round(mem_rows / 2**20, 2)
+        out["embed_grad"]["hbm_ratio"] = round(mem_dense / mem_rows, 2)
+    # headline copies for the telemetry line / gate
+    out["dense_step_ms"] = out["embed_grad"]["dense_step_ms"]
+    out["rows_step_ms"] = out["embed_grad"]["rows_step_ms"]
+    out["speedup_rows"] = out["embed_grad"]["speedup_rows"]
+    out["equality_ok"] = all(v == "ok" for v in out["equality"].values())
+    return out
+
+
 def bench_vit(batch=64, warmup=3, iters=15, **cfg_overrides):
     """ViT-base/16 image-classification fine-tune step (the vision side of
     the flagship trunk; same 6ND + attention-inclusive MFU accounting as
@@ -844,6 +987,10 @@ def _run_section(name):
     elif name == "comm_quant_dp":
         kw = (dict(width=64, batch=32, steps=8, warmup=2) if smoke else {})
         out = bench_comm_quant_dp(**kw)
+    elif name == "kernels":
+        kw = (dict(vocab=5000, dim=32, batch=512, lookups=2, warmup=1,
+                   iters=3) if smoke else {})
+        out = bench_kernels(**kw)
     else:
         raise SystemExit(f"unknown section {name}")
     import jax
@@ -867,6 +1014,11 @@ SECTION_ENV = {
     "comm_quant_ps": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
     "comm_quant_dp": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
                       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    # hetukern cell (docs/KERNELS.md): the dense-vs-rows embed-grad A/B is
+    # a structural HBM/step-time claim, deterministic on CPU; the equality
+    # smoke drives interpret-mode Pallas, which the tunneled chip only
+    # slows down
+    "kernels": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
 }
 
 
@@ -1028,7 +1180,9 @@ class _Ledger:
                       "introspect_overhead_pct", "step_ms_off",
                       "step_ms_on", "bytes_wire_ratio", "auc_off",
                       "auc_int8", "auc_delta", "final_loss_off",
-                      "loss_delta_int8", "loss_delta_fp8"):
+                      "loss_delta_int8", "loss_delta_fp8",
+                      "dense_step_ms", "rows_step_ms", "speedup_rows",
+                      "equality_ok"):
                 if result.get(k) is not None:
                     rec[k] = result[k]
         try:
@@ -1194,7 +1348,8 @@ def main():
                      ("wdl_criteo_hybrid_ps", "wdl", 600),
                      ("comm_quant_ps_wdl", "comm_quant_ps", 600),
                      ("comm_quant_dp_mlp", "comm_quant_dp", 600),
-                     ("introspect_overhead", "introspect", 420)]
+                     ("introspect_overhead", "introspect", 420),
+                     ("kernels_tier", "kernels", 600)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent
     # with a cold compile that outlives a killed client server-side and

@@ -22,7 +22,8 @@ from .findings import (
 )
 from .abstract import AbstractGraph
 from .graph_passes import (
-    TIER_A_PASSES, structure_pass, shapes_pass, comm_pass, dce_pass,
+    TIER_A_PASSES, structure_pass, shapes_pass, comm_pass, comm_quant_pass,
+    kernels_pass, dce_pass,
 )
 from .analyzer import (
     AnalysisConfig, AnalysisContext, GraphAnalyzer, analyze_graph,
@@ -38,7 +39,8 @@ __all__ = [
     "Finding", "GraphValidationError", "ERROR", "WARN", "NOTE", "SEVERITIES",
     "suppress", "sort_findings", "count_by_severity", "format_findings",
     "AbstractGraph", "TIER_A_PASSES", "structure_pass", "shapes_pass",
-    "comm_pass", "dce_pass", "AnalysisConfig", "AnalysisContext",
+    "comm_pass", "comm_quant_pass", "kernels_pass", "dce_pass",
+    "AnalysisConfig", "AnalysisContext",
     "GraphAnalyzer", "analyze_graph", "record_graph", "analyze_executor",
     "recompile_findings", "donation_findings", "host_transfer_findings",
     "replicated_tensor_findings", "cost_analysis_of", "RecompileMonitor",

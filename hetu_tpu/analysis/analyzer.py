@@ -79,7 +79,7 @@ class AnalysisConfig:
 
     def __init__(self, comm_mode=None, mesh=None, dp_size=None,
                  dp_axis="dp", mp_axis="tp", compute_dtype=np.float32,
-                 gpipe=False, comm_quant_policy=None):
+                 gpipe=False, comm_quant_policy=None, kernels=None):
         self.comm_mode = comm_mode
         self.mesh = mesh
         self._dp_size = dp_size
@@ -90,6 +90,9 @@ class AnalysisConfig:
         # hetuq policy for the comm_quant lints (a comm_quant.QuantPolicy);
         # None = quantization off, the lints are skipped
         self.comm_quant_policy = comm_quant_policy
+        # hetukern mode for the kernels_pass lints ("off"|"auto"|"force");
+        # None = skip the pass (the hetulint CLI default)
+        self.kernels = kernels
 
     @property
     def dp_size(self) -> int:

@@ -53,9 +53,11 @@ def load_builder(spec: str):
     return fn
 
 
-def lint_target(spec: str, suppress=(), options=None):
+def lint_target(spec: str, suppress=(), options=None, kernels=None):
     """Build one target's graph (recording the op universe) and run Tier A.
-    Returns (findings, counts)."""
+    Returns (findings, counts). ``kernels`` overrides the builder's
+    hetukern mode so CI can ask "would kernels='force' fly on this
+    graph?" without editing the builder (docs/KERNELS.md)."""
     builder = load_builder(spec)
     with record_graph() as universe:
         result = builder()
@@ -64,6 +66,8 @@ def lint_target(spec: str, suppress=(), options=None):
     if isinstance(result, tuple) and len(result) == 2 \
             and isinstance(result[1], dict):
         graph, config_kwargs = result
+    if kernels is not None:
+        config_kwargs = dict(config_kwargs, kernels=kernels)
     config = AnalysisConfig(**config_kwargs)
     analyzer = GraphAnalyzer(
         graph, config=config, universe=universe, suppress=suppress,
@@ -85,6 +89,10 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on", choices=["error", "warn", "never"],
                     default="error",
                     help="lowest severity that fails the run (default error)")
+    ap.add_argument("--kernels", choices=["off", "auto", "force"],
+                    default=None,
+                    help="override the hetukern dispatch mode for the "
+                         "kernels_pass lints (docs/KERNELS.md)")
     args = ap.parse_args(argv)
 
     def target_ok(counts) -> bool:
@@ -101,7 +109,8 @@ def main(argv=None) -> int:
     load_failed = False
     for spec in args.targets:
         try:
-            findings, counts = lint_target(spec, suppress=args.suppress)
+            findings, counts = lint_target(spec, suppress=args.suppress,
+                                           kernels=args.kernels)
         except Exception as e:  # noqa: BLE001 — builder errors are exit 2
             # report on stderr, but keep the --json stdout contract: CI
             # parsers get a well-formed report carrying the partial results

@@ -16,6 +16,7 @@ comm       : ps-op-without-ps-mode(E) ps-push-ignored(W)
              pipeline-send-unconsumed(W) pipeline-recv-source(N)
              pipeline-stage-loop(W)
 comm_quant : comm-quant-forced-small(W) comm-quant-no-error-feedback(N)
+kernels    : kernels-force-ineligible(E) kernels-auto-fallback(N)
 dce        : dead-subgraph(W) common-subexpression(N)
 """
 from __future__ import annotations
@@ -173,6 +174,33 @@ def _is_fed(node) -> bool:
             or (node.is_placeholder and getattr(node, "is_feed", False)))
 
 
+def _embed_grad_push_wired(push, grad_in, ctx, consumers) -> bool:
+    """Mirror of the executor's rows-route rewire preconditions
+    (``_rewire_ps_gradients``): would this explicit embedding-grad push
+    actually be wired? The structural half (sole consumer, not an eval
+    target, ps_id present, dense mode) is the SHARED predicate
+    ``embed_grad_push_routable``; only the target-param resolution
+    differs — the PS runtime isn't available at lint time, so the param
+    resolves by name over the topo with the same sparse classification
+    the runtime applies."""
+    from ..graph.ops.embedding import embed_grad_push_routable
+    eval_ids = {id(n) for n in ctx.eval_nodes}
+    if not embed_grad_push_routable(push, grad_in, consumers, eval_ids):
+        return False
+    var = next((n for n in ctx.topo
+                if isinstance(n, PlaceholderOp) and n.trainable
+                and n.name == push.ps_id), None)
+    if var is None:
+        return False
+    sparse = (getattr(var, "is_embed", False)
+              or id(var) in getattr(ctx, "ps_embed_ids", ()))
+    if not sparse:
+        return False
+    shape = getattr(var, "shape", None)
+    return shape is None \
+        or tuple(shape) == tuple(getattr(grad_in, "embed_shape", ()))
+
+
 def comm_pass(ctx) -> list:
     """Comm-op placement: AllReduce vs DP context, PS ops vs comm_mode,
     dispatch pairing/rank, pipeline send/recv consistency."""
@@ -216,7 +244,20 @@ def comm_pass(ctx) -> list:
                     "the parameter forever", "comm"))
             if isinstance(node, ParameterServerCommunicateOp):
                 grad_in = node.inputs[0]
-                if not getattr(grad_in, "is_gradient", False):
+                # an explicit EmbeddingLookUpGradient push is a wired
+                # route since hetukern ONLY under the executor's rewire
+                # conditions (executor._rewire_ps_gradients): ps_id
+                # resolves to a sparse-classified param of matching shape,
+                # the grad op's sole consumer is this push, and it is not
+                # itself an eval target. Anything short of that is still
+                # silently dropped — keep warning.
+                is_embed_grad = (isinstance(grad_in, FunctionalOp)
+                                 and grad_in.opname
+                                 == "EmbeddingLookUpGradient"
+                                 and _embed_grad_push_wired(
+                                     node, grad_in, ctx, consumers))
+                if not getattr(grad_in, "is_gradient", False) \
+                        and not is_embed_grad:
                     out.append(Finding.at(
                         node, "ps-push-ignored", WARN,
                         f"push input {grad_in.name!r} is not a gradient "
@@ -357,6 +398,145 @@ def comm_quant_pass(ctx) -> list:
 
 
 # ---------------------------------------------------------------------------
+# hetukern (docs/KERNELS.md): kernel-tier dispatch lints
+# ---------------------------------------------------------------------------
+
+def kernels_pass(ctx) -> list:
+    """Kernel-tier placement lints. Under ``kernels="force"`` an
+    ineligible shape raises KernelEligibilityError at trace time deep in a
+    jit stack — this pass reports the same predicate at define time with
+    op-level provenance (``kernels-force-ineligible``, error). Under
+    ``auto``, a kernel whose dispatches mostly fell back (>50%) gets a
+    note: the tier is configured but not serving (shape misalignment is
+    the usual cause)."""
+    import jax
+
+    out = []
+    cfg = ctx.config
+    mode = getattr(cfg, "kernels", None) if cfg is not None else None
+    if mode in (None, "off"):
+        return out
+    from ..kernels import registry as kreg
+    ag = ctx.abstract
+
+    def struct(shape, dtype=np.float32):
+        return jax.ShapeDtypeStruct(tuple(int(s) for s in shape), dtype)
+
+    if mode == "force":
+        # force + a multi-device mesh can never be served: the executor
+        # scopes every trace spmd=True and each dispatch raises (HetuConfig
+        # rejects this combination at construction; surface the same
+        # verdict for AnalysisConfig-driven lints)
+        mesh = getattr(cfg, "mesh", None)
+        dp = getattr(cfg, "dp_size", 1)
+        if (mesh is not None and getattr(mesh, "size", 1) > 1) or dp > 1:
+            out.append(Finding.at(
+                next(iter(ctx.topo), None), "kernels-force-ineligible",
+                ERROR,
+                "kernels='force' on a multi-device (GSPMD) program: a "
+                "bare pallas_call has no SPMD partitioning rule, so every "
+                "kernel dispatch raises at trace time — use kernels="
+                "'auto' (docs/KERNELS.md)", "kernels"))
+            return out
+        for node in ctx.topo:
+            # fused embedding grad: flattened (n, dim) row gradients
+            if isinstance(node, FunctionalOp) \
+                    and node.opname == "EmbeddingLookUpGradient":
+                vshape = ag.shape_of(node.inputs[0])
+                if not vshape or len(vshape) < 2:
+                    continue
+                n = int(np.prod(vshape[:-1]))
+                # the prep casts grads to f32 unconditionally before the
+                # kernel (embed_grad._prep), so the lint mirrors that —
+                # dtype can never disqualify this call at runtime
+                sv = struct((n, int(vshape[-1])))
+                seg = struct((n,), np.int32)
+                ok, why = kreg.eligibility_of("fused_embed_grad", sv, seg)
+                if not ok:
+                    out.append(Finding.at(
+                        node, "kernels-force-ineligible", ERROR,
+                        f"kernels='force' but the fused_embed_grad kernel "
+                        f"cannot take this call: {why}", "kernels"))
+            # CSR spmm/spmv: route through the REAL eligibility predicate
+            # so the lint cannot drift from the kernel's rules. The dense
+            # operand shape/dtype and nrow (the op's output rows) are
+            # static; nnz is runtime-fed — a block-aligned stand-in (the
+            # predicate does not read it)
+            if isinstance(node, FunctionalOp) \
+                    and node.opname in ("CSRMatMat", "CSRMatVec") \
+                    and len(node.inputs) > 1:
+                bshape = ag.shape_of(node.inputs[1])
+                bdt = ag.dtype_of(node.inputs[1]) or np.float32
+                oshape = ag.shape_of(node)
+                if bshape and oshape:
+                    kern = ("csr_spmm" if node.opname == "CSRMatMat"
+                            else "csr_spmv")
+                    nnz = struct((256,), np.int32)
+                    if kern == "csr_spmm" and len(bshape) == 2:
+                        # trans_B transposes the operand before the kernel
+                        # sees it — derive the EFFECTIVE (K, F) from the
+                        # op's output (F = oshape[-1]); a square operand
+                        # is orientation-agnostic anyway
+                        f_eff = int(oshape[-1])
+                        k_eff = (int(bshape[0]) if int(bshape[1]) == f_eff
+                                 else int(bshape[1]))
+                        b_eff = struct((k_eff, f_eff), bdt)
+                    else:
+                        b_eff = struct(bshape, bdt)
+                    ok, why = kreg.eligibility_of(
+                        kern, struct((256,)), nnz, nnz, b_eff,
+                        nrow=int(oshape[0]))
+                    if not ok:
+                        out.append(Finding.at(
+                            node, "kernels-force-ineligible", ERROR,
+                            f"kernels='force' but the {kern} kernel "
+                            f"cannot take this call: {why}", "kernels"))
+            # fused optimizer apply: every locally-applied trainable param
+            if node.is_optimizer:
+                opt_name = type(node.optimizer).__name__
+                kern = {"AdamOptimizer": "fused_adam",
+                        "AdamWOptimizer": "fused_adam",
+                        "SGDOptimizer": "fused_sgd"}.get(opt_name)
+                if kern is None:
+                    continue
+                for var in node.vars:
+                    shape = ag.shape_of(var) or getattr(var, "shape", None)
+                    if not shape:
+                        continue
+                    p = struct(shape, getattr(var, "dtype", np.float32))
+                    args = ((p, p, p, p, struct((), np.float32), 0.01)
+                            if kern == "fused_adam" else (p, p, 0.01))
+                    ok, why = kreg.eligibility_of(kern, *args)
+                    if not ok:
+                        out.append(Finding.at(
+                            node, "kernels-force-ineligible", ERROR,
+                            f"kernels='force' but {kern} cannot apply "
+                            f"{var.name!r}: {why}", "kernels"))
+    # fallback-ratio note: only meaningful on a TPU backend — off-TPU,
+    # auto-mode fallback is the DESIGN (interpret-mode Pallas would be
+    # slower), so noting it would spam every CPU test run
+    if mode == "auto" and kreg._on_tpu():
+        stats = kreg.dispatch_stats()
+        kernels = {k for k, _path in stats}
+        anchor = next(iter(ctx.topo), None)
+        for k in sorted(kernels):
+            ratio = kreg.fallback_ratio(k)
+            total = (stats.get((k, "pallas"), 0)
+                     + stats.get((k, "fallback"), 0))
+            if ratio is not None and ratio > 0.5 and total >= 2:
+                out.append(Finding.at(
+                    anchor, "kernels-auto-fallback", NOTE,
+                    f"kernel {k!r}: {ratio:.0%} of {total} auto-mode "
+                    "dispatches fell back to XLA — the tier is configured "
+                    "but mostly not serving (ineligible shapes or "
+                    "partitioned programs). PROCESS-WIDE tallies: every "
+                    "executor/trace in this process contributes, not just "
+                    "the analyzed graph; hetuprof's dispatch counter shows "
+                    "which call sites", "kernels"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # dead subgraphs + common subexpressions
 # ---------------------------------------------------------------------------
 
@@ -415,4 +595,4 @@ def _has_closure_params(node) -> bool:
 
 
 TIER_A_PASSES = (structure_pass, shapes_pass, comm_pass, comm_quant_pass,
-                 dce_pass)
+                 kernels_pass, dce_pass)

@@ -213,13 +213,19 @@ def quantized_allreduce(x, residual, mesh, dp_axis: str, out_sharding,
     # quantizer)
     flat = jax.lax.with_sharding_constraint(
         flat, NamedSharding(mesh, P(dp_axis)))
-    q, scales, n = quantize_blocks(flat, policy.block, policy.mode)
+    # hetukern quant-fused legs (docs/KERNELS.md): the quantize fused into
+    # the reduce-scatter output and the dequantize into the all-gather
+    # output each become ONE Pallas pass over the shard when the kernel
+    # tier is active — bit-identical wire payloads to this module's jnp
+    # path (asserted in tests/test_kernels.py), so mixed fleets agree
+    from .kernels import quant_comm as _qk
+    q, scales, n = _qk.quantize_blocks(flat, policy.block, policy.mode)
     # all-gather point: the wire payload here is the 1-byte-per-element
     # compressed tensor plus one f32 scale per block
     q = jax.lax.with_sharding_constraint(q, NamedSharding(mesh, P()))
     scales = jax.lax.with_sharding_constraint(
         scales, NamedSharding(mesh, P()))
-    dq = dequantize_blocks(q, scales, n, policy.block)
+    dq = _qk.dequantize_blocks(q, scales, n, policy.block)
     new_residual = None
     if residual is not None:
         new_residual = (g.reshape(-1) - dq).reshape(x.shape)

@@ -102,7 +102,7 @@ class HetuConfig:
                  telemetry=None, introspect=None, comm_quant=None,
                  comm_quant_block=None, comm_quant_min_size=None,
                  comm_quant_error_feedback=None, comm_quant_force=(),
-                 **kwargs):
+                 kernels=None, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -159,6 +159,16 @@ class HetuConfig:
             comm_quant, comm_quant_block, comm_quant_min_size,
             comm_quant_error_feedback, comm_quant_force)
         self.comm_quant = self.comm_quant_policy.mode
+        # hetukern (docs/KERNELS.md): Pallas kernel tier dispatch mode.
+        # "off" = every call site serves its pre-hetukern XLA expression,
+        # bit-identical; "auto" (default) = eligible shapes take the Pallas
+        # kernel on real TPU backends and fall back per-shape elsewhere —
+        # off-TPU auto IS the pre-hetukern path; "force" = kernels
+        # everywhere (interpret mode off-TPU), ineligible shapes raise.
+        # Env default: HETU_KERNELS. The executor scopes this mode around
+        # every trace/lower so interleaved executors never leak settings.
+        from ..kernels.registry import resolve_mode as _kresolve
+        self.kernels = _kresolve(kernels)
         if self.comm_quant != "off" and gpipe:
             raise ValueError(
                 "comm_quant is not supported with gpipe=True: the pipeline "
@@ -188,6 +198,14 @@ class HetuConfig:
                 "parallel subgraph in a tuple DeviceGroup context (e.g. "
                 "ctx=[(tpu(0), tpu(1)), (tpu(2), tpu(3))] for 2 workers x "
                 f"2-way TP) or pass mesh= with a {self.mp_axis!r} axis")
+        if self.kernels == "force" and self.mesh is not None \
+                and self.mesh.size > 1:
+            raise ValueError(
+                "kernels='force' cannot serve a multi-device (GSPMD) "
+                "program: a bare pallas_call has no SPMD partitioning "
+                "rule, so every kernel would raise at trace time. Use "
+                "kernels='auto' (partitioned programs keep their XLA "
+                "fallbacks) — docs/KERNELS.md")
         self.device = self._deduce_device()
 
     # -- device & mesh deduction -------------------------------------------
@@ -356,6 +374,12 @@ class TraceContext:
                 return g.astype(jnp.float32)  # PS stores/accumulates f32
             return g
 
+        from .ops.embedding import IndexedRows
+        if isinstance(grad, IndexedRows):
+            # hetukern rows-mode embedding grad: ids stay int, values f32
+            self.ps_grad_outputs[id(op)] = IndexedRows(grad.rows,
+                                                       f32(grad.grads))
+            return None
         # a shared-table gradient arrives as a tuple of per-lookup row grads
         self.ps_grad_outputs[id(op)] = (
             tuple(f32(g) for g in grad) if isinstance(grad, tuple) else f32(grad))
@@ -835,6 +859,15 @@ class SubExecutor:
                   and os.environ.get("HETU_NO_DONATE") != "1" else ())
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def _kern_spmd(self) -> bool:
+        """Is this subexecutor's program a GSPMD multi-device program? A
+        bare pallas_call inside one has no SPMD partitioning rule, so the
+        kernel tier's eligibility declines under this scope
+        (registry.in_spmd_scope; per-shard shard_map wrapping is the
+        documented follow-up in docs/KERNELS.md)."""
+        mesh = self.config.mesh
+        return mesh is not None and mesh.size > 1
+
     def profile_summary(self):
         """Per-step host-phase breakdown (HETU_PROFILE=1), or None.
 
@@ -1031,7 +1064,9 @@ class SubExecutor:
                 np.bool_(inject_nan),
                 tuple(ex.state["qresid"][id(n)] for n in self.qresid_nodes))
         from ..telemetry import scope as _scope
-        *_rest, stats_t = fn(*args)
+        from ..kernels import registry as _kreg
+        with _kreg.active(self.config.kernels, spmd=self._kern_spmd()):
+            *_rest, stats_t = fn(*args)
         order, inputs_map, spec = self._scope_meta
         stats = _scope.host_stats(spec, stats_t)
         return _scope.find_culprit(order, inputs_map, stats, step)
@@ -1041,7 +1076,9 @@ class SubExecutor:
         if self._last_call is None:
             return None
         fn, args = self._last_call
-        return fn.lower(*args)
+        from ..kernels import registry as _kreg
+        with _kreg.active(self.config.kernels, spmd=self._kern_spmd()):
+            return fn.lower(*args)
 
     def _executable(self):
         """Compiled executable of the latest executed step, cached per
@@ -1054,7 +1091,9 @@ class SubExecutor:
         fn, args = self._last_call
         exe = self._exe_cache.get(id(fn))
         if exe is None:
-            exe = fn.lower(*args).compile()
+            from ..kernels import registry as _kreg
+            with _kreg.active(self.config.kernels, spmd=self._kern_spmd()):
+                exe = fn.lower(*args).compile()
             self._exe_cache[id(fn)] = exe
         return exe
 
@@ -1259,15 +1298,22 @@ class SubExecutor:
             # bounded jax.profiler window around the configured steps
             tel.xla_window.on_step(step)
         t_d0 = time.perf_counter() if timed else 0.0
+        # hetukern: scope the kernel dispatch mode around the call — jit
+        # traces lazily, so the trace (where dispatch decisions live) runs
+        # under this scope; on cache-hit steps the context is a ~µs no-op
+        from ..kernels import registry as _kreg
         if tel is not None and tel.tracer is not None:
             # named step regions in the device timeline when a jax profiler
             # trace is active (the XLA window above, or an external capture)
-            with _XW.step_annotation(step):
+            with _XW.step_annotation(step), \
+                    _kreg.active(self.config.kernels,
+                                 spmd=self._kern_spmd()):
                 outputs, new_params, new_slots, new_opstate, ps_grads, \
                     qresid_out, finite_t, scope_stats_t = fn(*args)
         else:
-            outputs, new_params, new_slots, new_opstate, ps_grads, \
-                qresid_out, finite_t, scope_stats_t = fn(*args)
+            with _kreg.active(self.config.kernels, spmd=self._kern_spmd()):
+                outputs, new_params, new_slots, new_opstate, ps_grads, \
+                    qresid_out, finite_t, scope_stats_t = fn(*args)
         t_d1 = time.perf_counter() if timed else 0.0
         if prof is not None:
             prof["dispatch_s"] += t_d1 - t_d0
@@ -1526,6 +1572,23 @@ class Executor:
                 node.insert_comm_ops(config)
         full_topo = find_topo_sort(all_nodes)
 
+        # hetukern rows-mode reset: graph nodes are shared between
+        # executors (the comm_quant re-assert idiom) — a grad op a
+        # PREVIOUS executor flipped to rows mode must come back dense
+        # BEFORE lint runs and before this build's own PS wiring
+        # re-flips eligible ops; likewise a push op's ps_param_node /
+        # staged_lookups from a previous wiring must not survive into a
+        # build whose conditions no longer hold (a stale ps_param_node
+        # would enroll the push in ps_comm_ops with a dense grad and no
+        # indices).
+        from .ops.ps import ParameterServerCommunicateOp as _PSPush
+        for node in full_topo:
+            if getattr(node, "rows_mode", False):
+                node.to_dense()
+            if isinstance(node, _PSPush):
+                node.ps_param_node = None
+                node.staged_lookups = None
+
         # -- define-time validation (hetulint Tier A, docs/ANALYSIS.md) -----
         # Runs over the post-comm-insertion graph — the graph that will
         # actually trace — and BEFORE any PS server spawns or parameter
@@ -1707,11 +1770,36 @@ class Executor:
         the table variable, so the traced grad is (batch_rows, width) instead
         of a full-table scatter (the reference's IndexedSlices analogue)."""
         loss_topo_ids: dict[int, set] = {}  # per-loss memo for this pass
+        ps_by_name = {p.node.name: p for p in self.ps_runtime.params.values()}
+        consumers: dict[int, list] = {}
+        for n in topo:
+            for i in n.inputs:
+                consumers.setdefault(id(i), []).append(n)
+        eval_ids = {id(n) for ns in self.eval_node_dict.values() for n in ns}
         for node in topo:
             if not isinstance(node, ParameterServerCommunicateOp):
                 continue
             grad_node = node.inputs[0]
             if not getattr(grad_node, "is_gradient", False):
+                # hetukern satellite (docs/KERNELS.md): an explicit
+                # embedding_lookup_gradient_op whose ONLY consumer is this
+                # PS push flips into ROWS mode — the rows leave the device
+                # anyway, so the (vocab, dim) zeros-table scatter the dense
+                # form pays is pure waste on this route. The runtime trims
+                # the sentinel tail and pushes (rows, grads) directly.
+                # Another consumer (or the op itself as an eval target)
+                # needs the dense table shape, so the op stays dense then.
+                # Structural preconditions shared with hetulint's
+                # ps-push-ignored mirror (embed_grad_push_routable) so the
+                # lint and this rewire cannot drift.
+                from .ops.embedding import embed_grad_push_routable
+                if embed_grad_push_routable(node, grad_node, consumers,
+                                            eval_ids) \
+                        and node.ps_id in ps_by_name:
+                    p = ps_by_name[node.ps_id]
+                    if p.sparse and tuple(grad_node.embed_shape) == p.shape:
+                        grad_node.to_rows()
+                        node.ps_param_node = p.node
                 continue
             var = grad_node.x
             p = self.ps_runtime.params.get(id(var))

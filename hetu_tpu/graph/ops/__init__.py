@@ -37,7 +37,9 @@ from .losses import (
     softmaxcrossentropy_op, softmaxcrossentropy_gradient_op,
     binarycrossentropy_op, binarycrossentropy_gradient_op,
 )
-from .embedding import embedding_lookup_op, embedding_lookup_gradient_op
+from .embedding import (
+    embedding_lookup_op, embedding_lookup_gradient_op, IndexedRows,
+)
 from .comm import (
     allreduceCommunicate_op, groupallreduceCommunicate_op,
     datah2d_op, datad2h_op,

@@ -6,12 +6,53 @@ Replaces the reference's EmbeddingLookup gather kernel
 scatter-add, which XLA sorts/segments efficiently. When the embedding variable
 is PS-hosted (comm_mode PS/Hybrid), the executor routes lookups through the
 parameter-server client instead (see ops/ps.py).
+
+hetukern (docs/KERNELS.md): ``embedding_lookup_gradient_op`` dispatches
+through the kernel tier. With kernels active on TPU (or forced), the dense
+table gradient is reconstructed from the fused sort/unique + segment-sum
+kernel's compact ``(rows, grads)`` form — one unique-row scatter instead of
+one scatter per occurrence; with ``kernels="off"`` (or auto off-TPU) it is
+the pre-hetukern full-table scatter, bit for bit. When the consumer is a PS
+gradient push the executor flips the op into ROWS mode (:meth:`to_rows`):
+the traced output becomes an :class:`IndexedRows` pair and the ``(vocab,
+dim)`` zeros table is never materialized — the rows leave the device anyway.
 """
 from __future__ import annotations
+
+from typing import Any, NamedTuple
 
 import jax.numpy as jnp
 
 from ..node import FunctionalOp
+
+
+class IndexedRows(NamedTuple):
+    """IndexedSlices-style sparse gradient: ``rows`` (n,) int32 unique row
+    ids padded with the vocab-size sentinel, ``grads`` (n, dim) per-row
+    sums (zeros past the valid prefix). The PS runtime trims the sentinel
+    tail before the wire."""
+
+    rows: Any
+    grads: Any
+
+
+def embed_grad_push_routable(push, grad_op, consumers, eval_ids) -> bool:
+    """The STRUCTURAL half of the rows-route preconditions, shared by the
+    executor's rewire (``_rewire_ps_gradients``) and hetulint's
+    ``ps-push-ignored`` mirror so the two cannot drift: the grad op is in
+    dense mode, its sole consumer is this push, and it is not itself an
+    eval target. Each caller still resolves the target parameter its own
+    way (live PS runtime vs static name match) and checks sparse/shape.
+
+    ``consumers``: ``{id(node): [consumer, ...]}`` over the caller's
+    topo; ``eval_ids``: ids of the eval targets."""
+    if getattr(grad_op, "rows_mode", None) is not False:
+        return False
+    if getattr(push, "ps_id", None) is None:
+        return False
+    if any(c is not push for c in consumers.get(id(grad_op), ())):
+        return False
+    return id(grad_op) not in eval_ids
 
 
 def embedding_lookup_op(embedding, index, ctx=None):
@@ -24,13 +65,53 @@ def embedding_lookup_op(embedding, index, ctx=None):
 
 
 def embedding_lookup_gradient_op(vectors, index, embed_shape, ctx=None):
-    """Dense scatter-add of lookup grads into a zeros table (the reference
-    returns IndexedSlices; on TPU a fused scatter-add is preferred)."""
+    """Table-shaped scatter-add of lookup grads (the reference returns
+    IndexedSlices; a dense consumer needs table shape either way). The
+    executor may switch the op to the compact rows form via
+    :meth:`to_rows` when the value only feeds a PS push."""
     shape = tuple(int(s) for s in embed_shape)
 
-    def _grad(vec, idx):
-        flat_idx = idx.astype(jnp.int32).reshape(-1)
-        flat_vec = vec.reshape((-1, shape[-1]))
-        return jnp.zeros(shape, vec.dtype).at[flat_idx].add(flat_vec)
+    def _grad_dense(vec, idx):
+        from ...kernels import embed_grad, registry
+        mode = registry.current_mode()
+        # rows restructure only where the kernel will actually serve:
+        # force takes it unconditionally (an ineligible shape raises, the
+        # force contract); auto-on-TPU consults eligibility FIRST so an
+        # ineligible shape keeps the pre-tier one-scatter expression
+        # instead of paying sort + fallback-segment-sum + scatter
+        if mode == "force" or (mode == "auto" and registry._on_tpu()
+                               and embed_grad.rows_path_eligible(vec, idx)):
+            return embed_grad.embed_grad_dense(vec, idx, shape)
+        # pre-hetukern expression — bit-identical off/fallback path.
+        # Tick the dispatch stat here too: this branch IS this kernel's
+        # off/fallback route for dense consumers, and the fallback-ratio
+        # lint + hetutop panel must see it
+        registry._count("fused_embed_grad",
+                        "off" if mode == "off" else "fallback")
+        return embed_grad.embed_grad_dense_xla(vec, idx, shape)
 
-    return FunctionalOp("EmbeddingLookUpGradient", _grad, [vectors, index], ctx)
+    def _grad_rows(vec, idx):
+        from ...kernels import embed_grad
+        rows, grads, _count = embed_grad.embed_grad_rows(vec, idx, shape[0])
+        return IndexedRows(rows, grads)
+
+    op = FunctionalOp("EmbeddingLookUpGradient", _grad_dense,
+                      [vectors, index], ctx)
+    op.embed_shape = shape
+    op.rows_mode = False
+    op._dense_fn = _grad_dense
+    op._rows_fn = _grad_rows
+
+    def to_rows():
+        op.fn = op._rows_fn
+        op.rows_mode = True
+        return op
+
+    def to_dense():
+        op.fn = op._dense_fn
+        op.rows_mode = False
+        return op
+
+    op.to_rows = to_rows
+    op.to_dense = to_dense
+    return op

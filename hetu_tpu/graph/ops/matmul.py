@@ -9,7 +9,6 @@ where true sparse units don't exist.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from ..node import FunctionalOp, Op
@@ -50,6 +49,10 @@ def matrix_dot_op(node_A, node_B, axes=0, ctx=None):
 # ---------------------------------------------------------------------------
 # CSR sparse products. The sparse operand is fed as a ``ND_Sparse_Array``
 # (COO rows/cols + values); at trace time it arrives as three arrays.
+# hetukern (docs/KERNELS.md): both products route through the ``csr_spmm``
+# kernel-registry entry — the blocked rows-into-VMEM segment-MAC kernel on
+# TPU (or forced), the gather + segment_sum expression below otherwise
+# (``kernels="off"`` serves it verbatim, bit-identical to pre-hetukern).
 # ---------------------------------------------------------------------------
 
 class SparseInputOp(Op):
@@ -65,13 +68,13 @@ class SparseInputOp(Op):
 
 
 def _coo_matvec(values, rows, cols, nrow, x):
-    contrib = values * jnp.take(x, cols, axis=0)
-    return jax.ops.segment_sum(contrib, rows, num_segments=nrow)
+    from ...kernels import csr_spmm
+    return csr_spmm.coo_matvec(values, rows, cols, nrow, x)
 
 
 def _coo_matmat(values, rows, cols, nrow, B):
-    contrib = values[:, None] * jnp.take(B, cols, axis=0)
-    return jax.ops.segment_sum(contrib, rows, num_segments=nrow)
+    from ...kernels import csr_spmm
+    return csr_spmm.coo_matmat(values, rows, cols, nrow, B)
 
 
 def csrmv_op(node_A, node_B, trans=False, ctx=None):

@@ -1,0 +1,29 @@
+"""hetukern: the Pallas kernel tier (docs/KERNELS.md).
+
+Layout:
+
+- :mod:`registry` — the dispatch gate every kernel call goes through
+  (``HetuConfig(kernels="off"|"auto"|"force")`` / ``HETU_KERNELS``,
+  per-call eligibility, ``hetu_kernel_dispatch_total{kernel,path}``).
+- :mod:`embed_grad` — fused sparse embedding gradient: sort/unique +
+  segment-sum into IndexedSlices-style ``(rows, grads)``.
+- :mod:`csr_spmm` — blocked rows-into-VMEM segment-MAC for the
+  CSR/COO sparse products (csrmm/csrmv, DistGCN 1.5D).
+- :mod:`quant_comm` — one-pass blockwise quantize/dequantize fused into
+  the hetuq AllReduce legs (bit-identical wire payloads).
+- :mod:`fused_opt` — multi-tensor Adam/SGD apply in one VMEM pass.
+- :mod:`flash_attention` / :mod:`fused_ce` — the two pre-tier kernels
+  (their ``should_fuse``-style gating predates the registry and is
+  documented in docs/KERNELS.md).
+
+Importing this package registers the four tier kernels; the graph ops
+import it lazily inside their compute fns so jax-free tools never pay
+for it.
+"""
+from . import registry                            # noqa: F401
+from .registry import (                           # noqa: F401
+    KernelEligibilityError, KernelSpec, active, current_mode, dispatch,
+    dispatch_stats, eligibility_of, fallback_ratio, register_kernel,
+    registered_kernels, reset_stats, resolve_mode,
+)
+from . import embed_grad, csr_spmm, quant_comm, fused_opt  # noqa: F401

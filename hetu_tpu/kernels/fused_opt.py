@@ -1,0 +1,211 @@
+"""Fused optimizer step kernels: one VMEM pass over (grad, m, v, param)
+(docs/KERNELS.md).
+
+The reference applies sparse/dense updates with hand-fused CUDA kernels
+(``src/ops/Optimizers.cu`` / ``OptimizersSparse.cu``); under XLA the
+update rule is a chain of elementwise HLOs that the fusion pass USUALLY
+melts into the gradient epilogue — but for the large-parameter ZeRO-ish
+step the measured behavior (hetuprof roofline: optimizer families sit on
+the HBM roof) is several full passes over param-sized tensors. The Adam
+kernel here reads grad + m + v + param once each and writes the three
+outputs in the same pass — arithmetic intensity goes from ~1 flop/byte
+per HLO to the full rule per element loaded.
+
+Numerical contract: the kernel body is the SAME expression sequence as
+``Optimizer.apply_dense`` (bias-corrected Adam, SGD with fused l2), so
+off/auto/force agree to f32 rounding; the equality tests pin it.
+
+Layout: parameters arrive in their natural shapes; the kernel views them
+as lane-shaped ``(rows, 128)`` blocks, zero-padded up to the 8x128 f32
+tile and sliced back — elementwise kernels can always be tiled by
+padding, so only dtype (f32 master precision) disqualifies a call, and
+the whole parameter set of a real model (odd biases included) rides the
+fused pass. An optional extra addend (e.g. a decoded error-feedback
+residual folded into the grad) rides the same pass.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import registry
+
+_LANE = registry.LANE
+_SUBLANE = registry.SUBLANE
+_TILE = _LANE * _SUBLANE
+
+
+def _lane_view(x):
+    """Flat lane-shaped view, zero-padded up to the 8x128 f32 tile —
+    elementwise kernels can always be tiled by padding (the pad rows are
+    computed and sliced away; XLA fuses the pad/slice into the call's
+    edges), unlike the gather/matmul kernels whose alignment is load-
+    bearing. Returns (view, n_elements)."""
+    n = x.size
+    pad = (-n) % _TILE
+    flat = x.reshape(-1)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, _LANE), n
+
+
+def _unview(view, n, shape):
+    return view.reshape(-1)[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Adam (bias-corrected; optional decoupled weight decay)
+# ---------------------------------------------------------------------------
+
+def _adam_xla(param, grad, m, v, t, lr, *, beta1, beta2, eps, weight_decay):
+    """The Optimizer.apply_dense expression sequence, verbatim."""
+    t = t + 1.0
+    m = beta1 * m + (1.0 - beta1) * grad
+    v = beta2 * v + (1.0 - beta2) * grad * grad
+    m_hat = m / (1.0 - beta1 ** t)
+    v_hat = v / (1.0 - beta2 ** t)
+    new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    if weight_decay > 0:
+        new_param = new_param - lr * weight_decay * param
+    return new_param, m, v, t
+
+
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, t_ref, lr_ref,
+                 po_ref, mo_ref, vo_ref, *, beta1, beta2, eps, weight_decay):
+    t = t_ref[0, 0] + 1.0
+    lr = lr_ref[0, 0]
+    g = g_ref[:]
+    p = p_ref[:]
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    m_hat = m / (1.0 - beta1 ** t)
+    v_hat = v / (1.0 - beta2 ** t)
+    new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    if weight_decay > 0:
+        new_p = new_p - lr * weight_decay * p
+    po_ref[:] = new_p
+    mo_ref[:] = m
+    vo_ref[:] = v
+
+
+def _adam_pallas(param, grad, m, v, t, lr, *, beta1, beta2, eps,
+                 weight_decay):
+    shape = param.shape
+    (pv, n), (gv, _), (mv, _), (vv, _) = (
+        _lane_view(x) for x in (param, grad, m, v))
+    t_in = jnp.asarray(t, jnp.float32).reshape(1, 1)
+    lr_in = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    vec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    sca = pl.BlockSpec(memory_space=pltpu.SMEM)
+    new_p, new_m, new_v = pl.pallas_call(
+        functools.partial(_adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
+                          weight_decay=weight_decay),
+        in_specs=[vec, vec, vec, vec, sca, sca],
+        out_specs=[vec, vec, vec],
+        out_shape=[jax.ShapeDtypeStruct(pv.shape, jnp.float32)] * 3,
+        interpret=not registry._on_tpu(),
+    )(pv, gv, mv, vv, t_in, lr_in)
+    return (_unview(new_p, n, shape), _unview(new_m, n, shape),
+            _unview(new_v, n, shape), jnp.asarray(t, jnp.float32) + 1.0)
+
+
+def _sized_f32(name, x):
+    """Elementwise kernels pad to the tile internally, so alignment is
+    never disqualifying — only dtype (f32 master precision) and emptiness
+    are."""
+    if jnp.dtype(x.dtype) != jnp.dtype(jnp.float32):
+        return False, f"{name} must be f32 (master precision), got {x.dtype}"
+    n = 1
+    for s in x.shape:
+        n *= int(s)
+    if n == 0:
+        return False, f"{name} is empty"
+    return True, None
+
+
+def _adam_eligible(param, grad, m, v, t, lr, **_kw):
+    for name, x in (("param", param), ("grad", grad), ("m", m), ("v", v)):
+        ok, why = _sized_f32(name, x)
+        if not ok:
+            return ok, why
+    return True, None
+
+
+registry.register_kernel(
+    "fused_adam",
+    pallas_fn=_adam_pallas,
+    xla_fallback=_adam_xla,
+    eligibility=_adam_eligible,
+)
+
+
+# ---------------------------------------------------------------------------
+# SGD (l2 folded into the same pass)
+# ---------------------------------------------------------------------------
+
+def _sgd_xla(param, grad, lr, *, l2reg):
+    if l2reg > 0:
+        grad = grad + l2reg * param
+    return param - lr * grad
+
+
+def _sgd_kernel(p_ref, g_ref, lr_ref, o_ref, *, l2reg):
+    g = g_ref[:]
+    p = p_ref[:]
+    if l2reg > 0:
+        g = g + l2reg * p
+    o_ref[:] = p - lr_ref[0, 0] * g
+
+
+def _sgd_pallas(param, grad, lr, *, l2reg):
+    shape = param.shape
+    pv, n = _lane_view(param)
+    gv, _ = _lane_view(grad)
+    lr_in = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    vec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sgd_kernel, l2reg=l2reg),
+        in_specs=[vec, vec, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=vec,
+        out_shape=jax.ShapeDtypeStruct(pv.shape, jnp.float32),
+        interpret=not registry._on_tpu(),
+    )(pv, gv, lr_in)
+    return _unview(out, n, shape)
+
+
+def _sgd_eligible(param, grad, lr, **_kw):
+    for name, x in (("param", param), ("grad", grad)):
+        ok, why = _sized_f32(name, x)
+        if not ok:
+            return ok, why
+    return True, None
+
+
+registry.register_kernel(
+    "fused_sgd",
+    pallas_fn=_sgd_pallas,
+    xla_fallback=_sgd_xla,
+    eligibility=_sgd_eligible,
+)
+
+
+# ---------------------------------------------------------------------------
+# optimizer.py entry points
+# ---------------------------------------------------------------------------
+
+def adam_step(opt, param, grad, slot, lr):
+    """Registry-dispatched Adam apply for one parameter. ``opt`` is the
+    AdamOptimizer (hyperparameters are trace-time constants)."""
+    new_p, m, v, t = registry.dispatch(
+        "fused_adam", param, grad, slot["m"], slot["v"], slot["t"], lr,
+        beta1=opt.beta1, beta2=opt.beta2, eps=opt.epsilon,
+        weight_decay=opt.weight_decay)
+    return new_p, {"m": m, "v": v, "t": t}
+
+
+def sgd_step(opt, param, grad, lr):
+    return registry.dispatch("fused_sgd", param, grad, lr, l2reg=opt.l2reg)

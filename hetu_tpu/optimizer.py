@@ -90,8 +90,12 @@ class SGDOptimizer(Optimizer):
         super().__init__(learning_rate, l2reg, clip_grad_norm)
 
     def apply_dense(self, param, grad, slot, lr):
-        grad = self._regularized(param, grad)
-        return param - lr * grad, slot
+        # hetukern (docs/KERNELS.md): one registry dispatch in EVERY mode
+        # — "off" serves fused_opt._sgd_xla, which is the pre-hetukern
+        # expression (incl. the l2 fold) verbatim, so the update rule has
+        # exactly one copy and off stays bit-identical
+        from .kernels import fused_opt
+        return fused_opt.sgd_step(self, param, grad, lr), slot
 
 
 class MomentumOptimizer(Optimizer):
@@ -147,15 +151,12 @@ class AdamOptimizer(Optimizer):
 
     def apply_dense(self, param, grad, slot, lr):
         grad = self._regularized(param, grad)
-        t = slot["t"] + 1.0
-        m = self.beta1 * slot["m"] + (1.0 - self.beta1) * grad
-        v = self.beta2 * slot["v"] + (1.0 - self.beta2) * grad * grad
-        m_hat = m / (1.0 - self.beta1 ** t)
-        v_hat = v / (1.0 - self.beta2 ** t)
-        new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
-        if self.weight_decay > 0:
-            new_param = new_param - lr * self.weight_decay * param
-        return new_param, {"m": m, "v": v, "t": t}
+        # hetukern (docs/KERNELS.md): one registry dispatch in EVERY mode
+        # — "off" serves fused_opt._adam_xla, the bias-corrected rule as
+        # ONE copy (previously duplicated here); the kernel path is the
+        # same expression sequence in one VMEM pass
+        from .kernels import fused_opt
+        return fused_opt.adam_step(self, param, grad, slot, lr)
 
 
 class AdamWOptimizer(AdamOptimizer):

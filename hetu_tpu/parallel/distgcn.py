@@ -81,11 +81,15 @@ def spmm_15d(mesh: Mesh, adj_parts, h, n_nodes: int,
     nr = n_nodes // gr
 
     def local(vals, lrows, lcols, h_local):
+        from ..kernels import csr_spmm
         vals, lrows, lcols = vals[0, 0], lrows[0, 0], lcols[0, 0]
         # the column-group broadcast stages: one tiled all_gather over gr
         h_slice = jax.lax.all_gather(h_local, gr_axis, axis=0, tiled=True)
-        contrib = vals[:, None] * h_slice[lcols]
-        z = jax.ops.segment_sum(contrib, lrows, num_segments=nr)
+        # hetukern csr_spmm (docs/KERNELS.md): the local block product goes
+        # through the kernel registry — inside this shard_map the named-axis
+        # eligibility guard keeps auto mode on the gather+segment_sum
+        # fallback (identical to the pre-hetukern expression)
+        z = csr_spmm.coo_matmat(vals, lrows, lcols, nr, h_slice)
         # the row-group allreduce over the contraction split
         return jax.lax.psum(z, gc_axis)
 

@@ -426,6 +426,31 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
             line += (f"  NONFINITE: {', '.join(nonfin[:4])}" if nonfin
                      else "  nonfinite ops: 0")
             lines.append(line)
+    # hetukern dispatch panel (docs/KERNELS.md): per-kernel pallas vs
+    # fallback vs off tallies from hetu_kernel_dispatch_total — which tier
+    # served each op family in the programs now compiled. Absent (no line)
+    # when nothing ever dispatched (kernel tier untouched).
+    kern: dict = {}
+    for rk in state["ranks"].values():
+        for child, v in _metric_children(
+                rk["metrics"], "hetu_kernel_dispatch_total", ""):
+            if not child:
+                continue
+            labels = dict(p.split("=", 1) for p in child.split(",")
+                          if "=" in p)
+            name = labels.get("kernel")
+            path = labels.get("path")
+            if name and path:
+                ent = kern.setdefault(name, {})
+                ent[path] = ent.get(path, 0) + (_defloat(v) or 0)
+    if kern:
+        parts = []
+        for name in sorted(kern):
+            ent = kern[name]
+            parts.append(name + " " + "/".join(
+                f"{p}:{int(ent[p])}" for p in ("pallas", "forced", "fallback", "off")
+                if p in ent))
+        lines.append("kernels: " + "  ".join(parts))
     # hetu-elastic membership (docs/FAULT_TOLERANCE.md): current world
     # version, live workers/servers, last resize cost — fed by the
     # ElasticAgent's gauges; absent (no line) for non-elastic runs

@@ -633,6 +633,18 @@ def op_cost_estimate(node, meta_of) -> Tuple[float, float]:
             and len(getattr(in_metas[1], "shape", ())) == 4:
         _o, i, kh, kw = in_metas[1].shape
         return 2.0 * out_elems * int(i) * int(kh) * int(kw), float(bytes_)
+    # hetukern families (docs/KERNELS.md): the fused-embed-grad and
+    # csr-spmm tiers are HBM-roof citizens — flops are the segment adds
+    # (one per input element / two per nnz·feature), bytes dominate
+    if fam == "EmbeddingLookUpGradient":
+        in_elems = (_prod(in_metas[0].shape)
+                    if in_metas and in_metas[0] is not None
+                    and getattr(in_metas[0], "shape", None) else out_elems)
+        return float(in_elems), float(bytes_)   # one add per grad element
+    if fam in ("CSRMatMat", "CSRMatVec"):
+        # nnz is runtime-fed (COO feed); 2·out_elems is the dense-output
+        # floor — the residual column absorbs the per-graph density
+        return 2.0 * out_elems, float(bytes_)
     if fam.startswith("Embedding"):
         return 0.0, float(bytes_)   # a gather: pure HBM traffic
     return _FLOPS_PER_ELEM.get(fam, 1.0) * out_elems, float(bytes_)
@@ -679,6 +691,38 @@ def roofline_rows(nodes, training: bool = True, target: Optional[str] = None,
     # training multiplier: matmul/conv backward re-runs two GEMMs (3x),
     # everything else roughly doubles (fwd + elementwise vjp)
     fams: Dict[str, dict] = {}
+    # hetukern fused-optimizer family (docs/KERNELS.md): the apply runs
+    # inside the step under its own named_scope, so the measured join works
+    # — give it a predicted row too. Adam reads grad+m+v+param and writes
+    # param+m+v (~10 flops and 7 f32 transfers per element); SGD reads
+    # grad+param, writes param (2 flops, 3 transfers).
+    # per-element (flops, f32 transfers) by update rule: Adam reads
+    # grad+m+v+param / writes param+m+v; Momentum reads grad+v+param /
+    # writes param+v; AdaGrad reads grad+accum+param / writes param+accum;
+    # SGD reads grad+param / writes param
+    _OPT_COST = {"AdamOptimizer": (10.0, 7.0), "AdamWOptimizer": (10.0, 7.0),
+                 "MomentumOptimizer": (4.0, 5.0),
+                 "AdaGradOptimizer": (6.0, 5.0),
+                 "SGDOptimizer": (2.0, 3.0)}
+    for node in topo:
+        if not node.is_optimizer:
+            continue
+        opt_name = type(node.optimizer).__name__
+        per_flops, per_moves = _OPT_COST.get(opt_name, (2.0, 3.0))
+        elems = 0
+        for var in getattr(node, "vars", ()):
+            m = meta_of(var)
+            shape = (getattr(m, "shape", None)
+                     or getattr(var, "shape", None))
+            if shape:
+                elems += _prod(shape)
+        if elems:
+            fam = op_family(node.name)      # e.g. Optimizer_AdamOptimizer
+            f = fams.setdefault(fam, {"n_ops": 0, "flops": 0.0,
+                                      "bytes": 0.0})
+            f["n_ops"] += 1
+            f["flops"] += per_flops * elems
+            f["bytes"] += per_moves * 4.0 * elems
     for node in topo:
         if node.is_placeholder or node.is_dataloader or node.is_optimizer \
                 or node.is_gradient:

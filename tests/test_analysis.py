@@ -581,3 +581,120 @@ def test_graphboard_lint_annotation(tmp_path):
     assert "<title>" in svg          # tooltip on the offending node
     dot = open(os.path.join(out, "output.dot")).read()
     assert "tooltip=" in dot
+
+
+def test_kernels_force_ineligible():
+    """Seeded defect (hetukern, docs/KERNELS.md): kernels='force' over an
+    optimizer whose parameter cannot take the fused kernel (declared
+    float64 — the fused apply is f32-master-precision only) must error at
+    define time with provenance on the optimizer node, instead of raising
+    a KernelEligibilityError deep inside the jit trace. Odd SIZES are
+    fine — the elementwise kernels pad to the tile internally."""
+    x = feed("xk", (4, 7))
+    w = ht.Variable(name="w_f64_k", value=np.ones((7, 7), np.float64),
+                    dtype=np.float64)
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    opt = ht.optim.AdamOptimizer(0.01).minimize(loss)
+    cfg = analysis.AnalysisConfig(kernels="force")
+    fs = analysis.analyze_graph([loss, opt], config=cfg)
+    errs = lints_of(fs, "kernels-force-ineligible")
+    assert errs and errs[0].severity == "error"
+    assert errs[0].op_name == opt.name
+    assert "fused_adam" in errs[0].message and "w_f64_k" in errs[0].message
+    # an f32 parameter is eligible regardless of shape: no finding
+    x2 = feed("xk2", (4, 7))
+    w2 = ht.Variable(name="w_ok_k", value=np.ones((7, 7), np.float32))
+    loss2 = ht.reduce_mean_op(ht.matmul_op(x2, w2), [0, 1])
+    opt2 = ht.optim.AdamOptimizer(0.01).minimize(loss2)
+    assert not lints_of(analysis.analyze_graph([loss2, opt2], config=cfg),
+                        "kernels-force-ineligible")
+    # and with kernels unset/off the pass stays silent even on the bad one
+    assert not lints_of(analysis.analyze_graph([loss, opt],
+                                               config=analysis.AnalysisConfig()),
+                        "kernels-force-ineligible")
+
+
+def test_kernels_force_ineligible_embed_grad():
+    """Seeded defect: a forced fused_embed_grad over a non-lane-aligned
+    embedding width (dim 20) errors with the kernel's reason."""
+    vec = feed("vk", (16, 20))
+    idx = feed("ik", (16,), np.int64)
+    g = ht.embedding_lookup_gradient_op(vec, idx, (100, 20))
+    cfg = analysis.AnalysisConfig(kernels="force")
+    fs = analysis.analyze_graph([g], config=cfg)
+    errs = lints_of(fs, "kernels-force-ineligible")
+    assert errs and errs[0].op_name == g.name
+    assert "fused_embed_grad" in errs[0].message
+
+
+def test_kernels_auto_fallback_note(monkeypatch):
+    """Seeded defect: under kernels='auto' ON A TPU BACKEND, a kernel
+    whose dispatches mostly fell back gets the silent-fallback note (on
+    CPU the fallback is the design and must stay silent)."""
+    import jax.numpy as jnp
+    from hetu_tpu.kernels import registry
+
+    registry.reset_stats()
+    try:
+        with registry.active("auto"):
+            # ineligible shape (dim 20): every dispatch falls back
+            for _ in range(3):
+                registry.dispatch(
+                    "fused_embed_grad",
+                    jnp.ones((16, 20), jnp.float32),
+                    jnp.zeros((16,), jnp.int32))
+        x = feed("xkf", (4, 4))
+        g = ht.relu_op(x)
+        cfg = analysis.AnalysisConfig(kernels="auto")
+        # CPU backend: silent by design
+        assert not lints_of(analysis.analyze_graph([g], config=cfg),
+                            "kernels-auto-fallback")
+        # pretend-TPU: the note names the kernel and the ratio
+        monkeypatch.setattr("hetu_tpu.kernels.registry._on_tpu",
+                            lambda: True)
+        notes = lints_of(analysis.analyze_graph([g], config=cfg),
+                         "kernels-auto-fallback")
+        assert len(notes) == 1 and notes[0].severity == "note"
+        assert "fused_embed_grad" in notes[0].message
+    finally:
+        registry.reset_stats()
+
+
+def test_ps_push_ignored_embed_grad_route():
+    """The hetukern rows route only suppresses ps-push-ignored when the
+    executor would actually wire the push: a resolvable sparse target, the
+    push as sole consumer, not an eval target. A typo'd ps_id (or a second
+    consumer) keeps the warning."""
+    from hetu_tpu.comm_quant import QuantPolicy  # noqa: F401 (idiom parity)
+    vocab, dim = 20, 8
+    cfg = analysis.AnalysisConfig(comm_mode="PS")
+
+    def build(name, ps_id=None, extra_consumer=False):
+        table = ht.init.zeros((vocab, dim), name=name, is_embed=True)
+        # true fed placeholders (no value): the PS staging contract
+        # requires the lookup index host-side
+        idx = ht.Variable(name=f"pi_{name}", dtype=np.int64,
+                          trainable=False)
+        vec = ht.Variable(name=f"pv_{name}", trainable=False)
+        look = ht.embedding_lookup_op(table, idx)
+        g = ht.embedding_lookup_gradient_op(vec, idx, (vocab, dim))
+        push = ht.parameterServerCommunicate_op(
+            g, ps_id=name if ps_id is None else ps_id)
+        nodes = [ht.reduce_mean_op(look, [0, 1]), push]
+        if extra_consumer:
+            nodes.append(ht.reduce_mean_op(g, [0, 1]))
+        return nodes
+
+    # wired route: sole-consumer push with a resolvable ps_id — no warn
+    ok_nodes = build("t_good")
+    assert not lints_of(analysis.analyze_graph(ok_nodes, config=cfg),
+                        "ps-push-ignored")
+    # typo'd ps_id: the executor will silently drop this push — warn
+    bad = build("t_typo", ps_id="no_such_param")
+    assert lints_of(analysis.analyze_graph(bad, config=cfg),
+                    "ps-push-ignored")
+    # second consumer: the executor keeps the op dense and never wires
+    # the push (ps_param_node unset) — warn
+    multi = build("t_multi", extra_consumer=True)
+    assert lints_of(analysis.analyze_graph(multi, config=cfg),
+                    "ps-push-ignored")

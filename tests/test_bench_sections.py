@@ -19,7 +19,7 @@ import bench  # noqa: E402
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
             "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
-            "introspect"]
+            "introspect", "kernels"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
@@ -29,6 +29,9 @@ EXPECTED_KEYS = {
     "bert": ("attn_impl", "mlm_ce", "trace"),
     "transformer": ("attn_impl",),
     "transformer350": ("attn_impl", "trace"),
+    # hetukern: the cell must carry the per-kernel equality verdicts and
+    # the embed-grad A/B headline (docs/KERNELS.md)
+    "kernels": ("equality_ok", "speedup_rows"),
 }
 
 
