@@ -185,6 +185,113 @@ def bench_introspect_overhead(width=512, batch=512, warmup=None, iters=60,
             "cadence": cadence}
 
 
+def bench_trail_overhead(batch_size=128, iters=40, rows=5000, width=16,
+                         warmup=10, windows=3):
+    """hetutrail always-on cost (docs/OBSERVABILITY.md pillar 5 acceptance:
+    < 2%/step with the ring enabled): the SAME PS-mode embedding trainer
+    against one live cluster, client span ring disarmed vs armed (SetTrail
+    A/B on the singleton worker + per-boundary span drain). Interleaved
+    best-of-N windows (off/on alternating, min per leg) — run-to-run noise
+    on this container (±6%) exceeds the cost being measured, and a
+    sequential A/B would land any load drift entirely in the delta.
+
+    Scope caveat: the SERVER-side rings stay armed in both legs (they arm
+    from HETU_TRAIL_DIR at spawn; there is no runtime toggle), so the
+    delta measures the client ring + drain — the only trail cost on the
+    worker's critical path. The server's on-request cost before the
+    response is two clock reads (~40 ns); its record+flush run after
+    send_msg, off the caller's path."""
+    import glob as _glob
+    import shutil
+    import tempfile
+    from hetu_tpu.ps.local_cluster import local_cluster
+    tdir = tempfile.mkdtemp(prefix="hetu_trail_bench_")
+    saved = os.environ.get("HETU_TRAIL_DIR")
+    os.environ["HETU_TRAIL_DIR"] = tdir
+    try:
+        with local_cluster(n_servers=2, n_workers=1):
+            import hetu_tpu as ht
+
+            def build(leg):
+                # disjoint server tensor ids per leg (see bench_wdl_ps)
+                os.environ["HETU_PS_ID_BASE"] = str(leg * 1000)
+                embed = ht.init.random_normal((rows, width), stddev=0.05,
+                                              name=f"embed{leg}",
+                                              is_embed=True)
+                idx = ht.Variable(name="idx", trainable=False)
+                y_ = ht.Variable(name="y_", trainable=False)
+                vec = ht.embedding_lookup_op(embed, idx)
+                flat = ht.array_reshape_op(vec, (-1, 4 * width))
+                w = ht.init.random_normal((4 * width, 1), stddev=0.1,
+                                          name=f"w{leg}")
+                prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+                loss = ht.reduce_mean_op(
+                    ht.binarycrossentropy_op(prob, y_), [0])
+                train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+                ex = ht.Executor({"train": [loss, train_op]},
+                                 ctx=ht.cpu(0), comm_mode="Hybrid", seed=0)
+                rng = np.random.RandomState(7)
+                feeds = {idx: rng.randint(0, rows, (batch_size, 4))
+                         .astype(np.float32),
+                         y_: rng.randint(0, 2, (batch_size, 1))
+                         .astype(np.float32)}
+                return ex, feeds
+
+            # leg 1 (env set at build) gets the trail writer; leg 0 is
+            # built with the env hidden so its runtime never drains
+            os.environ.pop("HETU_TRAIL_DIR", None)
+            ex_off, feeds_off = build(0)
+            os.environ["HETU_TRAIL_DIR"] = tdir
+            ex_on, feeds_on = build(1)
+
+            def window(ex, feeds, armed):
+                # re-arm per window: SetTrail state is per-worker (a
+                # process singleton), not per-executor. Disarming CLEARS
+                # the native ring, so the on-leg's undrained tail must hit
+                # its file first or client_spans undercounts.
+                if not armed:
+                    from hetu_tpu.telemetry import trail as _trail
+                    rt = ex_on.ps_runtime
+                    if rt.trail_writer is not None:
+                        with rt._rpc_lock:
+                            _trail.drain_client_spans(rt.comm,
+                                                      rt.trail_writer)
+                ex.ps_runtime.comm.SetTrail(armed)
+                for _ in range(warmup):
+                    ex.run("train", feed_dict=feeds)
+                t0 = time.time()
+                for _ in range(iters - 1):
+                    ex.run("train", feed_dict=feeds)
+                float(np.mean(ex.run("train",
+                                     feed_dict=feeds)[0].asnumpy()))
+                return (time.time() - t0) / iters * 1000
+
+            off_windows, on_windows = [], []
+            for _ in range(windows):   # interleaved: drift hits both legs
+                off_windows.append(window(ex_off, feeds_off, False))
+                on_windows.append(window(ex_on, feeds_on, True))
+            ms_off, ms_on = min(off_windows), min(on_windows)
+            ex_off.close()
+            ex_on.close()   # shutdown() drains the ring's tail into the file
+            spans = 0
+            for p in _glob.glob(os.path.join(tdir,
+                                             "trail-client-r*.jsonl")):
+                with open(p) as f:
+                    spans += sum(1 for line in f if '"kind":"rpc"' in line)
+        os.environ.pop("HETU_PS_ID_BASE", None)
+        return {"step_ms_off": round(ms_off, 4),
+                "step_ms_on": round(ms_on, 4),
+                "trail_overhead_pct": round(
+                    (ms_on - ms_off) / ms_off * 100, 2),
+                "client_spans": spans, "windows": windows}
+    finally:
+        if saved is None:
+            os.environ.pop("HETU_TRAIL_DIR", None)
+        else:
+            os.environ["HETU_TRAIL_DIR"] = saved
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 def _capture_trace(out, step_twice, trace_dir, label):
     """Post-window jax.profiler capture shared by the LM cells (bert,
     transformer/350): runs AFTER the timed window so tracing overhead
@@ -987,6 +1094,13 @@ def _run_section(name):
     elif name == "comm_quant_dp":
         kw = (dict(width=64, batch=32, steps=8, warmup=2) if smoke else {})
         out = bench_comm_quant_dp(**kw)
+    elif name == "trail":
+        # hetutrail overhead cell (docs/OBSERVABILITY.md pillar 5): the
+        # <2%-with-ring-enabled claim is MEASURED here, not asserted
+        kw = (dict(batch_size=32, iters=6, rows=500, warmup=2, windows=2)
+              if smoke else {})
+        out = bench_trail_overhead(**kw)
+        out["servers"] = 2
     elif name == "kernels":
         kw = (dict(vocab=5000, dim=32, batch=512, lookups=2, warmup=1,
                    iters=3) if smoke else {})
@@ -1019,6 +1133,10 @@ SECTION_ENV = {
     # smoke drives interpret-mode Pallas, which the tunneled chip only
     # slows down
     "kernels": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetutrail overhead A/B: framework-relative, PS-cluster-bound —
+    # deterministic on CPU, and the tunneled chip would add 60-85ms RTTs
+    # that drown the cost being measured
+    "trail": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
 }
 
 
@@ -1177,7 +1295,8 @@ class _Ledger:
         if isinstance(result, dict):
             for k in ("samples_per_sec", "step_ms", "mfu", "mfu_6nd",
                       "mfu_attn_incl", "tokens_per_sec",
-                      "introspect_overhead_pct", "step_ms_off",
+                      "introspect_overhead_pct", "trail_overhead_pct",
+                      "client_spans", "step_ms_off",
                       "step_ms_on", "bytes_wire_ratio", "auc_off",
                       "auc_int8", "auc_delta", "final_loss_off",
                       "loss_delta_int8", "loss_delta_fp8",
@@ -1349,6 +1468,7 @@ def main():
                      ("comm_quant_ps_wdl", "comm_quant_ps", 600),
                      ("comm_quant_dp_mlp", "comm_quant_dp", 600),
                      ("introspect_overhead", "introspect", 420),
+                     ("trail_overhead", "trail", 600),
                      ("kernels_tier", "kernels", 600)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent

@@ -389,6 +389,52 @@ void TestCorruptNextQuant(int node) {
   });
 }
 
+// -- hetutrail (docs/OBSERVABILITY.md pillar 5) -----------------------------
+
+// Stamp the worker's current training step onto subsequent client RPC spans
+// (the span context riding the wire stays the existing client_id/req_id).
+void SetTrailStep(long long step) {
+  guard([&] { worker().set_trail_step(static_cast<int64_t>(step)); });
+}
+
+// Arm/disarm the client span ring at runtime (the env default is
+// HETU_TRAIL_DIR at Init; an A/B of two executors on one live worker needs
+// the explicit toggle, like SetCommQuant). Disarming clears the ring.
+void SetTrail(int on) {
+  guard([&] { worker().set_trail(on != 0); });
+}
+
+// Drain up to max_rows client spans (oldest first) into out as 10-wide i64
+// rows: [req_id, client_id, server, psf, tensor, step, t0_us, dur_us,
+// req_bytes, rsp_bytes]. t0_us is CLOCK_MONOTONIC µs (net.h trail_mono_us),
+// directly comparable with server-side spans on the same host. Returns the
+// row count (0 when the ring is empty or trail is off).
+long DrainTrailSpans(long long* out, int max_rows) {
+  long n = 0;
+  guard([&] {
+    n = static_cast<long>(worker().drain_trail(
+        reinterpret_cast<int64_t*>(out),
+        max_rows > 0 ? static_cast<size_t>(max_rows) : 0));
+  });
+  return n;
+}
+
+// Spans dropped because the bounded ring was full (monotonic counter).
+long long TrailDropped() {
+  return g_worker ? static_cast<long long>(worker().trail_dropped()) : 0;
+}
+
+// hetutrail test lever (inert without HETU_TEST_MODE): delay server
+// `server`'s NEXT optimizer apply by `ms` — the deterministic slow leg the
+// critical-path and straggler tests attribute.
+void TestSlowApply(int server, int ms) {
+  guard([&] {
+    if (!hetups::env_test_mode())
+      throw std::runtime_error("TestSlowApply requires HETU_TEST_MODE");
+    worker().test_slow_apply(static_cast<size_t>(server), ms);
+  });
+}
+
 // Worker-side RPC counters: fills up to n of [rpcs, retries, failovers,
 // quant raw value bytes, quant wire value bytes] (worker.h client_stats —
 // the telemetry twin of QueryServerStats).

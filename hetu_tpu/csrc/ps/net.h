@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -79,6 +80,11 @@ enum class PsfType : int32_t {
   // server-side membership surface:
   kListParams = 65,       // any -> server: param key/meta inventory
   kSetWorldVersion = 66,  // coordinator -> server: arm stale-epoch rejection
+  // hetutrail (docs/OBSERVABILITY.md pillar 5): deterministic test lever —
+  // delay the server's NEXT optimizer apply by i64[ms] (inert without
+  // HETU_TEST_MODE), so critical-path and straggler tests have a knowable
+  // slow leg to attribute
+  kTestSlowApply = 70,
 };
 
 struct MsgHeader {
@@ -321,6 +327,17 @@ inline void set_recv_timeout(int fd, int ms) {
 inline int env_int_or(const char* name, int dflt) {
   const char* v = ::getenv(name);
   return v && *v ? std::atoi(v) : dflt;
+}
+
+// hetutrail: ONE monotonic-µs clock for every trail span on both sides of
+// the wire. CLOCK_MONOTONIC (what steady_clock reads on Linux) counts from
+// boot and is shared by every process on a host, so client and server spans
+// are directly comparable without wall-clock re-anchoring — immune to the
+// NTP steps that motivated the PR 4 req_id epoch machinery.
+inline int64_t trail_mono_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 inline int listen_on(const std::string& host, int port, int backlog = 128) {

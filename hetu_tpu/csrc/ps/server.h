@@ -29,6 +29,15 @@
 
 namespace hetups {
 
+// hetutrail per-request apply timing: begin_req/mark run on the SAME serve
+// thread as serve_conn's span record, so a thread_local pair carries the
+// true apply window (optimizer math only — param-lock wait and response
+// serialization excluded) out of handle() without threading a context
+// through every PSF case. Zeroed per request in serve_conn; stays 0 for
+// reads and when trail is off.
+inline thread_local int64_t g_trail_apply_t0 = 0;
+inline thread_local int64_t g_trail_apply_us = 0;
+
 // The single truthy-env convention shared with the Python side
 // (resilience.env_truthy): destructive test hooks are inert without it.
 inline bool env_test_mode() {
@@ -62,6 +71,21 @@ class PsServer {
                         spec.substr(colon + 1) == "snap";
       test_exit_after_updates_ = std::atol(spec.c_str());
     }
+    // hetutrail (docs/OBSERVABILITY.md pillar 5): per-request timelines
+    // into a bounded ring, flushed as JSONL the offline analyzer joins to
+    // client spans by (client_id, req_id). Armed by HETU_TRAIL_DIR — the
+    // server is a light ctypes process with no Python telemetry, so the
+    // C++ side owns the file.
+    const char* td = std::getenv("HETU_TRAIL_DIR");
+    if (td && *td) {
+      trail_path_ = std::string(td) + "/trail-server-s" +
+                    std::to_string(rank_) + ".jsonl";
+      trail_cap_ = static_cast<size_t>(env_int_or("HETU_TRAIL_RING", 65536));
+      // bounded file growth, like the Python TrailWriter: rotate to one
+      // .1 backup past the cap (0 disables)
+      trail_max_bytes_ = static_cast<int64_t>(
+          env_int_or("HETU_TRAIL_MAX_MB", 512)) * 1000000;
+    }
   }
 
   ~PsServer() { stop(); }
@@ -88,6 +112,7 @@ class PsServer {
 
   void stop() {
     running_ = false;
+    trail_flush(/*force=*/true);
     {
       std::lock_guard<std::mutex> g(snap_mu_);
       snap_stop_ = true;
@@ -105,6 +130,14 @@ class PsServer {
       for (int fd : live_fds_) ::shutdown(fd, SHUT_RDWR);
     }
     conn_threads_.join_all();
+    trail_flush(/*force=*/true);  // spans the serve threads added late
+    {
+      std::lock_guard<std::mutex> g(trail_mu_);
+      if (trail_f_) {
+        std::fclose(trail_f_);
+        trail_f_ = nullptr;
+      }
+    }
   }
 
   int rank() const { return rank_; }
@@ -158,9 +191,11 @@ class PsServer {
       live_fds_.push_back(fd);
     }
     Message req;
+    const bool trail = !trail_path_.empty();
     while (recv_msg(fd, &req)) {
       if (static_cast<PsfType>(req.head.type) == PsfType::kShutdown) break;
       req_count_.fetch_add(1, std::memory_order_relaxed);
+      const int64_t tr_recv = trail ? trail_mono_us() : 0;
       // hetu-elastic stale-epoch rejection: once armed (kSetWorldVersion),
       // a request stamped with a DIFFERENT non-zero world version comes
       // from a worker that missed a resize commit — its view of the key
@@ -226,6 +261,14 @@ class PsServer {
       rsp.head.tensor_id = req.head.tensor_id;
       rsp.head.req_id = req.head.req_id;
       uint64_t wseq = 0;
+      // trail timeline: recv -> (queue + dedup-slot lock wait) -> handle
+      // (param lock wait + apply + serialize) -> respond; the apply
+      // window alone rides the begin_req/mark thread_locals
+      if (trail) {
+        g_trail_apply_t0 = 0;   // clear any stale window (error paths)
+        g_trail_apply_us = 0;
+      }
+      const int64_t tr_h0 = trail ? trail_mono_us() : 0;
       const auto handle_t0 = std::chrono::steady_clock::now();
       try {
         handle(req, &rsp, skip_apply, &wseq);
@@ -272,11 +315,27 @@ class PsServer {
                      (unsigned long long)req.head.req_id);
         std::_Exit(137);
       }
+      const int64_t tr_h1 = trail ? trail_mono_us() : 0;
+      bool sent = true;
       try {
         send_msg(fd, slot ? slot->rsp : rsp);
       } catch (...) {
-        break;  // peer gone mid-reply
+        sent = false;  // peer gone mid-reply
       }
+      if (trail) {
+        SrvSpan s;
+        s.client_id = req.head.client_id;
+        s.req_id = req.head.req_id;
+        s.psf = req.head.type;
+        s.tensor = req.head.tensor_id;
+        s.t0_us = tr_recv;
+        s.q_us = tr_h0 - tr_recv;
+        s.handle_us = tr_h1 - tr_h0;
+        s.apply_us = g_trail_apply_us;   // optimizer math only; 0 = read
+        s.send_us = trail_mono_us() - tr_h1;
+        trail_record(s);
+      }
+      if (!sent) break;
     }
     {
       std::lock_guard<std::mutex> g(fds_mu_);
@@ -310,8 +369,98 @@ class PsServer {
   // counter is what snapshot manifests stamp — recovery reports exactly how
   // many updates the restored state is behind.
   void begin_req(Param& p) {
+    // hetutrail ps_slow fault (kTestSlowApply, HETU_TEST_MODE-gated):
+    // one-shot delay of the next apply, taken while the param's exclusive
+    // lock is held — exactly the lock-wait shape a genuinely slow apply
+    // inflicts on concurrent requests, which is what the critical-path
+    // and straggler tests must attribute.
+    // apply-window start BEFORE the slow hook's sleep: the injected delay
+    // stands in for a genuinely slow apply, so it must read as apply time
+    if (!trail_path_.empty()) g_trail_apply_t0 = trail_mono_us();
+    const int64_t slow = test_slow_ms_.exchange(0, std::memory_order_relaxed);
+    if (slow > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(slow));
     begin_update(p);
     update_count_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // -- hetutrail span ring (bounded; see the flight-recorder precedent) ---
+  struct SrvSpan {
+    uint64_t req_id;
+    int32_t client_id, psf, tensor;
+    int64_t t0_us, q_us, handle_us, apply_us, send_us;
+  };
+
+  void trail_record(const SrvSpan& s) {
+    bool do_flush = false;
+    {
+      std::lock_guard<std::mutex> g(trail_mu_);
+      if (trail_ring_.size() >= trail_cap_) {
+        ++trail_dropped_;
+        do_flush = true;  // drain to disk so the ring frees up
+      } else {
+        trail_ring_.push_back(s);
+        do_flush = trail_ring_.size() >= kTrailFlushEvery;
+      }
+    }
+    if (do_flush) trail_flush(false);
+  }
+
+  // Append the ring to trail-server-s<rank>.jsonl. The first write of each
+  // file handle emits an anchor record pairing this host's monotonic clock
+  // with the wall clock, so offline tools can place spans in absolute time
+  // without trusting wall-clock stamps taken mid-run (NTP steps).
+  void trail_flush(bool force) {
+    if (trail_path_.empty()) return;
+    std::lock_guard<std::mutex> g(trail_mu_);
+    if (trail_ring_.empty() && !force) return;
+    if (!trail_f_) {
+      trail_f_ = std::fopen(trail_path_.c_str(), "a");
+      if (!trail_f_) {
+        trail_ring_.clear();  // unwritable dir must not grow the ring
+        return;
+      }
+      // count what a predecessor incarnation already wrote, so the size
+      // bound holds across restarts too
+      if (std::fseek(trail_f_, 0, SEEK_END) == 0)
+        trail_file_bytes_ = std::ftell(trail_f_);
+      const double wall = std::chrono::duration_cast<std::chrono::duration<
+          double>>(std::chrono::system_clock::now().time_since_epoch())
+          .count();
+      std::fprintf(trail_f_,
+                   "{\"kind\":\"anchor\",\"server\":%d,\"mono_us\":%lld,"
+                   "\"wall_s\":%.3f}\n",
+                   rank_, (long long)trail_mono_us(), wall);
+    }
+    for (const SrvSpan& s : trail_ring_) {
+      int k = std::fprintf(
+          trail_f_,
+          "{\"kind\":\"srv\",\"server\":%d,\"client\":%d,"
+          "\"req_id\":%llu,\"psf\":%d,\"tensor\":%d,"
+          "\"t0_us\":%lld,\"q_us\":%lld,\"handle_us\":%lld,"
+          "\"apply_us\":%lld,\"send_us\":%lld}\n",
+          rank_, s.client_id, (unsigned long long)s.req_id, s.psf,
+          s.tensor, (long long)s.t0_us, (long long)s.q_us,
+          (long long)s.handle_us, (long long)s.apply_us,
+          (long long)s.send_us);
+      if (k > 0) trail_file_bytes_ += k;
+    }
+    if (trail_dropped_) {
+      std::fprintf(trail_f_,
+                   "{\"kind\":\"dropped\",\"server\":%d,\"n\":%llu}\n",
+                   rank_, (unsigned long long)trail_dropped_);
+      trail_dropped_ = 0;
+    }
+    trail_ring_.clear();
+    std::fflush(trail_f_);
+    if (trail_max_bytes_ > 0 && trail_file_bytes_ >= trail_max_bytes_) {
+      // rotate to ONE .1 backup (bounded growth, the TrailWriter/JsonlSink
+      // convention); the next flush reopens and writes a fresh anchor
+      std::fclose(trail_f_);
+      trail_f_ = nullptr;
+      std::rename(trail_path_.c_str(), (trail_path_ + ".1").c_str());
+      trail_file_bytes_ = 0;
+    }
   }
 
   // hetuq: f32 view of a value arg that may ride the wire quantized
@@ -358,6 +507,13 @@ class PsServer {
       pm.last_write_seq =
           write_seq_gen_.fetch_add(1, std::memory_order_relaxed) + 1;
       if (write_seq) *write_seq = pm.last_write_seq;
+      // close the hetutrail apply window opened by begin_req (mark runs
+      // right after the case's apply loop); cases that mark without
+      // begin_req (init/assign/clear/load) leave t0 at 0 — no apply span
+      if (g_trail_apply_t0) {
+        g_trail_apply_us = trail_mono_us() - g_trail_apply_t0;
+        g_trail_apply_t0 = 0;
+      }
     };
     switch (type) {
       case PsfType::kParamInit: {
@@ -783,6 +939,19 @@ class PsServer {
         world_version_.store(
             static_cast<uint64_t>(req.args[0].as_i64()[0]),
             std::memory_order_relaxed);
+        break;
+      }
+      case PsfType::kTestSlowApply: {
+        // hetutrail fault lever (ps_slow@step[:ms]): arm a one-shot delay
+        // of the next optimizer apply. Doubly gated — capi refuses to send
+        // without HETU_TEST_MODE, and this server refuses to arm without
+        // it, so a stray message can never slow a production server.
+        if (!env_test_mode())
+          throw std::runtime_error("kTestSlowApply requires HETU_TEST_MODE");
+        if (req.args.empty() || req.args[0].size() < 8)
+          throw std::runtime_error("kTestSlowApply needs i64[ms]");
+        test_slow_ms_.store(req.args[0].as_i64()[0],
+                            std::memory_order_relaxed);
         break;
       }
       case PsfType::kServerStats: {
@@ -1294,6 +1463,17 @@ class PsServer {
   std::atomic<int64_t> last_snapshot_steady_ms_{0};  // 0 = none yet
   long test_exit_after_updates_ = -1;              // test hook (gated)
   bool test_exit_snap_ = false;
+  // hetutrail: per-request span ring + ps_slow fault state
+  static constexpr size_t kTrailFlushEvery = 256;
+  std::string trail_path_;                         // "" = trail off
+  size_t trail_cap_ = 65536;
+  int64_t trail_max_bytes_ = 0;                    // HETU_TRAIL_MAX_MB
+  int64_t trail_file_bytes_ = 0;                   // guarded by trail_mu_
+  std::mutex trail_mu_;
+  std::vector<SrvSpan> trail_ring_;
+  uint64_t trail_dropped_ = 0;                     // guarded by trail_mu_
+  FILE* trail_f_ = nullptr;                        // guarded by trail_mu_
+  std::atomic<int64_t> test_slow_ms_{0};           // kTestSlowApply (gated)
   // hetu-elastic membership epoch (0 = rejection unarmed); set via
   // kSetWorldVersion, compared against MsgHeader::world_ver in serve_conn
   std::atomic<uint64_t> world_version_{0};

@@ -131,6 +131,14 @@ class PsWorker {
         sched_port_(sched_port), pool_(n_threads) {
     recv_timeout_ms_ = env_int_or("DMLC_PS_RECV_TIMEOUT_MS", 15000);
     max_retry_ = env_int_or("DMLC_PS_MAX_RETRY", 3);
+    // hetutrail: client-side RPC spans into a bounded ring, drained by the
+    // Python runtime (DrainTrailSpans) into trail-client-r<rank>.jsonl.
+    // Armed by HETU_TRAIL_DIR like the server side; when off the rpc path
+    // pays one relaxed atomic load and nothing else.
+    if (const char* td = std::getenv("HETU_TRAIL_DIR"))
+      trail_on_.store(td[0] != '\0');
+    trail_cap_ = static_cast<size_t>(
+        env_int_or("HETU_TRAIL_RING", 65536));
     // hetuq: quantize push/pull value payloads (ArgType::kQI8 — row-wise
     // int8 for sparse, kQuantWireBlock blocks for dense). Env default so a
     // bare PSClient inherits the run's knob; SetCommQuant overrides.
@@ -794,6 +802,74 @@ class PsWorker {
   // -- control -----------------------------------------------------------
   void wait(int32_t key) { pending_.wait(key); }
 
+  // -- hetutrail client spans (docs/OBSERVABILITY.md pillar 5) ------------
+  // One span per successful RPC round trip, stamped with the worker's
+  // current step (SetTrailStep) — the span context riding the wire is the
+  // existing (client_id, req_id) pair, so server spans join back without
+  // any wire-format change.
+  struct TrailSpan {
+    uint64_t req_id;
+    int32_t client_id, server, psf, tensor;
+    int64_t step;
+    int64_t t0_us, dur_us;      // trail_mono_us at send / round-trip span
+    int64_t req_bytes, rsp_bytes;
+  };
+  static constexpr size_t kTrailCols = 10;  // i64 row width for the drain
+
+  void set_trail_step(int64_t step) {
+    trail_step_.store(step, std::memory_order_relaxed);
+  }
+
+  // Explicit arm/disarm (the SetCommQuant pattern): the worker is a
+  // process singleton, so an A/B of two executors must not inherit the
+  // other leg's ring state. Disarming clears the ring.
+  void set_trail(bool on) {
+    trail_on_.store(on);
+    if (!on) {
+      std::lock_guard<std::mutex> g(trail_mu_);
+      trail_ring_.clear();
+    }
+  }
+
+  // Copy up to max_rows spans (oldest first) into out as kTrailCols-wide
+  // i64 rows, removing them from the ring. Returns the row count.
+  size_t drain_trail(int64_t* out, size_t max_rows) {
+    std::lock_guard<std::mutex> g(trail_mu_);
+    size_t n = std::min(max_rows, trail_ring_.size());
+    for (size_t i = 0; i < n; ++i) {
+      const TrailSpan& s = trail_ring_[i];
+      int64_t* r = out + i * kTrailCols;
+      r[0] = static_cast<int64_t>(s.req_id);
+      r[1] = s.client_id;
+      r[2] = s.server;
+      r[3] = s.psf;
+      r[4] = s.tensor;
+      r[5] = s.step;
+      r[6] = s.t0_us;
+      r[7] = s.dur_us;
+      r[8] = s.req_bytes;
+      r[9] = s.rsp_bytes;
+    }
+    trail_ring_.erase(trail_ring_.begin(), trail_ring_.begin() + n);
+    return n;
+  }
+
+  uint64_t trail_dropped() const { return trail_dropped_.load(); }
+
+  // hetutrail test lever (capi gates on HETU_TEST_MODE, the server gates
+  // again): delay the target server's NEXT optimizer apply by `ms`.
+  void test_slow_apply(size_t server, int ms) {
+    if (server >= servers_.size())
+      throw std::runtime_error("test_slow_apply: server index " +
+                               std::to_string(server) + " out of range");
+    Message req;
+    req.head.type = static_cast<int32_t>(PsfType::kTestSlowApply);
+    req.head.tensor_id = -1;
+    int64_t v = ms;
+    req.args.push_back(Arg::i64(&v, 1));
+    rpc(server, req);
+  }
+
   // Worker-side RPC counters (telemetry: kServerStats' client-side twin):
   // [rpc round trips issued, fast-retry attempts, successful failover
   // re-issues, raw value-payload bytes, wire value-payload bytes]. The two
@@ -1057,6 +1133,10 @@ class PsWorker {
     auto& conns = ch == 0 ? servers_ : servers_fast_;
     std::lock_guard<std::mutex> g(server_mu_[ch][server % kMaxServers]);
     rpc_count_.fetch_add(1, std::memory_order_relaxed);
+    // hetutrail: span start AFTER the per-(server, channel) lock — the span
+    // measures wire + server time, not local queueing behind a sibling RPC
+    const bool trail = trail_on_.load(std::memory_order_relaxed);
+    const int64_t tr0 = trail ? trail_mono_us() : 0;
     req.head.req_id = next_req_id_.fetch_add(1);
     // per-channel client identity: the server's resend-dedup slot assumes
     // monotonic req_ids per client, which holds per channel but not across
@@ -1089,7 +1169,10 @@ class PsWorker {
           continue;
         }
       }
-      if (try_roundtrip(conns, server, req, &rsp, &last_err)) return rsp;
+      if (try_roundtrip(conns, server, req, &rsp, &last_err)) {
+        if (trail) trail_record(req, rsp, server, tr0);
+        return rsp;
+      }
     }
     // phase 2 (opt-in): the server is gone — block-with-deadline until the
     // supervisor's replacement registers with the scheduler, then re-issue
@@ -1121,6 +1204,7 @@ class PsWorker {
             last_err = e.what();
           }
           if (connected && try_roundtrip(conns, server, req, &rsp, &last_err)) {
+            if (trail) trail_record(req, rsp, server, tr0);
             failover_count_.fetch_add(1, std::memory_order_relaxed);
             std::fprintf(stderr,
                          "[hetups worker %d] server %zu recovered at %s; "
@@ -1140,6 +1224,34 @@ class PsWorker {
     throw std::runtime_error(
         "PS server " + std::to_string(server) + " unreachable after " +
         std::to_string(max_retry_ + 1) + " attempts (" + last_err + ")");
+  }
+
+  // hetutrail: bounded ring append (drop-new + counter when full — the
+  // always-on cost contract is a fixed memory ceiling, like the flight
+  // recorder, never an unbounded buffer).
+  void trail_record(const Message& req, const Message& rsp, size_t server,
+                    int64_t t0_us) {
+    TrailSpan s;
+    s.req_id = req.head.req_id;
+    s.client_id = req.head.client_id;
+    s.server = static_cast<int32_t>(server);
+    s.psf = req.head.type;
+    s.tensor = req.head.tensor_id;
+    s.step = trail_step_.load(std::memory_order_relaxed);
+    s.t0_us = t0_us;
+    s.dur_us = trail_mono_us() - t0_us;
+    s.req_bytes = 0;
+    for (const auto& a : req.args)
+      s.req_bytes += static_cast<int64_t>(a.buf.size());
+    s.rsp_bytes = 0;
+    for (const auto& a : rsp.args)
+      s.rsp_bytes += static_cast<int64_t>(a.buf.size());
+    std::lock_guard<std::mutex> g(trail_mu_);
+    if (trail_ring_.size() >= trail_cap_) {
+      trail_dropped_.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+    trail_ring_.push_back(s);
   }
 
   template <typename F>
@@ -1260,6 +1372,16 @@ class PsWorker {
   std::unordered_map<int32_t, TensorMeta> metas_;
   std::mutex opts_mu_;
   std::unordered_map<int32_t, std::array<float, 3>> push_opts_;
+  // hetutrail client-span ring (armed by HETU_TRAIL_DIR)
+  std::atomic<bool> trail_on_{false};
+  std::atomic<int64_t> trail_step_{0};
+  std::atomic<uint64_t> trail_dropped_{0};
+  size_t trail_cap_ = 65536;
+  std::mutex trail_mu_;
+  // deque, not vector: the drain erases from the FRONT in 4096-row
+  // batches while trail_mu_ blocks concurrent rpc records — a vector
+  // would memmove the whole remaining ring per batch
+  std::deque<TrailSpan> trail_ring_;
   std::atomic<query_t> next_query_{1};
   std::mutex loads_mu_;
   std::string record_dir_;

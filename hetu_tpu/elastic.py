@@ -1098,30 +1098,66 @@ class ScalePolicy:
         self._hot_polls = 0
         self._last = None  # (t, per-server [requests, apply_ns, applies])
         self._last_decision_t = 0.0
+        self.stragglers_seen = 0   # hetutrail events fed via note_straggler
+        # observe() runs on the PS supervisor's poll thread while
+        # note_straggler() arrives from the launcher's reap loop — the
+        # shared cooldown state must not double-recommend for one episode
+        self._lock = threading.Lock()
 
     def observe(self, stats_rows: list[list[int]],
                 now: Optional[float] = None) -> Optional[dict]:
         now = time.monotonic() if now is None else now
-        cur = [(r[5], r[6], r[7]) for r in stats_rows if len(r) >= 8]
-        prev, self._last = self._last, (now, cur)
-        if not cur or prev is None or len(prev[1]) != len(cur):
+        with self._lock:
+            cur = [(r[5], r[6], r[7]) for r in stats_rows if len(r) >= 8]
+            prev, self._last = self._last, (now, cur)
+            if not cur or prev is None or len(prev[1]) != len(cur):
+                self._hot_polls = 0
+                return None
+            dt = max(1e-6, now - prev[0])
+            hot = False
+            for (req0, ns0, ap0), (req1, ns1, ap1) in zip(prev[1], cur):
+                d_ap = ap1 - ap0
+                if d_ap > 0 and (ns1 - ns0) / d_ap / 1e6 > self.apply_ms_hi:
+                    hot = True
+                if (req1 - req0) / dt > self.req_rate_hi:
+                    hot = True
+            self._hot_polls = self._hot_polls + 1 if hot else 0
+            if self._hot_polls < self.sustain:
+                return None
+            if len(cur) >= self.max_servers:
+                return None
+            if now - self._last_decision_t < self.cooldown_s:
+                return None
             self._hot_polls = 0
-            return None
-        dt = max(1e-6, now - prev[0])
-        hot = False
-        for (req0, ns0, ap0), (req1, ns1, ap1) in zip(prev[1], cur):
-            d_ap = ap1 - ap0
-            if d_ap > 0 and (ns1 - ns0) / d_ap / 1e6 > self.apply_ms_hi:
-                hot = True
-            if (req1 - req0) / dt > self.req_rate_hi:
-                hot = True
-        self._hot_polls = self._hot_polls + 1 if hot else 0
-        if self._hot_polls < self.sustain:
-            return None
-        if len(cur) >= self.max_servers:
-            return None
-        if now - self._last_decision_t < self.cooldown_s:
-            return None
-        self._hot_polls = 0
-        self._last_decision_t = now
-        return {"action": "grow_server", "n_servers": len(cur) + 1}
+            self._last_decision_t = now
+            return {"action": "grow_server", "n_servers": len(cur) + 1}
+
+    def note_straggler(self, event: dict,
+                       now: Optional[float] = None) -> Optional[dict]:
+        """hetutrail straggler events (trail.SkewMonitor /
+        trail-events.jsonl) as a scale signal. A rank-level straggler is
+        recorded but recommends nothing by itself — a slow WORKER is not
+        fixed by more PS servers; when the event's critical-path
+        attribution names a PS server (``server`` key, from ``hetutrail
+        --step``'s verdict riding the event), it counts like sustained
+        apply-latency pressure and recommends one more server, under the
+        same cooldown/max bounds as :meth:`observe`."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.stragglers_seen += 1
+            if event.get("server") is None:
+                return None
+            # the policy's own stats view (observe() feeds it from real
+            # kServerStats rows) is the ONLY acceptable cluster size for
+            # the cap check: the event's n_servers — distinct servers SEEN
+            # in the straggler's recent spans — is a lower bound that
+            # could grow past max_servers. No stats yet => no
+            # recommendation (the real wiring polls observe() alongside).
+            n_servers = len(self._last[1]) if self._last else 0
+            if not n_servers or n_servers >= self.max_servers:
+                return None
+            if now - self._last_decision_t < self.cooldown_s:
+                return None
+            self._last_decision_t = now
+            return {"action": "grow_server", "n_servers": int(n_servers) + 1,
+                    "reason": f"straggler server {event['server']}"}

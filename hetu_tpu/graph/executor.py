@@ -537,6 +537,7 @@ class SubExecutor:
         self._tel_ps_every = max(1, int(os.environ.get(
             "HETU_TELEMETRY_PS_EVERY", "20")))
         self.last_phases: Optional[dict] = None
+        self._tel_cp_cache: dict = {}   # hetutrail critical-path gauges
 
         # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
         ps = executor.ps_runtime
@@ -887,7 +888,8 @@ class SubExecutor:
 
     def _record_telemetry(self, tel, step, t0, t_pre, t_c0, t_c1, t_d0,
                           t_d1, t_end, compiled_now, feed_vals, batch_vals,
-                          ps_comm_ms=None):
+                          ps_comm_ms=None, ps_pull_ms=None,
+                          ps_push_ms=None):
         """Per-step telemetry: phase spans (trace mode), step metrics and
         the JSONL step record; PS server health on its poll cadence. Runs
         only when telemetry is active — the hot path records raw
@@ -901,6 +903,11 @@ class SubExecutor:
             phases["compile_ms"] = (t_c1 - t_c0) * 1e3
         if ps_comm_ms is not None:
             phases["ps_comm_ms"] = ps_comm_ms
+        if ps_pull_ms is not None:
+            # the two PS legs separately (pull wait in prestep, push in
+            # poststep): what hetutrail's critical path decomposes
+            phases["ps_pull_ms"] = ps_pull_ms
+            phases["ps_push_ms"] = ps_push_ms or 0.0
         self.last_phases = {"step_ms": step_ms, "step": int(step), **phases}
         tracer = tel.tracer
         label = "step" if self.training else "eval"
@@ -940,6 +947,14 @@ class SubExecutor:
             # it offline from the device trace (docs/PROFILING.md).
             tel.metrics.gauge("hetu_comm_fraction").set(
                 min(1.0, ps_comm_ms / step_ms))
+        # hetutrail critical path (docs/OBSERVABILITY.md pillar 5): the
+        # blocking chain per step as hetu_critical_path_ms{leg=...} gauges
+        # plus hetu_cp_fraction (dominant leg's share) — the cost-model
+        # calibration signal hetuprof's cp_fraction column reads back
+        from ..telemetry import trail as _trail_mod
+        _trail_mod.export_critical_path(
+            tel.metrics, _trail_mod.step_legs(phases),
+            cache=self._tel_cp_cache)
         if compiled_now:
             tm["compiles"].inc()
             # recompile churn counts distinct SHAPE signatures, not the
@@ -1247,7 +1262,10 @@ class SubExecutor:
             p = ps.params[id(n)]
             ps.wait_dense(p)   # async DDPushPull updates host_value
             ps_dense_vals.append(ex._prepare_input(p.host_value, batch=False))
-        ps_comm_s = (time.perf_counter() - t_ps0) if ps_timed else 0.0
+        # pull-wait vs push legs tracked separately: hetutrail's critical
+        # path needs to know WHICH PS leg blocked, not just the total
+        ps_pull_s = (time.perf_counter() - t_ps0) if ps_timed else 0.0
+        ps_comm_s = ps_pull_s
 
         t_pre = time.perf_counter() if timed else 0.0
         if prof is not None:
@@ -1364,8 +1382,10 @@ class SubExecutor:
                 p = ps.params[id(op.ps_param_node)]
                 idx = self._push_idx(op, staged_idx)
                 ps.push_grad(p, grad, idx, step=step)
+        ps_push_s = 0.0
         if ps_timed:
-            ps_comm_s += time.perf_counter() - t_pu0
+            ps_push_s = time.perf_counter() - t_pu0
+            ps_comm_s += ps_push_s
 
         if self.training:
             for node, val in zip(ex.param_nodes, new_params):
@@ -1445,13 +1465,20 @@ class SubExecutor:
         if prof is not None:
             prof["poststep_s"] += t_end - t_d1
             prof["steps"] += 1
+        # hetutrail step boundary: drain this step's client RPC spans and
+        # advance the span step stamp (None writer when off — one check)
+        if ps is not None and self.training \
+                and ps.trail_writer is not None:
+            ps.trail_step_boundary(step)
         if tel is not None:
             # recorded BEFORE supervisor post-step: an emergency flush on
             # the preemption path must already contain this step's record
             self._record_telemetry(
                 tel, step, t_run0, t_pre, t_c0, t_c1, t_d0, t_d1, t_end,
                 compiled_now, feed_vals, batch_vals,
-                ps_comm_ms=ps_comm_s * 1e3 if ps_timed else None)
+                ps_comm_ms=ps_comm_s * 1e3 if ps_timed else None,
+                ps_pull_ms=ps_pull_s * 1e3 if ps_timed else None,
+                ps_push_ms=ps_push_s * 1e3 if ps_timed else None)
 
         # post-step supervision LAST: a rollback rewrites ex.state, an
         # emergency save captures it, and Preempted aborts the return — all

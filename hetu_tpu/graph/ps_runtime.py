@@ -224,6 +224,36 @@ class PSRuntime:
             self._m_push_bytes = reg.counter("hetu_ps_push_bytes_total")
             self._m_pref_hits = reg.counter("hetu_ps_prefetch_hits_total")
             self._m_pref_miss = reg.counter("hetu_ps_prefetch_misses_total")
+        # hetutrail (docs/OBSERVABILITY.md pillar 5): the native worker's
+        # client-span ring (armed by the same HETU_TRAIL_DIR the C++ side
+        # checks) is drained at every step boundary into
+        # trail-client-r<rank>.jsonl. None when off — the executor's
+        # boundary hook pays one attribute check and nothing else.
+        from ..telemetry import trail as _trail
+        self._trail_mod = _trail
+        self.trail_writer = None
+        # the span ring is drained on a cadence, not per step: one drain
+        # amortizes the ctypes round trip + JSON serialization over N
+        # steps (the ring holds HETU_TRAIL_RING spans — with ~a dozen RPCs
+        # per step that is thousands of steps of headroom), keeping
+        # always-on cost inside the <2% budget
+        self._trail_every = max(1, int(os.environ.get(
+            "HETU_TRAIL_DRAIN_EVERY", "64")))
+        trail_dir = _trail.armed()
+        if trail_dir is not None and hasattr(self.comm, "SetTrailStep"):
+            try:
+                self.trail_writer = _trail.TrailWriter(
+                    os.path.join(trail_dir,
+                                 f"trail-client-r{self.comm.rank}.jsonl"),
+                    self.comm.rank)
+                self.comm.SetTrailStep(0)
+            except OSError:
+                self.trail_writer = None  # unwritable dir: trail off
+        if hasattr(self.comm, "SetTrail"):
+            # explicit arm/disarm (the SetCommQuant pattern): the worker is
+            # a process singleton — an A/B of two executors must not
+            # inherit the other leg's ring state
+            self.comm.SetTrail(self.trail_writer is not None)
         ps_pkg._register_runtime(self)  # drained at worker_finish
 
     # ------------------------------------------------------------------
@@ -556,6 +586,24 @@ class PSRuntime:
                 self._dense_push_fut[id(p.node)] = fut
         return fut
 
+    def trail_step_boundary(self, step: int) -> None:
+        """hetutrail: drain the step's client spans into the trail file and
+        stamp the NEXT step id onto subsequent RPCs. Spans issued by async
+        pushes that land after the boundary carry the next step's stamp —
+        a documented one-step skew, matching the prefetch overlap they ride
+        with. Never raises."""
+        w = self.trail_writer
+        if w is None:
+            return
+        try:
+            self.comm.SetTrailStep(int(step) + 1)
+            if (int(step) + 1) % self._trail_every:
+                return   # off-cadence boundary: stamp only
+            with self._rpc_lock:
+                self._trail_mod.drain_client_spans(self.comm, w)
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
     def drain(self):
         """Complete all in-flight async PS traffic (checkpoint/fetch/shutdown
         boundaries)."""
@@ -575,6 +623,16 @@ class PSRuntime:
             self._io_pull.stop()
         self._io_push = self._io_pull = None
         self.async_enabled = False
+        if self.trail_writer is not None:
+            # final drain: the last (partial) step's spans, post-streams
+            try:
+                with self._rpc_lock:
+                    self._trail_mod.drain_client_spans(self.comm,
+                                                       self.trail_writer)
+            except Exception:  # noqa: BLE001
+                pass
+            self.trail_writer.close()
+            self.trail_writer = None
 
     # ------------------------------------------------------------------
     def save(self, directory: str):

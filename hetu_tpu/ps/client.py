@@ -40,6 +40,9 @@ def _load_lib():
     lib.SetWorldVersion.argtypes = [ctypes.c_ulonglong]
     lib.GetWorldVersion.restype = ctypes.c_ulonglong
     lib.RefreshServers.restype = ctypes.c_int
+    lib.SetTrailStep.argtypes = [ctypes.c_longlong]
+    lib.DrainTrailSpans.restype = ctypes.c_long
+    lib.TrailDropped.restype = ctypes.c_longlong
     return lib
 
 
@@ -65,6 +68,7 @@ class PSClient:
         # pinned staging buffers per tensor id: async Push/Pull contract
         # requires buffers to stay alive until Wait
         self._staging: dict[int, list] = {}
+        self._trail_buf = None   # reused drain buffer (DrainTrailSpans)
         # register as the process-wide worker communicator so components that
         # resolve it via ht.get_worker_communicate() (e.g. CacheSparseTable)
         # find this agent regardless of how it was constructed
@@ -197,6 +201,50 @@ class PSClient:
         lost-update accounting are untouched."""
         on = mode not in (0, False, None, "", "off")
         self._lib.SetCommQuant(ctypes.c_int(1 if on else 0))
+        self._check()
+
+    # -- hetutrail (docs/OBSERVABILITY.md pillar 5) -------------------------
+    def SetTrail(self, on):
+        """Arm/disarm the native client-span ring at runtime (the env
+        default is HETU_TRAIL_DIR at Init; A/Bs on one live worker need
+        the explicit toggle, like SetCommQuant). Disarming clears it."""
+        self._lib.SetTrail(ctypes.c_int(1 if on else 0))
+        self._check()
+
+    def SetTrailStep(self, step):
+        """Stamp the current training step onto this worker's subsequent
+        client RPC spans. The span context that crosses the wire stays the
+        existing (client_id, req_id) pair — step rides only in the local
+        span, so the wire format is unchanged."""
+        self._lib.SetTrailStep(ctypes.c_longlong(int(step)))
+
+    def DrainTrailSpans(self, max_rows=4096) -> np.ndarray:
+        """Drain up to ``max_rows`` client RPC spans from the native ring
+        (armed by HETU_TRAIL_DIR; always empty when off). Returns an
+        (n, 10) int64 array with columns ``trail.CLIENT_COLS``:
+        req_id, client_id, server, psf, tensor, step, t0_us (monotonic µs,
+        comparable with server spans on the same host), dur_us, req_bytes,
+        rsp_bytes. The array is a view of a REUSED buffer — consume it
+        before the next drain."""
+        buf = self._trail_buf
+        if buf is None or buf.shape[0] < int(max_rows):
+            buf = self._trail_buf = np.zeros((int(max_rows), 10), np.int64)
+        n = self._lib.DrainTrailSpans(buf.ctypes.data_as(_i64p),
+                                      ctypes.c_int(int(max_rows)))
+        self._check()
+        return buf[:max(0, int(n))]
+
+    def TrailDropped(self) -> int:
+        """Spans dropped because the bounded client ring was full."""
+        return int(self._lib.TrailDropped())
+
+    def TestSlowApply(self, server=0, ms=100):
+        """Test hook (requires HETU_TEST_MODE): delay PS server ``server``'s
+        NEXT optimizer apply by ``ms`` — the deterministic slow leg the
+        hetutrail critical-path and straggler tests attribute
+        (``ps_slow@step[:ms]`` in HETU_FAULT_SPEC)."""
+        self._lib.TestSlowApply(ctypes.c_int(int(server)),
+                                ctypes.c_int(int(ms)))
         self._check()
 
     def TestCorruptNextQuant(self, node=-1):

@@ -149,6 +149,13 @@ class FaultInjector:
       coordinator in a daemon thread; the executor's ElasticAgent
       drains/commits at the same boundary and the key ranges migrate
       live).
+    - ``ps_slow@S[:MS]`` — delay one PS server's NEXT optimizer apply by
+      MS milliseconds (default 100) at step S — the deterministic lever
+      the hetutrail critical-path and straggler tests drive
+      (docs/OBSERVABILITY.md pillar 5). The target server is
+      ``HETU_PS_SLOW_SERVER`` (default 0); the server-side hook
+      (``kTestSlowApply``) is additionally HETU_TEST_MODE-gated in capi
+      AND on the server.
 
     ``from_env()`` (the only path wired into the executor by default) returns
     None unless :func:`test_mode_enabled` — direct construction is itself an
@@ -156,7 +163,8 @@ class FaultInjector:
     """
 
     KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
-             "ps_kill", "quant_corrupt", "worker_lost", "ps_join")
+             "ps_kill", "quant_corrupt", "worker_lost", "ps_join",
+             "ps_slow")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -239,6 +247,13 @@ class FaultInjector:
         if e is not None:
             from .elastic import grow_local_cluster_server
             grow_local_cluster_server()
+        e = self.take("ps_slow", step)
+        if e is not None:
+            from . import ps as ps_pkg
+            comm = ps_pkg.get_worker_communicate()
+            comm.TestSlowApply(
+                server=int(os.environ.get("HETU_PS_SLOW_SERVER", "0")),
+                ms=100 if e["arg"] is None else int(e["arg"]))
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:

@@ -34,24 +34,78 @@ _shells: list = []
 _tel_dir: str = ""   # --telemetry-dir (run summary written at every exit)
 
 
+def _scan_rank_jsonl(tel_dir):
+    """Per-rank final step + the elastic world/resize history from the
+    rank JSONL files (including rotated ``.1`` backups): the post-mortem
+    of an elastic run should start from run_summary.json, not from
+    re-deriving the membership timeline by hand."""
+    import glob
+    import json
+    final_steps = {}
+    resizes = []
+    world_versions = set()
+    paths = sorted(glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl"))
+                   + glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl.1")))
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        # iterate, never slurp: an uncapped (HETU_TELEMETRY_MAX_MB unset)
+        # long-run rank file can be huge, and this runs in the launcher
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                rank = rec.get("rank")
+                if rec.get("kind") == "step" and "step" in rec:
+                    key = str(rank if rank is not None else "?")
+                    final_steps[key] = max(final_steps.get(key, -1),
+                                           int(rec["step"]))
+                elif rec.get("kind") == "event" and \
+                        str(rec.get("name", "")).startswith("resize"):
+                    ev = {k: rec.get(k) for k in
+                          ("ts", "name", "rank", "step", "world_version",
+                           "n_workers", "n_servers", "duration_ms")
+                          if rec.get(k) is not None}
+                    resizes.append(ev)
+                    if rec.get("world_version") is not None:
+                        world_versions.add(int(rec["world_version"]))
+    resizes.sort(key=lambda e: e.get("ts", 0))
+    return final_steps, resizes, sorted(world_versions)
+
+
 def _write_telemetry_summary(rc, preempted, num_workers):
     """Aggregate the run's per-rank telemetry files into one manifest
     (run_summary.json) in the shared directory — ranks already write
     metrics-r<N>.jsonl / trace-r<N>.json side by side (WORKER_ID keys the
-    file names), so the launcher's job is the closing inventory + outcome."""
+    file names), so the launcher's job is the closing inventory + outcome,
+    per-rank final steps, and the elastic resize/world-version history."""
     if not _tel_dir:
         return
     import glob
     import json
+    final_steps, resizes, world_versions = _scan_rank_jsonl(_tel_dir)
     summary = {
         "workers": num_workers,
         "exit_code": rc,
         "preempted": bool(preempted),
+        "final_steps": final_steps,
         "files": sorted(os.path.basename(p) for p in
                         glob.glob(os.path.join(_tel_dir, "*"))
                         if not p.endswith(".tmp")
                         and os.path.basename(p) != "run_summary.json"),
     }
+    if resizes:
+        summary["resizes"] = resizes
+        summary["world_versions"] = world_versions
     try:
         with open(os.path.join(_tel_dir, "run_summary.json"), "w") as f:
             json.dump(summary, f, indent=1)
@@ -189,6 +243,13 @@ def main(argv=None):
         env.setdefault("HETU_TELEMETRY", "metrics")
         # the PS supervisor runs in THIS process and reads the env directly
         os.environ["HETU_TELEMETRY_DIR"] = _tel_dir
+        # hetutrail (docs/OBSERVABILITY.md pillar 5): HETU_TRAIL=1 arms the
+        # PS-wire span rings for EVERY role, flushing next to the metrics
+        # files so hetutrail joins them from one directory
+        if os.environ.get("HETU_TRAIL", "").strip().lower() in (
+                "1", "true", "yes", "on"):
+            env.setdefault("HETU_TRAIL_DIR", _tel_dir)
+            os.environ.setdefault("HETU_TRAIL_DIR", _tel_dir)
     ps_ha = enable_ps and args.ps_max_respawns > 0 and len(hosts) == 1
     if enable_ps and args.ps_max_respawns > 0 and len(hosts) > 1:
         # don't let an operator believe HA is armed when it is not: the
@@ -359,6 +420,32 @@ def main(argv=None):
                 pending_departed.pop(r, None)
             return report
 
+        # hetutrail straggler watch (docs/OBSERVABILITY.md pillar 5): tail
+        # the rank JSONLs for cross-rank step skew; K-consecutive straggler
+        # events land in trail-events.jsonl and — under --elastic — reach
+        # the supervisor's ScalePolicy like any other pressure signal.
+        skew_mon = None
+        skew_next_poll = 0.0
+        if _tel_dir and num_workers > 1:
+            try:
+                from hetu_tpu.telemetry.trail import SkewMonitor
+
+                def _on_straggler(ev):
+                    print(f"# heturun: straggler rank {ev.get('rank')} @ "
+                          f"step {ev.get('step')}: {ev.get('step_ms')}ms vs "
+                          f"median {ev.get('median_ms')}ms",
+                          file=sys.stderr, flush=True)
+                    if ps_sup is not None and \
+                            getattr(ps_sup, "scale_policy", None) is not None:
+                        rec = ps_sup.scale_policy.note_straggler(ev)
+                        if rec is not None:
+                            scale_requests.append(rec)
+
+                skew_mon = SkewMonitor(_tel_dir, on_event=_on_straggler)
+            except Exception as e:  # noqa: BLE001 — watch is best-effort
+                print(f"# heturun: straggler watch off ({e!r})",
+                      file=sys.stderr)
+
         running = {w: spawn_worker(w) for w in range(num_workers)}
         respawn_at = {}   # worker id -> monotonic deadline (backoff pending)
         restarts, delay = 0, 2.0
@@ -474,6 +561,12 @@ def main(argv=None):
                 if now >= when:
                     del respawn_at[w]
                     running[w] = spawn_worker(w)
+            if skew_mon is not None and now >= skew_next_poll:
+                skew_next_poll = now + 2.0
+                try:
+                    skew_mon.poll()
+                except Exception:  # noqa: BLE001 — watch is best-effort
+                    pass
             if running or respawn_at:
                 time.sleep(0.2)
         if ps_sup is not None:

@@ -11,6 +11,10 @@ armed separately via ``HetuConfig(introspect=...)``):
   Perfetto-loadable, merged across ranks by ``bin/hetutrace``.
 - **Dashboards** — ``bin/hetutop`` tails the JSONL live;
   ``--check`` modes on both CLIs validate the schemas for CI.
+- **Distributed tracing** — hetutrail (:mod:`.trail`, pillar 5): PS-wire
+  client/server span rings joined by (client_id, req_id), per-step
+  critical-path attribution, straggler detection; armed separately by
+  ``HETU_TRAIL_DIR`` (``bin/hetutrail`` analyzes/validates).
 
 Activation contract (the zero-overhead-when-off design):
 

@@ -190,22 +190,28 @@ class Follower:
             buf = self._recs[path] = collections.deque(
                 maxlen=self.BUFFER)
         try:
-            size = os.path.getsize(path)
+            st = os.stat(path)
         except OSError:
             return buf
-        off = self._offsets.get(path, 0)
-        if size < off:            # truncated/rotated: start over
+        size = st.st_size
+        off, ino = self._offsets.get(path, (0, None))
+        # rotation (HETU_TELEMETRY_MAX_MB) is detected by inode change —
+        # size-only detection misses a fresh file refilled past the stale
+        # offset between frames
+        if (ino is not None and st.st_ino != ino) or size < off:
             off = 0
             buf.clear()
         if size == off:
+            self._offsets[path] = (off, st.st_ino)
             return buf
         with open(path, "rb") as f:
             f.seek(off)
             chunk = f.read()
         last_nl = chunk.rfind(b"\n")
         if last_nl < 0:           # partial tail line: retry next frame
+            self._offsets[path] = (off, st.st_ino)
             return buf
-        self._offsets[path] = off + last_nl + 1
+        self._offsets[path] = (off + last_nl + 1, st.st_ino)
         for raw in chunk[:last_nl].split(b"\n"):
             raw = raw.strip()
             if not raw:
@@ -482,6 +488,44 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
         if memb.get("hetu_resize_duration_ms") is not None:
             line += (f"  last resize "
                      f"{memb['hetu_resize_duration_ms']:.0f}ms")
+        lines.append(line)
+    # hetutrail (docs/OBSERVABILITY.md pillar 5): per-step blocking chain
+    # from the hetu_critical_path_ms{leg=...} gauges (latest-reporting
+    # rank) + cross-rank p50 skew straight from the rank table. Absent (no
+    # line) when the executor never exported critical-path gauges.
+    cp_rank = None
+    for rk in sorted(state["ranks"].values(),
+                     key=lambda r: r.get("last_ts") or 0):
+        if any(k.startswith("hetu_critical_path_ms")
+               for k in rk["metrics"]):
+            cp_rank = rk
+    if cp_rank is not None:
+        m = cp_rank["metrics"]
+        legs = {child.split("=", 1)[1]: _defloat(v) or 0.0
+                for child, v in _metric_children(
+                    m, "hetu_critical_path_ms", "") if "=" in child}
+        parts = [f"{leg} {legs[leg]:.2f}" for leg in
+                 ("feed", "ps_pull", "compute", "ps_push", "poststep")
+                 if leg in legs]
+        line = "trail: cp(ms) " + " | ".join(parts)
+        frac = _defloat(m.get("hetu_cp_fraction"))
+        if legs and frac is not None:
+            line += (f"  dominant {max(legs, key=legs.get)} "
+                     f"{100.0 * frac:.0f}%")
+        if len(state["ranks"]) > 1:
+            p50s = {r: rk["p50"] for r, rk in state["ranks"].items()}
+            slowest = max(p50s, key=p50s.get)
+            line += (f"  skew(p50) "
+                     f"{max(p50s.values()) - min(p50s.values()):.2f}ms "
+                     f"slowest r{slowest}")
+        stragglers = 0.0
+        for rk in state["ranks"].values():
+            for child, v in _metric_children(
+                    rk["metrics"], "hetu_events_total", ""):
+                if child == "event=straggler":
+                    stragglers += _defloat(v) or 0.0
+        if stragglers:
+            line += f"  stragglers {int(stragglers)}"
         lines.append(line)
     if state["ps"]:
         lines.append("PS servers:")

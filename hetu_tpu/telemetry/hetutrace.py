@@ -81,7 +81,18 @@ def check_file(path: str, out=sys.stdout) -> int:
 # ---------------------------------------------------------------------------
 
 def merge(inputs: list[str], out_path: str) -> str:
-    """Merge trace files (or expand directories) into one timeline."""
+    """Merge trace files (or expand directories) into one timeline.
+
+    Re-anchoring prefers each rank's MONOTONIC anchor
+    (``clock_anchor_mono_s`` — raw perf_counter = CLOCK_MONOTONIC on
+    Linux, counting from kernel boot) whenever every input carries one
+    and they all report the same kernel ``boot_id`` — the exact condition
+    under which monotonic origins coincide (hostnames can collide across
+    machines; boot ids cannot). An NTP step mid-run moves the wall
+    anchors but not the mono ones, so merged lanes stay aligned. Wall
+    anchors (``clock_anchor_unix_s``) remain the cross-boot fallback,
+    bounded by host clock skew as before.
+    """
     paths: list[str] = []
     for p in inputs:
         if os.path.isdir(p):
@@ -91,14 +102,20 @@ def merge(inputs: list[str], out_path: str) -> str:
     if not paths:
         raise FileNotFoundError(f"no trace files in {inputs}")
     docs = [(p, _load_doc(p)) for p in paths]
-    anchors = [d.get("otherData", {}).get("clock_anchor_unix_s")
-               for _, d in docs]
+    others = [d.get("otherData", {}) for _, d in docs]
+    monos = [o.get("clock_anchor_mono_s") for o in others]
+    boots = {o.get("boot_id") for o in others}
+    use_mono = (len(docs) > 1 and all(a is not None for a in monos)
+                and len(boots) == 1 and "" not in boots
+                and None not in boots)
+    anchors = monos if use_mono else \
+        [o.get("clock_anchor_unix_s") for o in others]
     base: Optional[float] = min((a for a in anchors if a is not None),
                                 default=None)
     events: list[dict] = []
     for idx, ((path, doc), anchor) in enumerate(zip(docs, anchors)):
         rank = doc.get("otherData", {}).get("rank", idx)
-        # re-anchor this rank's monotonic clock onto the earliest rank's
+        # re-anchor this rank's clock onto the earliest rank's
         shift_us = ((anchor - base) * 1e6
                     if anchor is not None and base is not None else 0.0)
         for ev in doc["traceEvents"]:
@@ -108,7 +125,9 @@ def merge(inputs: list[str], out_path: str) -> str:
                 ev["ts"] = round(ev["ts"] + shift_us, 1)
             events.append(ev)
     merged = {"displayTimeUnit": "ms",
-              "otherData": {"merged_from": [p for p, _ in docs]},
+              "otherData": {"merged_from": [p for p, _ in docs],
+                            "anchor_clock": ("monotonic" if use_mono
+                                             else "unix")},
               "traceEvents": events}
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:

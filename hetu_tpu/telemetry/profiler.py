@@ -480,7 +480,47 @@ def step_breakdown(phase_means: dict, attribution=None) -> dict:
     }
     if step > 0:
         out["comm_fraction"] = min(1.0, (coll + ps_rpc) / step)
+    # hetutrail critical path (trail.step_legs' decomposition, inlined so
+    # this module stays loadable by file path): who-blocked-whom per mean
+    # step, not just totals — the planner's calibration signal
+    legs = cp_legs(phase_means)
+    total = sum(legs.values())
+    if total > 0:
+        dom = max(legs, key=legs.get)
+        out["cp_legs_ms"] = {k: round(v, 4) for k, v in legs.items()}
+        out["cp_dominant"] = dom
+        out["cp_fraction"] = round(legs[dom] / total, 4)
     return out
+
+
+_TRAIL_MOD = None
+
+
+def _trail_mod():
+    """The hetutrail module, loadable BOTH ways this file is: as the
+    package module (tests) and by file path (bin/hetuprof, which must not
+    import the jax-bearing ``hetu_tpu`` package root) — the sibling
+    trail.py is stdlib-only, so file-path loading it is always safe."""
+    global _TRAIL_MOD
+    if _TRAIL_MOD is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "trail.py")
+        spec = importlib.util.spec_from_file_location("_hetuprof_trail",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hetuprof_trail"] = mod
+        spec.loader.exec_module(mod)
+        _TRAIL_MOD = mod
+    return _TRAIL_MOD
+
+
+def cp_legs(phase_means: dict) -> dict:
+    """The per-step blocking chain from mean phases — ONE definition,
+    ``trail.step_legs`` (feed → PS pull wait → compute → PS push →
+    poststep); zero-valued on runs that predate the ps_pull/ps_push phase
+    split."""
+    return _trail_mod().step_legs(phase_means)
 
 
 def profile_dir(tel_dir: str, trace_dir: Optional[str] = None,
@@ -661,13 +701,18 @@ class RooflineRow:
     predicted_us: float
     measured_us: Optional[float] = None
     residual: Optional[float] = None   # measured / predicted
+    # hetutrail: share of the step's measured blocking chain held by the
+    # leg this family executes in (compute for on-device families, the PS
+    # legs for boundary comm) — a 3x residual on a family at 90% of the
+    # critical path is a planner problem; the same residual at 2% is not
+    cp_fraction: Optional[float] = None
 
 
 def roofline_rows(nodes, training: bool = True, target: Optional[str] = None,
                   peak_tflops: float = DEFAULT_PEAK_TFLOPS,
                   peak_gbs: float = DEFAULT_PEAK_GBS,
-                  attribution: Optional[Attribution] = None
-                  ) -> List[RooflineRow]:
+                  attribution: Optional[Attribution] = None,
+                  cp: Optional[dict] = None) -> List[RooflineRow]:
     """Roofline classification per op family over a graph (eval-node list,
     topo, or Executor). Needs hetu_tpu — call sites that only gate/parse
     traces never reach here."""
@@ -744,6 +789,16 @@ def roofline_rows(nodes, training: bool = True, target: Optional[str] = None,
         for fam, agg in attribution.families().items():
             measured[fam] = agg["wall_us"] / attribution.steps
 
+    # hetutrail cp column: `cp` is a blocking-chain legs dict (profiler
+    # cp_legs / trail.step_legs output, typically from the measured run's
+    # telemetry dir). Families that execute at the PS boundary get the PS
+    # legs' share; everything else runs inside the dispatched program and
+    # gets the compute leg's.
+    cp_compute = cp_ps = None
+    cp_total = sum(cp.values()) if cp else 0.0
+    if cp and cp_total > 0:
+        cp_compute = cp.get("compute", 0.0) / cp_total
+        cp_ps = (cp.get("ps_pull", 0.0) + cp.get("ps_push", 0.0)) / cp_total
     ridge = (peak_tflops * 1e12) / (peak_gbs * 1e9)   # flops per byte
     rows = []
     for fam, f in fams.items():
@@ -751,12 +806,19 @@ def roofline_rows(nodes, training: bool = True, target: Optional[str] = None,
         pred_us = max(f["flops"] / (peak_tflops * 1e12),
                       f["bytes"] / (peak_gbs * 1e9)) * 1e6
         m = measured.get(fam)
+        cp_frac = None
+        if cp_compute is not None:
+            is_ps = any(t in fam.lower()
+                        for t in ("embeddinglookup", "embedding_lookup",
+                                  "parameterserver", "allreduce", "comm"))
+            cp_frac = round(cp_ps if is_ps else cp_compute, 4)
         rows.append(RooflineRow(
             family=fam, n_ops=f["n_ops"], flops=f["flops"],
             bytes=f["bytes"], intensity=inten,
             bound="compute" if inten >= ridge else "memory",
             predicted_us=pred_us, measured_us=m,
-            residual=(m / pred_us) if (m and pred_us > 0) else None))
+            residual=(m / pred_us) if (m and pred_us > 0) else None,
+            cp_fraction=cp_frac))
     rows.sort(key=lambda r: -r.predicted_us)
     return rows
 
@@ -770,7 +832,9 @@ def format_roofline(rows: List[RooflineRow],
              "assumptions, not readings)",
              f"{'family':<22} {'ops':>4} {'GFLOP/step':>11} {'MB/step':>9} "
              f"{'flop/B':>8} {'bound':>8} {'pred us':>9} {'meas us':>9} "
-             f"{'resid':>6}"]
+             f"{'resid':>6}"
+             + ("  cp_frac" if any(r.cp_fraction is not None
+                                   for r in rows) else "")]
     for r in rows:
         lines.append(
             f"{r.family[:22]:<22} {r.n_ops:>4} {r.flops / 1e9:>11.3f} "
@@ -778,7 +842,9 @@ def format_roofline(rows: List[RooflineRow],
             f"{min(r.intensity, 1e6):>8.1f} {r.bound:>8} "
             f"{r.predicted_us:>9.1f} "
             f"{r.measured_us if r.measured_us is not None else float('nan'):>9.1f} "
-            f"{r.residual if r.residual is not None else float('nan'):>6.2f}")
+            f"{r.residual if r.residual is not None else float('nan'):>6.2f}"
+            + (f"  {r.cp_fraction:>7.3f}" if r.cp_fraction is not None
+               else ""))
     tf = sum(r.flops for r in rows)
     tb = sum(r.bytes for r in rows)
     tp = max(tf / (peak_tflops * 1e12), tb / (peak_gbs * 1e9)) * 1e6
@@ -1093,6 +1159,10 @@ def main(argv=None) -> int:
                     help="with --gate: self-check the exit-code contract "
                          "(CI smoke, no files needed)")
     ap.add_argument("--trace-dir", help="XLA profiler dir override")
+    ap.add_argument("--cp-from", metavar="TEL_DIR",
+                    help="with --roofline: telemetry dir whose measured "
+                         "critical-path legs fill the cp_frac column "
+                         "(hetutrail, docs/OBSERVABILITY.md pillar 5)")
     ap.add_argument("--hlo", help="optimized-HLO text file for the exact "
                                   "instruction->op join")
     ap.add_argument("--steps", type=int, help="steps in the trace window "
@@ -1146,9 +1216,13 @@ def main(argv=None) -> int:
             if events:
                 attribution = attribute(events, op_map=op_map,
                                         steps=args.steps)
+        cp = None
+        if args.cp_from:
+            means = step_phase_means(read_metrics_records(args.cp_from))
+            cp = cp_legs(means) if means else None
         rows = roofline_rows(list(graph), peak_tflops=args.peak_tflops,
                              peak_gbs=args.peak_gbs,
-                             attribution=attribution)
+                             attribution=attribution, cp=cp)
         if args.as_json:
             print(json.dumps([r.__dict__ for r in rows], indent=2))
         else:
@@ -1172,6 +1246,12 @@ def main(argv=None) -> int:
               f" + ps-rpc {b['ps_rpc_ms']:.2f} + host {b['host_ms']:.2f}"
               + (f"  (comm fraction {b['comm_fraction']:.1%})"
                  if "comm_fraction" in b else ""))
+        if "cp_dominant" in b:
+            legs = "  ".join(f"{k}={v:.2f}" for k, v in
+                             b["cp_legs_ms"].items())
+            print(f"critical path (hetutrail): {legs} ms — dominant "
+                  f"{b['cp_dominant']} at {b['cp_fraction']:.1%} of the "
+                  "blocking chain")
     if report["memory"]:
         mem = report["memory"]
         parts = [f"{k.replace('hetu_hbm_', '').replace('_bytes', '')} "

@@ -258,13 +258,33 @@ class JsonlSink:
     Every record gains ``ts`` (unix seconds) and the writer's identity
     fields. Flushes at most every ``flush_s`` seconds on write, plus on
     ``close`` — crash-durability for the resilience events comes from the
-    explicit ``flush()`` those call sites do before aborting."""
+    explicit ``flush()`` those call sites do before aborting.
+
+    Long-run growth is bounded by ``HETU_TELEMETRY_MAX_MB`` (default off,
+    for test stability): when the live file exceeds the cap at a record
+    boundary it rotates — the current file is atomically renamed to
+    ``<path>.1`` (replacing the previous backup) and a fresh file opens at
+    the same path. Readers stay valid through the flip: a tailer holding
+    the old fd keeps a complete file; offset-based followers (hetutop's
+    Follower, trail's SkewMonitor) observe size < offset and restart, and
+    ``--check`` globs never match the ``.1`` backup."""
 
     def __init__(self, path: str, base_fields: Optional[dict] = None,
-                 flush_s: float = 1.0):
+                 flush_s: float = 1.0, max_mb: Optional[float] = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
+        if max_mb is None:
+            try:
+                max_mb = float(os.environ.get("HETU_TELEMETRY_MAX_MB",
+                                              "0") or 0)
+            except ValueError:
+                max_mb = 0.0
+        self._max_bytes = int(max_mb * 1e6) if max_mb and max_mb > 0 else 0
         self._f = open(path, "a")
+        try:
+            self._nbytes = os.path.getsize(path)
+        except OSError:
+            self._nbytes = 0
         self._base = dict(base_fields or {})
         # identity fields serialized once: the per-step fast path
         # (write_fields) splices this fragment instead of re-dumping the
@@ -295,10 +315,32 @@ class JsonlSink:
             if self._f.closed:
                 return  # late writer (atexit ordering); drop, don't raise
             self._f.write(line)
+            self._nbytes += len(line)
+            if self._max_bytes and self._nbytes >= self._max_bytes:
+                self._rotate_locked()
             now = time.monotonic()
             if now - self._last_flush >= self._flush_s:
                 self._f.flush()
                 self._last_flush = now
+
+    def _rotate_locked(self) -> None:
+        """Atomic rollover (caller holds the lock): flush, rename the live
+        file onto the single ``.1`` backup, reopen fresh. Any failure
+        leaves the current file in place and disables rotation rather than
+        losing records."""
+        try:
+            self._f.flush()
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a")
+            self._nbytes = 0
+        except OSError:
+            self._max_bytes = 0
+            if self._f.closed:   # reopen (append) so writes keep landing
+                try:
+                    self._f = open(self.path, "a")
+                except OSError:
+                    pass
 
     def flush(self) -> None:
         with self._lock:

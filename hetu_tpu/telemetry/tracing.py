@@ -24,15 +24,36 @@ import threading
 import time
 from typing import Optional
 
-# trace clock: perf_counter in µs, plus the unix anchor recorded in metadata
-# (perf_counter is monotonic across threads of one process; cross-rank skew
-# is bounded by host clock skew and only affects lane alignment, not spans)
+# trace clock: perf_counter in µs, with BOTH anchors recorded in metadata —
+# the unix wall clock and the raw perf_counter value. On Linux perf_counter
+# reads CLOCK_MONOTONIC (since boot, shared by every process on a host), so
+# hetutrace's merge can re-anchor same-host ranks on the monotonic deltas:
+# an NTP step mid-run moves the wall anchors but not the mono ones, which is
+# exactly the bug class that bit the PR 4 req_id seeding. Cross-HOST merges
+# fall back to the wall anchors (mono origins differ per boot) — the host
+# name rides along so the merge can tell.
 _T0_PERF = time.perf_counter()
 _T0_UNIX = time.time()
 
 # jax.profiler.StepTraceAnnotation, resolved lazily on first use
 # (None = unresolved, False = jax unavailable — stay stdlib-importable)
 _STEP_ANNOT = None
+
+
+try:
+    _HOST = os.uname().nodename
+except (AttributeError, OSError):  # non-POSIX fallback
+    _HOST = "localhost"
+
+# the CORRECT mono-comparability key: CLOCK_MONOTONIC counts from kernel
+# boot, and the kernel's boot_id uniquely names that boot — two processes
+# share a monotonic origin iff they share it (containers with identical
+# image hostnames do; distinct machines never do, whatever their names)
+try:
+    with open("/proc/sys/kernel/random/boot_id") as _f:
+        _BOOT_ID = _f.read().strip()
+except OSError:
+    _BOOT_ID = ""   # non-Linux: merge falls back to wall anchors
 
 
 def _now_us() -> float:
@@ -158,6 +179,9 @@ class Tracer:
         wins, instead of interleaving writes into one shared .tmp."""
         with self._lock:
             other = {"clock_anchor_unix_s": round(_T0_UNIX, 3),
+                     "clock_anchor_mono_s": round(_T0_PERF, 6),
+                     "host": _HOST,
+                     "boot_id": _BOOT_ID,
                      "rank": self.rank}
             if self.dropped:
                 other["dropped_events"] = self.dropped

@@ -1,0 +1,897 @@
+"""hetutrail — cross-process distributed tracing over the PS wire, per-step
+critical-path attribution, and straggler/skew detection (observability
+pillar 5, docs/OBSERVABILITY.md).
+
+Three cooperating pieces, all stdlib-only (the CLI runs on a login node or
+in CI without jax):
+
+- **Span plumbing.** Workers stamp every PS RPC with a span context — the
+  existing ``(client_id, req_id)`` pair from the PR 4 resend-dedup/
+  incarnation machinery IS the context, so the wire format is unchanged.
+  The native worker keeps a bounded ring of client RPC spans
+  (``csrc/ps/worker.h``), drained here into
+  ``trail-client-r<rank>.jsonl``; each server keeps a bounded ring of
+  per-request timelines (recv → queue/lock wait → apply → respond,
+  ``csrc/ps/server.h``) flushed as ``trail-server-s<rank>.jsonl``.
+  :func:`join_spans` matches them by ``(client_id, req_id)`` into
+  parent-child flows. Both sides timestamp with CLOCK_MONOTONIC
+  (``trail_mono_us``), shared by every process on a host — immune to the
+  NTP steps that bit the PR 4 req_id seeding.
+- **Critical-path attribution.** :func:`step_legs` decomposes a step
+  record's phases into the blocking chain (feed → PS pull wait → compute
+  → PS push → poststep); :func:`dominant` names the longest leg; for PS
+  legs :func:`attribute_step` names the specific server and param from
+  the joined spans. The executor exports ``hetu_critical_path_ms{leg=…}``
+  and ``hetu_cp_fraction`` gauges per step via
+  :func:`export_critical_path`.
+- **Straggler/skew detection.** :class:`StragglerDetector` turns per-step
+  per-rank step times into K-consecutive straggler events;
+  :class:`SkewMonitor` tails a telemetry directory's per-rank JSONL,
+  exports ``hetu_step_skew_ms`` / ``hetu_straggler_rank``, and emits the
+  events through the resilience event bus (``telemetry.event``) so
+  elastic's ``ScalePolicy.note_straggler`` can act on them.
+
+Activation: everything is armed by ``HETU_TRAIL_DIR`` (the telemetry dir
+is the natural value; ``heturun --telemetry-dir`` + ``HETU_TRAIL=1`` sets
+it for every role). Off — the default — costs one attribute/env check per
+step and one relaxed atomic load per RPC, nothing else.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from typing import Callable, Iterable, Optional
+
+# i64 row layout of csrc/ps/worker.h drain_trail (capi DrainTrailSpans)
+CLIENT_COLS = ("req_id", "client", "server", "psf", "tensor", "step",
+               "t0_us", "dur_us", "req_bytes", "rsp_bytes")
+
+# the blocking chain, in step order (docs/OBSERVABILITY.md pillar 5);
+# "compute" is the jit dispatch window, which contains in-program AllReduce
+# — hetuprof splits the collective share out of it offline
+LEGS = ("feed", "ps_pull", "compute", "ps_push", "poststep")
+
+# PsfType names for reports (csrc/ps/net.h); unknown ids print as the int
+PSF_NAMES = {
+    7: "server_stats", 10: "dense_push", 11: "dense_pull", 12: "dd_pushpull",
+    20: "sparse_push", 21: "sparse_pull", 22: "sd_pushpull",
+    23: "ss_pushpull", 30: "param_init", 34: "param_assign",
+    35: "param_assign_rows", 40: "sync_embedding", 41: "push_embedding",
+    42: "push_sync_embedding", 50: "data_push", 51: "data_pull",
+    70: "test_slow_apply",
+}
+
+
+def _active_telemetry():
+    """The process's live Telemetry or None. Tolerates file-path loading
+    (bin/hetutrail runs this module packageless, where the relative import
+    has no parent)."""
+    try:
+        from . import get as _tel_get
+    except ImportError:
+        return None
+    return _tel_get()
+
+
+def armed() -> Optional[str]:
+    """The trail output directory, or None when trail is off (the single
+    gate every Python-side call site checks)."""
+    d = os.environ.get("HETU_TRAIL_DIR", "")
+    return d or None
+
+
+def mono_us() -> int:
+    """CLOCK_MONOTONIC µs — the same clock as the native spans'
+    ``trail_mono_us`` (CPython's time.monotonic on Linux)."""
+    return int(time.monotonic() * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# span plumbing: writer + drain + loaders + join
+# ---------------------------------------------------------------------------
+
+class TrailWriter:
+    """Append-only JSONL writer for one rank's client spans. The first line
+    of each file generation is an anchor pairing this process's monotonic
+    clock with the wall clock (spans themselves carry only monotonic
+    stamps).
+
+    Bounded like every other always-on trail surface: past
+    ``HETU_TRAIL_MAX_MB`` (default 512) the file rotates to one ``.1``
+    backup (atomic rename, fresh anchor in the new generation), so a
+    week-long armed run holds at most two generations per rank."""
+
+    def __init__(self, path: str, rank: int, max_mb: Optional[float] = None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.rank = int(rank)
+        if max_mb is None:
+            try:
+                max_mb = float(os.environ.get("HETU_TRAIL_MAX_MB",
+                                              "512") or 0)
+            except ValueError:
+                max_mb = 512.0
+        self._max_bytes = int(max_mb * 1e6) if max_mb > 0 else 0
+        self._f = open(path, "a")
+        try:
+            self._nbytes = os.path.getsize(path)
+        except OSError:
+            self._nbytes = 0
+        self._write_anchor()
+
+    def _write_anchor(self) -> None:
+        line = json.dumps(
+            {"kind": "anchor", "rank": self.rank, "mono_us": mono_us(),
+             "wall_s": round(time.time(), 3)},
+            separators=(",", ":")) + "\n"
+        self._f.write(line)
+        self._nbytes += len(line)
+        self._f.flush()
+
+    def write_rows(self, rows: Iterable) -> int:
+        n = 0
+        nbytes = 0
+        rank = self.rank
+        write = self._f.write
+        for row in rows:
+            # direct f-string: every field is an int and the keys are
+            # fixed, and json.dumps over a built dict measured ~8x this
+            # (the drain rides the step boundary, so per-row cost is the
+            # trail overhead budget)
+            r = [int(v) for v in row]
+            nbytes += write(
+                f'{{"kind":"rpc","rank":{rank},"req_id":{r[0]},'
+                f'"client":{r[1]},"server":{r[2]},"psf":{r[3]},'
+                f'"tensor":{r[4]},"step":{r[5]},"t0_us":{r[6]},'
+                f'"dur_us":{r[7]},"req_bytes":{r[8]},'
+                f'"rsp_bytes":{r[9]}}}\n')
+            n += 1
+        if n:
+            self._f.flush()
+            self._nbytes += nbytes
+            if self._max_bytes and self._nbytes >= self._max_bytes:
+                self._rotate()
+        return n
+
+    def write_dropped(self, n: int) -> None:
+        """Record ring overflow (the client twin of the server writer's
+        ``dropped`` records): without it a saturated ring silently
+        deflates span counts and skews per-server attribution."""
+        line = json.dumps({"kind": "dropped", "rank": self.rank,
+                           "n": int(n)}, separators=(",", ":")) + "\n"
+        self._f.write(line)
+        self._nbytes += len(line)
+        self._f.flush()
+
+    def _rotate(self) -> None:
+        """Atomic rollover to one .1 backup (the JsonlSink convention);
+        failures leave the live file in place and disable rotation rather
+        than losing spans."""
+        try:
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a")
+            self._nbytes = 0
+            self._write_anchor()
+        except OSError:
+            self._max_bytes = 0
+            if self._f.closed:
+                try:
+                    self._f = open(self.path, "a")
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+
+def drain_client_spans(comm, writer: TrailWriter, batch: int = 4096) -> int:
+    """Drain the native client-span ring through ``comm``
+    (:class:`~hetu_tpu.ps.client.PSClient`) into ``writer``; returns the
+    span count. Never raises — span drain must not take training down."""
+    total = 0
+    try:
+        while True:
+            rows = comm.DrainTrailSpans(batch)
+            if not len(rows):
+                break
+            total += writer.write_rows(rows)
+            if len(rows) < batch:
+                break
+        # surface ring overflow next to the spans (cumulative native
+        # counter -> per-writer delta), like the server-side records
+        dropped = int(comm.TrailDropped())
+        seen = getattr(writer, "_dropped_seen", 0)
+        if dropped > seen:
+            writer.write_dropped(dropped - seen)
+            writer._dropped_seen = dropped
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+    return total
+
+
+def _read_jsonl(path: str) -> list:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line of a live run
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def load_dir(dir_path: str) -> dict:
+    """Everything hetutrail needs from one directory: client spans, server
+    spans, anchors, drop counters, and the per-step metrics records (the
+    phases the critical path decomposes)."""
+    client, server, anchors = [], [], []
+    dropped = dropped_client = 0
+    for p in sorted(glob.glob(os.path.join(dir_path,
+                                           "trail-client-r*.jsonl"))):
+        for rec in _read_jsonl(p):
+            if rec.get("kind") == "rpc":
+                client.append(rec)
+            elif rec.get("kind") == "anchor":
+                anchors.append(rec)
+            elif rec.get("kind") == "dropped":
+                dropped_client += int(rec.get("n", 0))
+    for p in sorted(glob.glob(os.path.join(dir_path,
+                                           "trail-server-s*.jsonl"))):
+        for rec in _read_jsonl(p):
+            if rec.get("kind") == "srv":
+                server.append(rec)
+            elif rec.get("kind") == "anchor":
+                anchors.append(rec)
+            elif rec.get("kind") == "dropped":
+                dropped += int(rec.get("n", 0))
+    steps: dict = {}
+    for p in sorted(glob.glob(os.path.join(dir_path, "metrics-r*.jsonl"))):
+        for rec in _read_jsonl(p):
+            if rec.get("kind") == "step" and "step" in rec:
+                steps[(int(rec.get("rank", 0)), int(rec["step"]))] = rec
+    return {"client": client, "server": server, "anchors": anchors,
+            "dropped": dropped, "dropped_client": dropped_client,
+            "steps": steps}
+
+
+def join_spans(client: list, server: list):
+    """Match client RPC spans to server request timelines by the span
+    context that rode the wire: ``(client_id, req_id)``. Returns
+    ``(joined, join_rate)`` — each joined record is the client span plus a
+    ``srv`` field (None when unmatched); rate is None with no client
+    spans. Duplicates (failover re-issues) keep the first server record."""
+    srv_by: dict = {}
+    for s in server:
+        key = (int(s.get("client", -1)), int(s.get("req_id", 0)))
+        srv_by.setdefault(key, s)
+    joined = []
+    matched = 0
+    for c in client:
+        s = srv_by.get((int(c.get("client", -1)), int(c.get("req_id", 0))))
+        if s is not None:
+            matched += 1
+        joined.append({**c, "srv": s})
+    rate = (matched / len(client)) if client else None
+    return joined, rate
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+def step_legs(phases: dict) -> dict:
+    """Decompose one step record's phases into the blocking chain. The
+    executor's prestep contains the PS pull wait and its poststep the PS
+    push issue; both are measured separately (``ps_pull_ms`` /
+    ``ps_push_ms``) so the non-PS remainder is feed/bookkeeping."""
+    prestep = float(phases.get("prestep_ms", 0.0))
+    dispatch = float(phases.get("dispatch_ms", 0.0))
+    poststep = float(phases.get("poststep_ms", 0.0))
+    pull = float(phases.get("ps_pull_ms", 0.0))
+    push = float(phases.get("ps_push_ms", 0.0))
+    return {"feed": max(0.0, prestep - pull), "ps_pull": pull,
+            "compute": dispatch, "ps_push": push,
+            "poststep": max(0.0, poststep - push)}
+
+
+def dominant(legs: dict):
+    """(leg name, fraction of the chain) for the longest blocking leg;
+    (None, 0.0) for an all-zero chain."""
+    total = sum(legs.values())
+    if total <= 0.0:
+        return None, 0.0
+    leg = max(legs, key=legs.get)
+    return leg, legs[leg] / total
+
+
+def export_critical_path(metrics, legs: dict, cache: Optional[dict] = None):
+    """Set the per-step ``hetu_critical_path_ms{leg=…}`` gauges and the
+    ``hetu_cp_fraction`` gauge (dominant leg's share of the blocking
+    chain) on a live registry. ``cache`` avoids the labeled-gauge lookup
+    on the hot path. Returns (dominant leg, fraction)."""
+    if cache is not None:
+        gauges = cache.get("cp_gauges")
+        if gauges is None:
+            gauges = cache["cp_gauges"] = {
+                leg: metrics.gauge("hetu_critical_path_ms", {"leg": leg})
+                for leg in LEGS}
+            cache["cp_fraction"] = metrics.gauge("hetu_cp_fraction")
+        for leg, g in gauges.items():
+            g.set(legs.get(leg, 0.0))
+        frac_g = cache["cp_fraction"]
+    else:
+        for leg in LEGS:
+            metrics.gauge("hetu_critical_path_ms",
+                          {"leg": leg}).set(legs.get(leg, 0.0))
+        frac_g = metrics.gauge("hetu_cp_fraction")
+    dom, frac = dominant(legs)
+    frac_g.set(frac)
+    return dom, frac
+
+
+def _ps_attribution(joined: list, step: int, rank: Optional[int] = None):
+    """For one step's PS leg: per-server and per-param blocking time from
+    the joined spans (server-side queue+handle when joined, client
+    round-trip otherwise). The window includes spans stamped with the
+    PRECEDING step too: an async push queued at the previous boundary is
+    exactly the in-flight work a blocked pull waits on, and its stamp
+    races the boundary's step advance by design."""
+    by_server: dict = {}
+    by_tensor: dict = {}
+    window = (int(step) - 1, int(step))
+    for c in joined:
+        if int(c.get("step", -1)) not in window:
+            continue
+        if rank is not None and int(c.get("rank", 0)) != int(rank):
+            continue
+        s = c.get("srv")
+        us = (int(s["q_us"]) + int(s["handle_us"]) + int(s["send_us"])
+              if s is not None else int(c.get("dur_us", 0)))
+        by_server[int(c["server"])] = by_server.get(int(c["server"]), 0) + us
+        t = int(c.get("tensor", -1))
+        if t >= 0:
+            by_tensor[t] = by_tensor.get(t, 0) + us
+    return by_server, by_tensor
+
+
+def attribute_step(loaded: dict, step: int) -> dict:
+    """Per-rank critical-path verdict for one step: the legs, the dominant
+    leg, and — when a PS leg dominates — the specific server and param it
+    blocked on. ``loaded`` is :func:`load_dir` output."""
+    joined, rate = join_spans(loaded["client"], loaded["server"])
+    out: dict = {"step": int(step), "join_rate": rate, "ranks": {}}
+    for (rank, s), rec in sorted(loaded["steps"].items()):
+        if s != int(step):
+            continue
+        legs = step_legs(rec.get("phases") or {})
+        dom, frac = dominant(legs)
+        entry = {"legs": {k: round(v, 3) for k, v in legs.items()},
+                 "dominant": dom, "fraction": round(frac, 4),
+                 "step_ms": rec.get("step_ms")}
+        if dom in ("ps_pull", "ps_push"):
+            by_server, by_tensor = _ps_attribution(joined, step, rank)
+            if by_server:
+                top = max(by_server, key=by_server.get)
+                entry["server"] = top
+                entry["server_ms"] = round(by_server[top] / 1e3, 3)
+                entry["servers_ms"] = {k: round(v / 1e3, 3)
+                                       for k, v in sorted(by_server.items())}
+            if by_tensor:
+                tt = max(by_tensor, key=by_tensor.get)
+                entry["tensor"] = tt
+                entry["tensor_ms"] = round(by_tensor[tt] / 1e3, 3)
+        out["ranks"][rank] = entry
+    return out
+
+
+# ---------------------------------------------------------------------------
+# straggler / skew
+# ---------------------------------------------------------------------------
+
+class StragglerDetector:
+    """K-consecutive straggler events from per-step per-rank step times.
+
+    A rank straggles on a step when its time exceeds ``ratio`` × the median
+    of the other ranks by at least ``min_ms`` (the floor keeps µs-scale
+    noise on fast steps from counting). ``k`` consecutive straggling steps
+    fire ONE event, then the streak restarts — a persistently slow rank
+    re-fires every k steps, which is the cadence a ScalePolicy wants."""
+
+    def __init__(self, k: int = 3, ratio: float = 1.5, min_ms: float = 1.0):
+        self.k = max(1, int(k))
+        self.ratio = float(ratio)
+        self.min_ms = float(min_ms)
+        self._streak: dict = {}
+
+    def observe(self, step: int, rank_ms: dict) -> Optional[dict]:
+        if len(rank_ms) < 2:
+            return None
+        worst = max(rank_ms, key=rank_ms.get)
+        others = [v for r, v in rank_ms.items() if r != worst]
+        med = statistics.median(others)
+        is_straggler = (rank_ms[worst] > self.ratio * med
+                        and rank_ms[worst] - med >= self.min_ms)
+        for r in list(self._streak):
+            if r != worst or not is_straggler:
+                self._streak.pop(r, None)
+        if not is_straggler:
+            return None
+        streak = self._streak.get(worst, 0) + 1
+        if streak < self.k:
+            self._streak[worst] = streak
+            return None
+        self._streak.pop(worst, None)
+        return {"kind": "straggler", "rank": int(worst), "step": int(step),
+                "step_ms": round(rank_ms[worst], 3),
+                "median_ms": round(med, 3), "streak": self.k,
+                "n_ranks": len(rank_ms)}
+
+
+class SkewMonitor:
+    """Tail a telemetry directory's per-rank step records, compute
+    cross-rank per-step skew, and emit straggler events.
+
+    Incremental (byte offsets per file, like hetutop's Follower) so a
+    supervisor can poll it cheaply. Exports ``hetu_step_skew_ms`` and
+    ``hetu_straggler_rank`` (-1 = none) when telemetry is active in the
+    polling process; events go through the resilience event bus
+    (``telemetry.event("straggler", …)``), into ``trail-events.jsonl``
+    next to the rank files, and to ``on_event`` (how heturun hands them to
+    elastic's ScalePolicy).
+
+    When the straggling rank's blocking chain at the event step is
+    PS-dominated, the event is enriched with the blocking ``server`` (top
+    server by round-trip time over that rank's recent client spans, when
+    trail files sit in the same directory) and ``n_servers`` — the shape
+    ``ScalePolicy.note_straggler`` turns into a grow recommendation. A
+    compute-bound straggler stays a rank-level event: more PS servers
+    would not fix it."""
+
+    # recent client spans kept per rank for event attribution
+    _SPAN_WINDOW = 4096
+
+    def __init__(self, dir_path: str,
+                 detector: Optional[StragglerDetector] = None,
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 write_events: bool = True):
+        import collections
+        self.dir = dir_path
+        self.detector = detector or StragglerDetector()
+        self.on_event = on_event
+        self.write_events = write_events
+        self._offsets: dict = {}
+        self._pending: dict = {}    # step -> {rank: step_ms}
+        self._phases: dict = {}     # (step, rank) -> phases (bounded below)
+        self._spans: dict = {}      # rank -> deque[(step, server, dur_us)]
+        self._deque = collections.deque
+        self._done_through = -1
+        self.last_skew_ms: Optional[float] = None
+        self.last_slowest: Optional[int] = None
+        self.events: list = []
+
+    def _tail(self, path: str) -> list:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return []
+        size = st.st_size
+        off, ino = self._offsets.get(path, (0, None))
+        # rotation detection must be by inode, not just size < offset: a
+        # hot writer can refill the fresh file past the stale offset
+        # between polls, which would silently skip its head
+        if ino is not None and st.st_ino != ino:
+            off = 0
+        if size < off:          # truncated in place: restart
+            off = 0
+        if size == off:
+            self._offsets[path] = (off, st.st_ino)
+            return []
+        with open(path, "rb") as f:
+            f.seek(off)
+            chunk = f.read()
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            self._offsets[path] = (off, st.st_ino)
+            return []
+        self._offsets[path] = (off + last_nl + 1, st.st_ino)
+        out = []
+        for raw in chunk[:last_nl].split(b"\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def poll(self) -> list:
+        """Ingest new records; returns the straggler events fired by this
+        poll (also accumulated on ``self.events``)."""
+        files = sorted(glob.glob(os.path.join(self.dir,
+                                              "metrics-r*.jsonl")))
+        n_ranks = len(files)
+        for p in files:
+            for rec in self._tail(p):
+                if rec.get("kind") != "step" or "step" not in rec:
+                    continue
+                s = int(rec["step"])
+                if s <= self._done_through:
+                    continue
+                rank = int(rec.get("rank", 0))
+                self._pending.setdefault(s, {})[rank] = \
+                    float(rec.get("step_ms", 0.0))
+                if rec.get("phases"):
+                    self._phases[(s, rank)] = rec["phases"]
+        # client spans (same dir when HETU_TRAIL_DIR = telemetry dir):
+        # the attribution source for PS-blocked straggler events
+        for p in glob.glob(os.path.join(self.dir, "trail-client-r*.jsonl")):
+            for rec in self._tail(p):
+                if rec.get("kind") != "rpc":
+                    continue
+                rank = int(rec.get("rank", 0))
+                dq = self._spans.get(rank)
+                if dq is None:
+                    dq = self._spans[rank] = self._deque(
+                        maxlen=self._SPAN_WINDOW)
+                dq.append((int(rec.get("step", -1)),
+                           int(rec.get("server", -1)),
+                           int(rec.get("dur_us", 0))))
+        fired = []
+        for s in sorted(self._pending):
+            if s <= self._done_through:   # acted while this rank lagged
+                del self._pending[s]
+                continue
+            ranks = self._pending[s]
+            # act once every reporting rank landed; a step more than one
+            # WINDOW behind the newest acts with whoever reported (a rank
+            # that stopped writing must not wedge detection forever)
+            newest = max(self._pending)
+            if len(ranks) < n_ranks and newest - s < 64:
+                continue
+            del self._pending[s]
+            self._done_through = max(self._done_through, s)
+            if len(ranks) >= 2:
+                vals = list(ranks.values())
+                self.last_skew_ms = max(vals) - min(vals)
+                slowest = max(ranks, key=ranks.get)
+                self.last_slowest = slowest
+                self._export_gauges(none=False)
+                ev = self.detector.observe(s, ranks)
+                if ev is not None:
+                    self._attribute(ev)
+                    fired.append(ev)
+            for r in ranks:   # every acted step releases its phase rows
+                self._phases.pop((s, r), None)
+        for ev in fired:
+            self.events.append(ev)
+            self._emit(ev)
+        return fired
+
+    def _attribute(self, ev: dict) -> None:
+        """Attach the blocking PS server to a straggler event whose
+        dominant leg is a PS leg (see the class docstring). Mutates
+        ``ev`` in place; a compute-bound straggler is left rank-level."""
+        phases = self._phases.get((ev["step"], ev["rank"]))
+        if not phases:
+            return
+        dom, _ = dominant(step_legs(phases))
+        if dom not in ("ps_pull", "ps_push"):
+            return
+        lo = ev["step"] - self.detector.k
+        by_server: dict = {}
+        for step, server, dur_us in self._spans.get(ev["rank"], ()):
+            if lo <= step <= ev["step"] and server >= 0:
+                by_server[server] = by_server.get(server, 0) + dur_us
+        if not by_server:
+            return
+        ev["server"] = max(by_server, key=by_server.get)
+        ev["n_servers"] = len(by_server)
+
+    def _export_gauges(self, none: bool) -> None:
+        tel = _active_telemetry()
+        if tel is None:
+            return
+        try:
+            tel.metrics.gauge("hetu_step_skew_ms").set(
+                0.0 if none else (self.last_skew_ms or 0.0))
+            tel.metrics.gauge("hetu_straggler_rank").set(
+                -1 if none or self.last_slowest is None
+                else self.last_slowest)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _emit(self, ev: dict) -> None:
+        tel = _active_telemetry()
+        if tel is not None:
+            try:
+                tel.event("straggler", **{k: v for k, v in ev.items()
+                                          if k != "kind"})
+            except Exception:  # noqa: BLE001
+                pass
+        if self.write_events:
+            try:
+                with open(os.path.join(self.dir, "trail-events.jsonl"),
+                          "a") as f:
+                    f.write(json.dumps(
+                        {"ts": round(time.time(), 3), **ev},
+                        separators=(",", ":")) + "\n")
+            except OSError:
+                pass
+        if self.on_event is not None:
+            try:
+                self.on_event(ev)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------------
+
+def analyze(dir_path: str) -> dict:
+    """Whole-run report over a trail/telemetry directory: join rate,
+    per-server blocking totals, mean critical-path legs + dominant-leg
+    histogram, cross-rank skew series, and straggler events."""
+    loaded = load_dir(dir_path)
+    joined, rate = join_spans(loaded["client"], loaded["server"])
+    by_server: dict = {}
+    for c in joined:
+        s = c.get("srv")
+        sid = int(c["server"])
+        ent = by_server.setdefault(sid, {"rpcs": 0, "client_ms": 0.0,
+                                         "srv_ms": 0.0, "apply_ms": 0.0,
+                                         "q_ms": 0.0, "joined": 0})
+        ent["rpcs"] += 1
+        ent["client_ms"] += int(c.get("dur_us", 0)) / 1e3
+        if s is not None:
+            ent["joined"] += 1
+            ent["srv_ms"] += (int(s["q_us"]) + int(s["handle_us"])
+                              + int(s["send_us"])) / 1e3
+            ent["apply_ms"] += int(s["apply_us"]) / 1e3
+            ent["q_ms"] += int(s["q_us"]) / 1e3
+    leg_sums = {leg: 0.0 for leg in LEGS}
+    dom_hist: dict = {}
+    by_step: dict = {}
+    n_steps = 0
+    for (rank, s), rec in loaded["steps"].items():
+        legs = step_legs(rec.get("phases") or {})
+        n_steps += 1
+        for k, v in legs.items():
+            leg_sums[k] += v
+        dom, _ = dominant(legs)
+        if dom:
+            dom_hist[dom] = dom_hist.get(dom, 0) + 1
+        by_step.setdefault(s, {})[rank] = float(rec.get("step_ms", 0.0))
+    det = StragglerDetector()
+    skew = []
+    stragglers = []
+    for s in sorted(by_step):
+        ranks = by_step[s]
+        if len(ranks) < 2:
+            continue
+        vals = list(ranks.values())
+        skew.append({"step": s, "skew_ms": round(max(vals) - min(vals), 3),
+                     "slowest": max(ranks, key=ranks.get)})
+        ev = det.observe(s, ranks)
+        if ev is not None:
+            stragglers.append(ev)
+    return {
+        "dir": dir_path,
+        "client_spans": len(loaded["client"]),
+        "server_spans": len(loaded["server"]),
+        "dropped_client_spans": loaded["dropped_client"],
+        "dropped_server_spans": loaded["dropped"],
+        "join_rate": round(rate, 4) if rate is not None else None,
+        "servers": {k: {kk: (round(vv, 3) if isinstance(vv, float) else vv)
+                        for kk, vv in v.items()}
+                    for k, v in sorted(by_server.items())},
+        "steps": n_steps,
+        "mean_legs_ms": {k: round(v / n_steps, 3) if n_steps else 0.0
+                         for k, v in leg_sums.items()},
+        "dominant_hist": dom_hist,
+        "skew": skew[-50:],
+        "max_skew_ms": max((e["skew_ms"] for e in skew), default=None),
+        "stragglers": stragglers,
+    }
+
+
+def format_step_report(rep: dict) -> str:
+    lines = [f"hetutrail --step {rep['step']}: join rate "
+             f"{rep['join_rate'] if rep['join_rate'] is not None else 'n/a'}"]
+    if not rep["ranks"]:
+        lines.append("  no step records for this step (is this the "
+                     "telemetry dir, with HETU_TRAIL_DIR pointed at it?)")
+    for rank, e in sorted(rep["ranks"].items()):
+        legs = "  ".join(f"{k}={v:.2f}ms" for k, v in e["legs"].items())
+        lines.append(f"  rank {rank}: step_ms={e.get('step_ms')}  {legs}")
+        msg = (f"  rank {rank}: dominant leg {e['dominant']} "
+               f"({100.0 * e['fraction']:.1f}% of the blocking chain)")
+        if "server" in e:
+            msg += (f" — server {e['server']} "
+                    f"({e['server_ms']:.2f}ms blocked)")
+        if "tensor" in e:
+            msg += f", param {e['tensor']} ({e['tensor_ms']:.2f}ms)"
+        lines.append(msg)
+    return "\n".join(lines)
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"hetutrail: {rep['dir']}",
+             f"  spans: {rep['client_spans']} client / "
+             f"{rep['server_spans']} server, join rate {rep['join_rate']}"
+             + (f", dropped {rep['dropped_client_spans']} client / "
+                f"{rep['dropped_server_spans']} server"
+                if rep["dropped_server_spans"]
+                or rep["dropped_client_spans"] else "")]
+    for sid, e in rep["servers"].items():
+        lines.append(f"  server {sid}: {e['rpcs']} rpcs  "
+                     f"client {e['client_ms']:.1f}ms  "
+                     f"server {e['srv_ms']:.1f}ms "
+                     f"(queue {e['q_ms']:.1f}, apply {e['apply_ms']:.1f})")
+    if rep["steps"]:
+        legs = "  ".join(f"{k}={v:.2f}ms"
+                         for k, v in rep["mean_legs_ms"].items())
+        lines.append(f"  critical path over {rep['steps']} step rec(s): "
+                     f"{legs}")
+        lines.append("  dominant-leg histogram: "
+                     + ", ".join(f"{k}:{v}" for k, v in sorted(
+                         rep["dominant_hist"].items(), key=lambda kv: -kv[1])))
+    if rep["max_skew_ms"] is not None:
+        lines.append(f"  cross-rank skew: max {rep['max_skew_ms']:.2f}ms "
+                     f"over {len(rep['skew'])} multi-rank step(s)")
+    for ev in rep["stragglers"]:
+        lines.append(f"  STRAGGLER rank {ev['rank']} @ step {ev['step']}: "
+                     f"{ev['step_ms']}ms vs median {ev['median_ms']}ms "
+                     f"({ev['streak']} consecutive)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# --check: jax-free self-test (the CI smoke, like hetuscope --check)
+# ---------------------------------------------------------------------------
+
+def self_check(out=sys.stdout) -> int:
+    """Build a synthetic two-server, two-rank run in a tempdir, then prove
+    the whole pipeline: spans join by (client, req_id), the critical path
+    names the slow PS leg AND the slow server, and the straggler detector
+    fires on the slowed rank. Exit 0/1."""
+    try:
+        with tempfile.TemporaryDirectory(prefix="hetutrail_check_") as d:
+            w = TrailWriter(os.path.join(d, "trail-client-r0.jsonl"), 0)
+            srv_path = os.path.join(d, "trail-server-s%d.jsonl")
+            srv_f = {s: open(srv_path % s, "w") for s in (0, 1)}
+            for s, f in srv_f.items():
+                f.write(json.dumps({"kind": "anchor", "server": s,
+                                    "mono_us": 0, "wall_s": 0.0}) + "\n")
+            rows = []
+            req_id = 1000
+            for step in range(6):
+                for server in (0, 1):
+                    req_id += 1
+                    slow = server == 1 and step == 3
+                    dur = 80_000 if slow else 900
+                    rows.append((req_id, 0, server, 21, 7, step,
+                                 step * 1_000_000 + server, dur, 256, 4096))
+                    srv_f[server].write(json.dumps(
+                        {"kind": "srv", "server": server, "client": 0,
+                         "req_id": req_id, "psf": 21, "tensor": 7,
+                         "t0_us": step * 1_000_000 + server + 100,
+                         "q_us": 50, "handle_us": dur - 200,
+                         "apply_us": dur - 200, "send_us": 50}) + "\n")
+            w.write_rows(rows)
+            w.close()
+            for f in srv_f.values():
+                f.close()
+            # per-rank metrics: rank 1 straggles from step 2 on; step 3's
+            # blocking chain is PS-pull-dominated on rank 0
+            for rank in (0, 1):
+                with open(os.path.join(d, f"metrics-r{rank}.jsonl"),
+                          "w") as f:
+                    for step in range(6):
+                        slow_rank = rank == 1 and step >= 2
+                        ps_pull = 20.0 if (rank == 0 and step == 3) else 1.0
+                        phases = {"prestep_ms": ps_pull + 0.5,
+                                  "dispatch_ms": 5.0,
+                                  "poststep_ms": 1.0, "ps_pull_ms": ps_pull,
+                                  "ps_push_ms": 0.4,
+                                  "ps_comm_ms": ps_pull + 0.4}
+                        step_ms = (300.0 if slow_rank else
+                                   phases["prestep_ms"]
+                                   + phases["dispatch_ms"]
+                                   + phases["poststep_ms"])
+                        f.write(json.dumps(
+                            {"ts": step * 0.1, "rank": rank, "kind": "step",
+                             "sub": "train", "step": step,
+                             "step_ms": step_ms,
+                             "phases": phases}) + "\n")
+            loaded = load_dir(d)
+            _, rate = join_spans(loaded["client"], loaded["server"])
+            assert rate == 1.0, f"join rate {rate} != 1.0"
+            rep = attribute_step(loaded, 3)
+            e = rep["ranks"][0]
+            assert e["dominant"] == "ps_pull", e
+            assert e.get("server") == 1, (
+                f"slow server misattributed: {e}")
+            assert e.get("tensor") == 7, e
+            full = analyze(d)
+            assert full["join_rate"] == 1.0, full["join_rate"]
+            assert any(ev["rank"] == 1 for ev in full["stragglers"]), (
+                "no straggler event for the slowed rank: "
+                f"{full['stragglers']}")
+            # SkewMonitor path: same events via the incremental tailer
+            seen = []
+            mon = SkewMonitor(d, on_event=seen.append, write_events=False)
+            mon.poll()
+            assert any(ev["rank"] == 1 for ev in seen), seen
+            det = StragglerDetector(k=2)
+            assert det.observe(0, {0: 1.0, 1: 10.0}) is None
+            assert det.observe(1, {0: 1.0, 1: 10.0})["rank"] == 1
+            # a recovered rank resets the streak
+            assert det.observe(2, {0: 1.0, 1: 1.0}) is None
+            assert det.observe(3, {0: 1.0, 1: 10.0}) is None
+        print("hetutrail --check: join/critical-path/straggler pipeline ok",
+              file=out)
+        return 0
+    except AssertionError as e:
+        print(f"hetutrail --check: FAIL: {e}", file=out)
+        return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetutrail",
+        description="distributed PS-wire tracing: span join, per-step "
+                    "critical-path attribution, straggler detection "
+                    "(docs/OBSERVABILITY.md pillar 5)")
+    ap.add_argument("dir", nargs="?",
+                    help="telemetry/trail directory (HETU_TRAIL_DIR)")
+    ap.add_argument("--step", type=int, default=None,
+                    help="report one step's critical path (names the "
+                         "dominant leg and, for PS legs, the blocking "
+                         "server and param)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="jax-free self-test of the join/critical-path/"
+                         "straggler pipeline, exit 0/1 (CI mode)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return self_check()
+    if not args.dir:
+        ap.error("a directory is required unless --check")
+    try:
+        if args.step is not None:
+            rep = attribute_step(load_dir(args.dir), args.step)
+            print(json.dumps(rep, indent=1) if args.json
+                  else format_step_report(rep))
+            return 0
+        rep = analyze(args.dir)
+        print(json.dumps(rep, indent=1) if args.json
+              else format_report(rep))
+    except BrokenPipeError:
+        return 0   # report piped into head/less that closed early
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

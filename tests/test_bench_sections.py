@@ -19,7 +19,7 @@ import bench  # noqa: E402
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
             "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
-            "introspect", "kernels"]
+            "introspect", "trail", "kernels"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
@@ -32,6 +32,9 @@ EXPECTED_KEYS = {
     # hetukern: the cell must carry the per-kernel equality verdicts and
     # the embed-grad A/B headline (docs/KERNELS.md)
     "kernels": ("equality_ok", "speedup_rows"),
+    # hetutrail: the overhead A/B must actually have recorded spans, or
+    # the on-leg measured nothing (docs/OBSERVABILITY.md pillar 5)
+    "trail": ("trail_overhead_pct", "client_spans"),
 }
 
 

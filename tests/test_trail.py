@@ -1,0 +1,475 @@
+"""hetutrail — cross-process PS-wire tracing, critical-path attribution,
+straggler detection (docs/OBSERVABILITY.md pillar 5).
+
+The two cluster tests are the acceptance proofs: client↔server spans join
+by (client_id, req_id) at ≥90% under a live multi-process cluster, and a
+``ps_slow``-injected apply makes ``hetutrail --step N`` name the PS leg as
+the dominant critical-path phase AND the slowed server. The rest are the
+satellites: straggler detector/SkewMonitor/ScalePolicy visibility,
+off-mode zero-work, JSONL rotation, monotonic re-anchoring, run_summary
+enrichment, and the --check CLI smoke.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from test_ps import run_cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# span join + slow-server attribution under a live multi-process cluster
+# ---------------------------------------------------------------------------
+
+def _span_join_worker(client, rank, tmpdir):
+    from hetu_tpu.telemetry import trail
+    td = os.environ["HETU_TRAIL_DIR"]
+    client.InitTensor(1, 0, 64, 1, "constant", 0.0, opt_type="sgd",
+                      lrs=(0.1,))
+    w = trail.TrailWriter(os.path.join(td, f"trail-client-r{rank}.jsonl"),
+                          rank)
+    for step in range(6):
+        client.SetTrailStep(step)
+        if step == 3:
+            # deterministic slow leg: server 1's next apply sleeps
+            client.TestSlowApply(server=1, ms=250)
+        client.Push(1, np.ones(64, np.float32))
+        client.Wait(1)
+        out = np.zeros(64, np.float32)
+        client.Pull(1, out)
+        client.Wait(1)
+    assert trail.drain_client_spans(client, w) > 0
+    assert client.TrailDropped() == 0
+    w.close()
+
+
+def test_span_join_and_slow_server(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TRAIL_DIR", str(tmp_path))
+    run_cluster(_span_join_worker, tmp_path, n_workers=1, n_servers=2)
+    from hetu_tpu.telemetry import trail
+    loaded = trail.load_dir(str(tmp_path))
+    assert loaded["client"] and loaded["server"]
+    joined, rate = trail.join_spans(loaded["client"], loaded["server"])
+    # acceptance: >= 90% of client-side PS RPC spans join to a server span
+    assert rate is not None and rate >= 0.9, rate
+    # the slowed server dominates the blocking time around step 3, and the
+    # joined server span carries the apply time itself
+    by_server, by_tensor = trail._ps_attribution(joined, 3)
+    assert by_server[1] > by_server.get(0, 0) + 200_000, by_server
+    assert by_tensor.get(1, 0) > 200_000, by_tensor
+    slow = [c for c in joined if c["server"] == 1 and c["dur_us"] > 200_000]
+    assert slow and slow[0]["srv"] is not None
+    assert slow[0]["srv"]["apply_us"] > 200_000
+
+
+# ---------------------------------------------------------------------------
+# executor integration: ps_slow fault -> hetutrail --step names the PS-pull
+# leg and the slowed server
+# ---------------------------------------------------------------------------
+
+def _executor_ps_slow_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry
+    from hetu_tpu.resilience import FaultInjector, Supervisor
+    embed = ht.init.random_normal((40, 8), stddev=0.1, name="embed",
+                                  is_embed=True)
+    idx = ht.Variable(name="idx", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    vec = ht.embedding_lookup_op(embed, idx)
+    flat = ht.array_reshape_op(vec, (-1, 32))
+    w = ht.init.xavier_uniform((32, 1), name="w")
+    prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    # BSP + prefetch: the pull stream IS the push stream, so the step-4
+    # pull queues behind step 3's slowed push — the deterministic
+    # pull-blocked-on-apply shape the critical path must attribute
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="Hybrid", bsp=True, prefetch=True,
+                     telemetry="metrics", seed=0)
+    sup = Supervisor(fault_injector=FaultInjector("ps_slow@3:400"))
+    ex.attach_supervisor(sup)
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        bidx = rng.randint(0, 40, (16, 4)).astype(np.float32)
+        by = rng.randint(0, 2, (16, 1)).astype(np.float32)
+        ex.run("train", feed_dict={idx: bidx, y_: by})
+    ex.close()
+    telemetry.shutdown()   # flush metrics-r0.jsonl before the parent reads
+
+
+def test_executor_ps_slow_critical_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TRAIL_DIR", str(tmp_path))
+    monkeypatch.setenv("HETU_TRAIL_DRAIN_EVERY", "1")
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    run_cluster(_executor_ps_slow_worker, tmp_path, n_workers=1,
+                n_servers=2)
+    from hetu_tpu.telemetry import trail
+    loaded = trail.load_dir(str(tmp_path))
+    joined, rate = trail.join_spans(loaded["client"], loaded["server"])
+    assert rate is not None and rate >= 0.9, rate
+    # the step AFTER the armed boundary blocks in its pull wait
+    rep = trail.attribute_step(loaded, 4)
+    entry = rep["ranks"][0]
+    assert entry["dominant"] == "ps_pull", entry
+    assert entry["fraction"] > 0.5, entry
+    # ...and the verdict names the slowed server (HETU_PS_SLOW_SERVER
+    # default: 0)
+    assert entry.get("server") == 0, entry
+    assert entry["legs"]["ps_pull"] > 300.0, entry
+    # the CLI says the same thing, jax-free
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetutrail"),
+         str(tmp_path), "--step", "4"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "dominant leg ps_pull" in out.stdout, out.stdout
+    assert "server 0" in out.stdout, out.stdout
+    # whole-run report works on the same dir
+    rep_all = trail.analyze(str(tmp_path))
+    assert rep_all["join_rate"] >= 0.9
+    # critical-path gauges rode the metrics snapshots
+    snap = {}
+    recs = [json.loads(line) for line in
+            open(tmp_path / "metrics-r0.jsonl") if line.strip()]
+    for r in recs:
+        if isinstance(r.get("metrics"), dict):
+            snap = r["metrics"]
+    assert any(k.startswith("hetu_critical_path_ms") for k in snap), \
+        sorted(snap)[:20]
+    assert 0 < snap.get("hetu_cp_fraction", 0) <= 1
+
+
+# ---------------------------------------------------------------------------
+# off-mode: zero trail work without HETU_TRAIL_DIR
+# ---------------------------------------------------------------------------
+
+def _off_mode_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu.telemetry import trail
+    assert trail.armed() is None
+    client.InitTensor(1, 0, 16, 1, "constant", 0.0, opt_type="sgd",
+                      lrs=(0.1,))
+    client.Push(1, np.ones(16, np.float32))
+    client.Wait(1)
+    # the native ring never armed: nothing recorded, nothing dropped
+    assert len(client.DrainTrailSpans()) == 0
+    assert client.TrailDropped() == 0
+    # an executor in the same process wires no trail writer and the step
+    # boundary is a single attribute check
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    w = ht.init.zeros((4, 1), name="w")
+    err = ht.matmul_op(x, w) - y_
+    loss = ht.reduce_mean_op(ht.mul_op(err, err), [0])
+    train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="PS")
+    assert ex.ps_runtime.trail_writer is None
+    for _ in range(2):
+        ex.run("train", feed_dict={x: np.ones((4, 4), np.float32),
+                                   y_: np.ones((4, 1), np.float32)})
+    assert len(client.DrainTrailSpans()) == 0
+    ex.close()
+    import glob
+    assert not glob.glob(os.path.join(str(tmpdir), "trail-*.jsonl"))
+
+
+def test_trail_off_mode_zero_work(tmp_path, monkeypatch):
+    monkeypatch.delenv("HETU_TRAIL_DIR", raising=False)
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    run_cluster(_off_mode_worker, tmp_path, n_workers=1, n_servers=1)
+    import glob
+    assert not glob.glob(os.path.join(str(tmp_path), "trail-*.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# straggler detection + ScalePolicy visibility
+# ---------------------------------------------------------------------------
+
+def test_straggler_detector():
+    from hetu_tpu.telemetry.trail import StragglerDetector
+    det = StragglerDetector(k=3, ratio=1.5, min_ms=1.0)
+    # two clean steps, then rank 1 goes slow for 3 consecutive steps
+    assert det.observe(0, {0: 10.0, 1: 10.5}) is None
+    assert det.observe(1, {0: 10.0, 1: 11.0}) is None
+    assert det.observe(2, {0: 10.0, 1: 30.0}) is None
+    assert det.observe(3, {0: 10.0, 1: 31.0}) is None
+    ev = det.observe(4, {0: 10.0, 1: 32.0})
+    assert ev is not None and ev["rank"] == 1 and ev["streak"] == 3
+    # after firing, the streak restarts (re-fires every k steps)
+    assert det.observe(5, {0: 10.0, 1: 33.0}) is None
+    # a recovery resets the streak
+    assert det.observe(6, {0: 10.0, 1: 10.0}) is None
+    assert det.observe(7, {0: 10.0, 1: 40.0}) is None
+    # sub-min_ms skew on fast steps never fires, whatever the ratio
+    fast = StragglerDetector(k=1, ratio=1.5, min_ms=1.0)
+    assert fast.observe(0, {0: 0.01, 1: 0.10}) is None
+    # single-rank worlds have no skew to detect
+    assert fast.observe(1, {0: 5.0}) is None
+
+
+def test_skew_monitor_and_scale_policy(tmp_path):
+    from hetu_tpu.elastic import ScalePolicy
+    from hetu_tpu.telemetry.trail import SkewMonitor, StragglerDetector
+    # rank 1 straggles from step 1 on, and its blocking chain is
+    # PS-pull-dominated — the SkewMonitor must attribute the server
+    for rank in (0, 1):
+        with open(tmp_path / f"metrics-r{rank}.jsonl", "w") as f:
+            for step in range(6):
+                slow = rank == 1 and step >= 1
+                ms = 40.0 if slow else 8.0
+                pull = 35.0 if slow else 1.0
+                f.write(json.dumps(
+                    {"ts": step * 0.1, "rank": rank, "kind": "step",
+                     "sub": "train", "step": step, "step_ms": ms,
+                     "phases": {"prestep_ms": pull + 0.5,
+                                "dispatch_ms": 3.0, "poststep_ms": 0.5,
+                                "ps_pull_ms": pull,
+                                "ps_push_ms": 0.2}}) + "\n")
+    # rank 1's client spans: server 1 carries the blocking time
+    with open(tmp_path / "trail-client-r1.jsonl", "w") as f:
+        for step in range(6):
+            for server in (0, 1):
+                f.write(json.dumps(
+                    {"kind": "rpc", "rank": 1, "req_id": 100 + step,
+                     "client": 2, "server": server, "psf": 21, "tensor": 5,
+                     "step": step, "t0_us": step * 1000,
+                     "dur_us": 34_000 if server == 1 else 500,
+                     "req_bytes": 64, "rsp_bytes": 640}) + "\n")
+    seen = []
+    mon = SkewMonitor(str(tmp_path), detector=StragglerDetector(k=3),
+                      on_event=seen.append)
+    fired = mon.poll()
+    assert fired and fired[0]["rank"] == 1
+    # PS-dominated straggler carries the blocking server + world size
+    assert fired[0]["server"] == 1 and fired[0]["n_servers"] == 2
+    assert seen == fired
+    assert mon.last_skew_ms == pytest.approx(32.0)
+    assert mon.last_slowest == 1
+    # the events landed next to the rank files for post-mortems
+    evs = [json.loads(line) for line in
+           open(tmp_path / "trail-events.jsonl")]
+    assert evs and evs[0]["kind"] == "straggler" and evs[0]["rank"] == 1
+    # a second poll with no new data fires nothing
+    assert mon.poll() == []
+
+    # ScalePolicy visibility: rank-level stragglers are recorded but don't
+    # grow the PS tier; the server-attributed event above does (bounded +
+    # cooldown) — the full SkewMonitor -> ScalePolicy chain. The cluster
+    # size for the cap check comes from the policy's OWN stats view
+    # (observe()), never from the event's lower-bound n_servers.
+    two_servers = [[0] * 8, [0] * 8]
+    pol = ScalePolicy(max_servers=3, cooldown_s=0.0)
+    pol.observe(two_servers, now=99.0)
+    assert pol.note_straggler({"kind": "straggler", "rank": 1, "step": 3},
+                              now=100.0) is None
+    assert pol.stragglers_seen == 1
+    rec = pol.note_straggler(fired[0], now=101.0)
+    assert rec == {"action": "grow_server", "n_servers": 3,
+                   "reason": "straggler server 1"}
+    # without a stats view there is no trustworthy size: no recommendation
+    # (an event's span-derived n_servers could undercount past the cap)
+    blind = ScalePolicy(max_servers=3, cooldown_s=0.0)
+    assert blind.note_straggler(fired[0], now=100.0) is None
+    # at the bound: no recommendation
+    pol3 = ScalePolicy(max_servers=2, cooldown_s=0.0)
+    pol3.observe(two_servers, now=199.0)
+    assert pol3.note_straggler({"kind": "straggler", "server": 1},
+                               now=200.0) is None
+    # cooldown respected
+    pol2 = ScalePolicy(max_servers=4, cooldown_s=30.0)
+    pol2.observe(two_servers, now=999.0)
+    assert pol2.note_straggler({"kind": "straggler", "server": 0},
+                               now=1000.0) is not None
+    assert pol2.note_straggler({"kind": "straggler", "server": 0},
+                               now=1001.0) is None
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_jsonl_rotation(tmp_path):
+    """HETU_TELEMETRY_MAX_MB: atomic rollover to one .1 backup; offset
+    readers observe size < offset and restart (hetutop Follower/
+    SkewMonitor contract)."""
+    from hetu_tpu.telemetry.registry import JsonlSink
+    path = str(tmp_path / "metrics-r0.jsonl")
+    sink = JsonlSink(path, base_fields={"rank": 0}, max_mb=0.002)  # 2 KB
+    for i in range(100):
+        sink.write({"kind": "step", "step": i, "step_ms": 1.0})
+    sink.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) < 2500
+    # both generations parse, and together they cover the tail
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    assert recs and recs[-1]["step"] == 99
+    old = [json.loads(line) for line in open(path + ".1") if line.strip()]
+    assert old
+    # default-off: no cap -> no rotation (test stability contract)
+    p2 = str(tmp_path / "m2.jsonl")
+    s2 = JsonlSink(p2, max_mb=None)
+    assert s2._max_bytes == 0 or os.environ.get("HETU_TELEMETRY_MAX_MB")
+    s2.close()
+    # the trail client writer is bounded the same way (HETU_TRAIL_MAX_MB),
+    # and each generation re-anchors
+    from hetu_tpu.telemetry.trail import TrailWriter
+    tw = TrailWriter(str(tmp_path / "trail-client-r0.jsonl"), 0,
+                     max_mb=0.002)
+    row = (1, 0, 0, 21, 5, 0, 1000, 50, 64, 640)
+    for _ in range(10):
+        tw.write_rows([row] * 10)
+    tw.close()
+    assert os.path.exists(str(tmp_path / "trail-client-r0.jsonl") + ".1")
+    live = [json.loads(line) for line in
+            open(tmp_path / "trail-client-r0.jsonl") if line.strip()]
+    assert live and live[0]["kind"] == "anchor"   # fresh generation anchor
+
+
+def test_trace_merge_prefers_mono_anchor(tmp_path):
+    """An NTP step between ranks moves the wall anchors but not the
+    monotonic ones; the merge must align on mono when the ranks share a
+    kernel boot (same boot_id — hostnames can collide across machines)
+    and fall back to unix across boots."""
+    from hetu_tpu.telemetry.hetutrace import merge
+
+    def write(path, rank, unix, mono, boot):
+        doc = {"displayTimeUnit": "ms",
+               "otherData": {"clock_anchor_unix_s": unix,
+                             "clock_anchor_mono_s": mono,
+                             "host": "hostA", "boot_id": boot,
+                             "rank": rank},
+               "traceEvents": [{"name": "step", "cat": "step", "ph": "X",
+                                "ts": 0.0, "dur": 5.0, "pid": rank,
+                                "tid": 1}]}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+    # rank 1 started 1s later (mono +1.0) but its wall clock was
+    # NTP-stepped +1000s: unix anchoring would shift its lane by 1000s
+    write(tmp_path / "trace-r0.json", 0, 1000.0, 50.0, "boot-a")
+    write(tmp_path / "trace-r1.json", 1, 2000.0, 51.0, "boot-a")
+    out = str(tmp_path / "merged.json")
+    merge([str(tmp_path)], out)
+    doc = json.load(open(out))
+    assert doc["otherData"]["anchor_clock"] == "monotonic"
+    ts_by_pid = {e["pid"]: e["ts"] for e in doc["traceEvents"]}
+    assert ts_by_pid[0] == 0.0
+    assert ts_by_pid[1] == pytest.approx(1e6)   # 1s, not 1000s
+    # different kernel boots (identical hostnames — container images):
+    # mono origins are not comparable -> unix fallback
+    write(tmp_path / "trace-r1.json", 1, 2000.0, 51.0, "boot-b")
+    merge([str(tmp_path)], out)
+    doc = json.load(open(out))
+    assert doc["otherData"]["anchor_clock"] == "unix"
+    # a real Tracer doc advertises both identity fields
+    from hetu_tpu.telemetry.tracing import Tracer
+    tr = Tracer(str(tmp_path / "trace-r9.json"), rank=9)
+    with tr.span("s"):
+        pass
+    tr.flush()
+    od = json.load(open(tmp_path / "trace-r9.json"))["otherData"]
+    assert "clock_anchor_mono_s" in od and "boot_id" in od
+
+
+def test_run_summary_final_steps_and_resizes(tmp_path, monkeypatch):
+    from hetu_tpu import runner
+    with open(tmp_path / "metrics-r0.jsonl", "w") as f:
+        for step in range(5):
+            f.write(json.dumps({"ts": step, "rank": 0, "kind": "step",
+                                "step": step, "step_ms": 1.0}) + "\n")
+        f.write(json.dumps({"ts": 9.0, "rank": 0, "kind": "event",
+                            "name": "resize_commit", "step": 4,
+                            "world_version": 2, "n_workers": 1,
+                            "n_servers": 2, "duration_ms": 12.5}) + "\n")
+    with open(tmp_path / "metrics-r1.jsonl", "w") as f:
+        for step in range(3):
+            f.write(json.dumps({"ts": step, "rank": 1, "kind": "step",
+                                "step": step, "step_ms": 1.0}) + "\n")
+    monkeypatch.setattr(runner, "_tel_dir", str(tmp_path))
+    runner._write_telemetry_summary(0, False, 2)
+    s = json.loads(open(tmp_path / "run_summary.json").read())
+    assert s["final_steps"] == {"0": 4, "1": 2}
+    assert s["world_versions"] == [2]
+    assert s["resizes"][0]["name"] == "resize_commit"
+    assert s["resizes"][0]["world_version"] == 2
+
+
+def test_fault_spec_ps_slow_parses():
+    from hetu_tpu.resilience import FaultInjector
+    fi = FaultInjector("ps_slow@5:250")
+    assert fi.entries == [{"kind": "ps_slow", "step": 5, "arg": 250.0,
+                           "fired": False}]
+    assert FaultInjector("ps_slow@2").entries[0]["arg"] is None
+
+
+def test_export_critical_path_gauges():
+    from hetu_tpu.telemetry.registry import MetricsRegistry
+    from hetu_tpu.telemetry import trail
+    reg = MetricsRegistry()
+    cache = {}
+    legs = trail.step_legs({"prestep_ms": 5.0, "dispatch_ms": 2.0,
+                            "poststep_ms": 1.0, "ps_pull_ms": 4.0,
+                            "ps_push_ms": 0.5})
+    assert legs == {"feed": 1.0, "ps_pull": 4.0, "compute": 2.0,
+                    "ps_push": 0.5, "poststep": 0.5}
+    dom, frac = trail.export_critical_path(reg, legs, cache=cache)
+    assert dom == "ps_pull" and frac == pytest.approx(0.5)
+    snap = reg.snapshot()
+    assert snap['hetu_critical_path_ms{leg="ps_pull"}'] == 4.0
+    assert snap["hetu_cp_fraction"] == pytest.approx(0.5)
+    # cached handles are reused across steps
+    assert trail.export_critical_path(reg, legs, cache=cache)[0] == \
+        "ps_pull"
+
+
+def test_profiler_cp_fraction_column():
+    from hetu_tpu.telemetry import profiler
+    means = {"step_ms": 10.0, "prestep_ms": 5.0, "dispatch_ms": 2.0,
+             "poststep_ms": 1.0, "ps_pull_ms": 4.0, "ps_push_ms": 0.5,
+             "ps_comm_ms": 4.5, "n_steps": 3}
+    b = profiler.step_breakdown(means)
+    assert b["cp_dominant"] == "ps_pull"
+    assert b["cp_fraction"] == pytest.approx(0.5)
+    assert b["cp_legs_ms"]["compute"] == 2.0
+
+
+def test_hetutop_trail_panel():
+    from hetu_tpu.telemetry.hetutop import render_frame
+    m = {'hetu_critical_path_ms{leg="feed"}': 1.0,
+         'hetu_critical_path_ms{leg="ps_pull"}': 4.0,
+         'hetu_critical_path_ms{leg="compute"}': 2.0,
+         'hetu_critical_path_ms{leg="ps_push"}': 0.5,
+         'hetu_critical_path_ms{leg="poststep"}': 0.5,
+         "hetu_cp_fraction": 0.5,
+         'hetu_events_total{event="straggler"}': 2}
+
+    def rank(p50):
+        return {"last_step": 9, "sub": "train", "steps_per_s": 10.0,
+                "examples_per_s": None, "p50": p50, "p90": p50, "p99": p50,
+                "max": p50, "metrics": m, "last_ts": 1.0}
+
+    state = {"ranks": {0: rank(8.0), 1: rank(40.0)}, "events": [],
+             "ps": {}, "run_info": {}, "model": {}, "scope": {}}
+    frame = render_frame(state)
+    assert "trail:" in frame
+    assert "dominant ps_pull 50%" in frame
+    assert "slowest r1" in frame
+    assert "stragglers 4" in frame   # summed across both ranks' snapshots
+
+
+def test_hetutrail_check_cli():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetutrail"),
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "pipeline ok" in out.stdout
