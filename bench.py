@@ -714,6 +714,97 @@ def bench_comm_quant_dp(width=512, batch=512, steps=40, warmup=5):
     return out
 
 
+def bench_planner(width=256, target_width=512, batch=256, warmup=8,
+                  iters=40):
+    """hetuplan cell (docs/ANALYSIS.md "Tier C: planning"): predicted vs
+    measured step time — the acceptance check that the cost model's
+    numbers mean something. A CALIBRATION MLP (``width``) trains on CPU
+    with telemetry=metrics; its telemetry dir calibrates the planner
+    (measured critical-path legs → compute residual + host term, exactly
+    what ``hetulint --plan --calibrate`` does). The calibrated model then
+    predicts a DIFFERENT graph — the ``target_width`` MLP it has never
+    seen — and that graph is trained and measured for the residual. Same-
+    graph prediction would be circular (the calibration reproduces its own
+    run by construction); cross-size is the real claim. The uncalibrated
+    prediction is recorded too — against TPU-assumed peaks on a CPU host
+    it is orders of magnitude off BY DESIGN (docs/ROOFLINE.md:
+    assumptions, not readings). SECTION_ENV pins the cell to CPU."""
+    import tempfile
+    import hetu_tpu as ht
+    from hetu_tpu import analysis
+    from hetu_tpu import telemetry as tel_mod
+    from hetu_tpu.telemetry import profiler as prof_mod
+
+    def build(w):
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        h = x
+        for i in range(3):
+            wt = ht.init.random_normal((w, w), stddev=0.05,
+                                       name=f"pw{i}_{w}")
+            h = ht.relu_op(ht.matmul_op(h, wt))
+        wo = ht.init.random_normal((w, 8), stddev=0.05, name=f"pwo_{w}")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, wo), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        rng = np.random.RandomState(0)
+        feeds = {x: rng.randn(batch, w).astype(np.float32),
+                 y_: np.eye(8, dtype=np.float32)[rng.randint(0, 8, batch)]}
+        return {"train": [loss, train_op]}, feeds
+
+    def run_measured(graph, feeds, tel_dir):
+        os.environ["HETU_TELEMETRY_DIR"] = tel_dir
+        ex = ht.Executor(graph, ctx=ht.cpu(0), seed=0, telemetry="metrics")
+        for _ in range(warmup):
+            ex.run("train", feed_dict=feeds)
+        t0 = time.time()
+        for _ in range(iters - 1):
+            ex.run("train", feed_dict=feeds)
+        last = ex.run("train", feed_dict=feeds)[0]
+        float(np.mean(last.asnumpy()))   # one sync closes the window
+        wall_ms = (time.time() - t0) / iters * 1000
+        tel_mod.shutdown()               # flush the step records
+        means = prof_mod.step_phase_means(
+            prof_mod.read_metrics_records(tel_dir))
+        return means.get("step_ms", wall_ms), means
+
+    # calibration run (width) -> measured legs + residuals
+    cal_graph, cal_feeds = build(width)
+    cal_dir = tempfile.mkdtemp(prefix="hetu_plan_cal_")
+    _cal_ms, _ = run_measured(cal_graph, cal_feeds, cal_dir)
+
+    # target run (target_width): predict FIRST, measure after. The
+    # calibration carries the CALIBRATION graph's own predicted compute
+    # as the residual baseline, so the correction is a true ratio that
+    # extrapolates across sizes instead of echoing the measured step.
+    cal = analysis.load_calibration(cal_dir)
+    cal_baseline = analysis.plan_graph(cal_graph, devices=1,
+                                       feed_meta=dict(cal_feeds))
+    cal.baseline_compute_ms = cal_baseline.breakdown.get("compute_ms")
+    tgt_graph, tgt_feeds = build(target_width)
+    feed_meta = dict(tgt_feeds)
+    plan_uncal = analysis.plan_graph(tgt_graph, devices=1,
+                                     feed_meta=feed_meta)
+    plan = analysis.plan_graph(tgt_graph, devices=1, calibrate=cal,
+                               feed_meta=feed_meta)
+    predicted = plan.predicted_step_ms
+    tgt_dir = tempfile.mkdtemp(prefix="hetu_plan_tgt_")
+    measured_ms, means = run_measured(tgt_graph, tgt_feeds, tgt_dir)
+    err_pct = abs(predicted - measured_ms) / measured_ms * 100 \
+        if measured_ms else None
+    return {
+        "calib_width": width, "target_width": target_width,
+        "calib_step_ms": round(_cal_ms, 4),
+        "measured_step_ms": round(measured_ms, 4),
+        "predicted_step_ms": round(predicted, 4),
+        "predicted_uncal_ms": round(plan_uncal.predicted_step_ms, 6),
+        "plan_err_pct": round(err_pct, 2) if err_pct is not None else None,
+        "plan_comm_mode": plan.comm_mode or "none",
+        "plan_mesh": plan.mesh,
+        "steps_measured": int(means.get("n_steps", iters)),
+    }
+
+
 def bench_kernels(vocab=1_000_000, dim=32, batch=4096, lookups=4,
                   warmup=5, iters=30):
     """hetukern cell (docs/KERNELS.md): (a) the per-kernel interpret-mode
@@ -1105,6 +1196,12 @@ def _run_section(name):
         kw = (dict(vocab=5000, dim=32, batch=512, lookups=2, warmup=1,
                    iters=3) if smoke else {})
         out = bench_kernels(**kw)
+    elif name == "planner":
+        # hetuplan predicted-vs-measured cell (docs/ANALYSIS.md Tier C):
+        # the 30%-of-measured acceptance for the calibrated prediction
+        kw = (dict(width=64, target_width=128, batch=64, warmup=3,
+                   iters=8) if smoke else {})
+        out = bench_planner(**kw)
     else:
         raise SystemExit(f"unknown section {name}")
     import jax
@@ -1137,6 +1234,10 @@ SECTION_ENV = {
     # deterministic on CPU, and the tunneled chip would add 60-85ms RTTs
     # that drown the cost being measured
     "trail": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetuplan predicted-vs-measured (docs/ANALYSIS.md Tier C): the
+    # calibration round-trip is framework-relative and must be
+    # deterministic; the tunnel's RTT jitter would drown the residual
+    "planner": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
 }
 
 
@@ -1301,7 +1402,9 @@ class _Ledger:
                       "auc_int8", "auc_delta", "final_loss_off",
                       "loss_delta_int8", "loss_delta_fp8",
                       "dense_step_ms", "rows_step_ms", "speedup_rows",
-                      "equality_ok"):
+                      "equality_ok", "measured_step_ms",
+                      "predicted_step_ms", "plan_err_pct",
+                      "plan_comm_mode"):
                 if result.get(k) is not None:
                     rec[k] = result[k]
         try:
@@ -1469,7 +1572,8 @@ def main():
                      ("comm_quant_dp_mlp", "comm_quant_dp", 600),
                      ("introspect_overhead", "introspect", 420),
                      ("trail_overhead", "trail", 600),
-                     ("kernels_tier", "kernels", 600)]
+                     ("kernels_tier", "kernels", 600),
+                     ("planner_residual", "planner", 420)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k
     # samples/s at bf16 bs512), so the hang signature is most consistent
     # with a cold compile that outlives a killed client server-side and

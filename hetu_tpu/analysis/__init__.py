@@ -1,6 +1,7 @@
-"""hetulint: define-time graph validation + lowered-program static analysis.
+"""hetulint: define-time graph validation + lowered-program static analysis
++ the hetuplan layout planner.
 
-Two tiers:
+Three tiers:
 
 - **Tier A** (:mod:`graph_passes`) runs over the Op graph before the executor
   builds: whole-graph abstract shape/dtype inference with op-level mismatch
@@ -13,6 +14,12 @@ Two tiers:
   recompilation detection, donation/aliasing and host-transfer checks, and
   the replicated-large-tensor lint. Entry points: :func:`analyze_executor`,
   :class:`RecompileMonitor`.
+- **Tier C** (:mod:`planner` + :mod:`cost_model`) *chooses* a layout instead
+  of linting one: per-parameter AllReduce/PS/Hybrid + comm_quant from
+  analytic wire costs, (dp, tp, pp) mesh search under the AOT HBM gate with
+  ZeRO-1/remat fallback, calibrated by measured roofline residuals and
+  critical-path legs. Entry points: :func:`plan_graph` -> :class:`Plan`,
+  ``hetulint --plan``, ``Executor(..., plan="auto")``.
 
 See docs/ANALYSIS.md for the lint catalogue with examples and suppression.
 """
@@ -32,8 +39,12 @@ from .analyzer import (
 from .lowered import (
     analyze_executor, recompile_findings, donation_findings,
     host_transfer_findings, replicated_tensor_findings, cost_analysis_of,
-    RecompileMonitor,
+    RecompileMonitor, resolve_replicated_threshold,
 )
+from .cost_model import (
+    Calibration, CostModel, CostModelConfig, load_calibration,
+)
+from .planner import Plan, ParamDecision, MeshCandidate, plan_graph
 
 __all__ = [
     "Finding", "GraphValidationError", "ERROR", "WARN", "NOTE", "SEVERITIES",
@@ -44,4 +55,7 @@ __all__ = [
     "GraphAnalyzer", "analyze_graph", "record_graph", "analyze_executor",
     "recompile_findings", "donation_findings", "host_transfer_findings",
     "replicated_tensor_findings", "cost_analysis_of", "RecompileMonitor",
+    "resolve_replicated_threshold",
+    "Calibration", "CostModel", "CostModelConfig", "load_calibration",
+    "Plan", "ParamDecision", "MeshCandidate", "plan_graph",
 ]

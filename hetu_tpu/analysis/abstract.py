@@ -28,6 +28,16 @@ import jax
 from ..graph.node import _as_struct
 
 
+def _shape_desc(m):
+    """Shape of one abstract meta for diagnostics — tolerant of pytree
+    metas (the IndexedRows rows-route pair has no ``.shape`` itself)."""
+    if hasattr(m, "shape"):
+        return tuple(m.shape)
+    if isinstance(m, tuple):
+        return tuple(_shape_desc(e) for e in m)
+    return type(m).__name__
+
+
 class AbstractGraph:
     """Abstract shapes/dtypes of one topo-sorted graph.
 
@@ -108,7 +118,7 @@ class AbstractGraph:
                 # may legitimately be None (PS push yields no in-graph value)
                 self.meta[id(node)] = node.infer_meta(in_metas)
             except TypeError as e:
-                shapes = [tuple(m.shape) for m in in_metas]
+                shapes = [_shape_desc(m) for m in in_metas]
                 self.failures[id(node)] = (
                     "shape-mismatch", f"{e} (input shapes {shapes})")
             except Exception as e:  # noqa: BLE001 — classify, don't crash

@@ -79,7 +79,8 @@ class AnalysisConfig:
 
     def __init__(self, comm_mode=None, mesh=None, dp_size=None,
                  dp_axis="dp", mp_axis="tp", compute_dtype=np.float32,
-                 gpipe=False, comm_quant_policy=None, kernels=None):
+                 gpipe=False, comm_quant_policy=None, kernels=None,
+                 replicated_threshold_bytes=None):
         self.comm_mode = comm_mode
         self.mesh = mesh
         self._dp_size = dp_size
@@ -93,6 +94,10 @@ class AnalysisConfig:
         # hetukern mode for the kernels_pass lints ("off"|"auto"|"force");
         # None = skip the pass (the hetulint CLI default)
         self.kernels = kernels
+        # replicated-large-tensor lint threshold; None defers to the
+        # HETU_REPLICATED_THRESHOLD_BYTES env, then the 64 MiB default
+        # (lowered.resolve_replicated_threshold)
+        self.replicated_threshold_bytes = replicated_threshold_bytes
 
     @property
     def dp_size(self) -> int:

@@ -117,11 +117,15 @@ def build_ctr_ps():
     """Wide&Deep-style CTR model with PS-hosted embedding tables (the
     examples/ctr/models/wdl_adult.py pattern, miniaturized). Declares
     ``comm_mode='PS'`` so the analyzer replays the executor's PS comm-op
-    insertion and checks the staging contract."""
+    insertion and checks the staging contract. The vocab stays CTR-shaped
+    (10k rows against 128 lookups/step, ~1% density) so the hetuplan
+    density × size rule sees the workload the example stands for — the
+    table is only ever an initializer shape here, nothing materializes
+    at lint/plan time."""
     import hetu_tpu as ht
     from hetu_tpu import init
 
-    n_cat, embed_rows, embed_dim, n_num = 4, 50, 8, 3
+    n_cat, embed_rows, embed_dim, n_num = 4, 10000, 8, 3
     rng = np.random.RandomState(2)
     cat = rng.randint(0, embed_rows, size=(128, n_cat)).astype(np.int64)
     num = rng.randn(128, n_num).astype(np.float32)
@@ -144,3 +148,27 @@ def build_ctr_ps():
     loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
     train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
     return {"train": [loss, train_op]}, {"comm_mode": "PS"}
+
+
+def build_ctr_ps_rows():
+    """The PR-12 explicit rows route (docs/KERNELS.md): an
+    ``embedding_lookup_gradient_op`` whose sole consumer is a PS gradient
+    push — the executor flips it to compact ``IndexedRows`` mode at build
+    so the ``(vocab, dim)`` zeros table never materializes. Bundled so CI
+    lint/plan covers the route's abstract tracing end to end (the
+    ``infer_meta`` identity keeps the whole cone evaluable)."""
+    import hetu_tpu as ht
+    from hetu_tpu import init
+
+    embed_rows, embed_dim = 10000, 8
+    rng = np.random.RandomState(4)
+    cat = rng.randint(0, embed_rows, size=(128, 4)).astype(np.int64)
+    idx = ht.dataloader_op([ht.Dataloader(cat, 32, "train")])
+    table = init.random_normal((embed_rows, embed_dim), stddev=0.1,
+                               name="rows_embed", is_embed=True)
+    lk = ht.embedding_lookup_op(table, idx)
+    loss = ht.reduce_mean_op(lk, [0, 1, 2])
+    grad = ht.embedding_lookup_gradient_op(lk, idx,
+                                           (embed_rows, embed_dim))
+    push = ht.parameterServerCommunicate_op(grad, ps_id="rows_embed")
+    return {"train": [loss, push]}, {"comm_mode": "PS"}

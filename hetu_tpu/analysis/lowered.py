@@ -22,11 +22,27 @@ program you meant to compile", which only exists after a run:
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
 
 from .findings import Finding, WARN, NOTE
+
+# replicated-large-tensor default threshold; see resolve_replicated_threshold
+DEFAULT_REPLICATED_THRESHOLD = 64 << 20
+
+
+def resolve_replicated_threshold(config=None) -> int:
+    """Threshold for the replicated-large-tensor lint, resolved the usual
+    way: an explicit ``AnalysisConfig(replicated_threshold_bytes=...)`` (or
+    any config carrying that attribute) wins, then the
+    ``HETU_REPLICATED_THRESHOLD_BYTES`` env (how CI tightens it for
+    planner-chosen tp layouts), then the 64 MiB default."""
+    t = getattr(config, "replicated_threshold_bytes", None)
+    if t is None:
+        t = os.environ.get("HETU_REPLICATED_THRESHOLD_BYTES")
+    return DEFAULT_REPLICATED_THRESHOLD if t in (None, "") else int(t)
 
 
 def _fmt_bytes(n) -> str:
@@ -147,13 +163,16 @@ def cost_analysis_of(sub) -> Optional[dict]:
     return sub.last_cost_analysis()
 
 
-def replicated_tensor_findings(sub, threshold_bytes: int = 64 << 20
+def replicated_tensor_findings(sub, threshold_bytes: Optional[int] = None
                                ) -> list[Finding]:
     """Parameters replicated (PartitionSpec ``P()``) across a dp>1 mesh with
     ``nbytes >= threshold`` — each replica burns a full copy of HBM and the
     update is recomputed everywhere (see PAPERS.md: automatic cross-replica
-    sharding of the weight update)."""
+    sharding of the weight update). ``threshold_bytes=None`` resolves via
+    :func:`resolve_replicated_threshold` (config attr → env → 64 MiB)."""
     cfg = sub.config
+    if threshold_bytes is None:
+        threshold_bytes = resolve_replicated_threshold(cfg)
     mesh = getattr(cfg, "mesh", None)
     dp = getattr(cfg, "dp_size", 1)
     if mesh is None or dp <= 1:
@@ -186,7 +205,8 @@ def replicated_tensor_findings(sub, threshold_bytes: int = 64 << 20
 
 
 def analyze_executor(executor, budget: int = 3,
-                     large_tensor_bytes: int = 64 << 20) -> list[Finding]:
+                     large_tensor_bytes: Optional[int] = None
+                     ) -> list[Finding]:
     """All Tier B checks over every subexecutor that has run at least one
     step. Gpipe subexecutors (their own per-stage programs) are skipped."""
     out: list[Finding] = []

@@ -122,3 +122,22 @@ def context(ctx):
 
 def get_current_context():
     return _context_stack[-1] if _context_stack else None
+
+
+def mesh_device_group(dp: int, tp: int = 1, device: str = "tpu",
+                      start: int = 0) -> DeviceGroup:
+    """The DeviceGroup literal for a (dp, tp) mesh in the placement
+    language: a flat group of ``dp`` devices, or ``dp`` uniform
+    ``tp``-tuples (the model-parallel tuple syntax) when ``tp > 1`` —
+    exactly what ``HetuConfig._deduce_mesh`` turns back into a
+    ``jax.sharding.Mesh``. This is how a hetuplan mesh choice
+    (``Plan.device_group()``, docs/ANALYSIS.md "Tier C") maps onto
+    ``Executor(ctx=...)`` without hand-writing device literals."""
+    if dp < 1 or tp < 1:
+        raise ValueError(f"mesh_device_group needs dp>=1, tp>=1; "
+                         f"got dp={dp}, tp={tp}")
+    ids = iter(range(start, start + dp * tp))
+    if tp == 1:
+        return DeviceGroup([f"{device}:{i}" for i in ids])
+    return DeviceGroup([tuple(f"{device}:{next(ids)}" for _ in range(tp))
+                        for _ in range(dp)])

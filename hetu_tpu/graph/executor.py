@@ -102,7 +102,7 @@ class HetuConfig:
                  telemetry=None, introspect=None, comm_quant=None,
                  comm_quant_block=None, comm_quant_min_size=None,
                  comm_quant_error_feedback=None, comm_quant_force=(),
-                 kernels=None, **kwargs):
+                 kernels=None, plan=None, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -169,6 +169,24 @@ class HetuConfig:
         # every trace/lower so interleaved executors never leak settings.
         from ..kernels.registry import resolve_mode as _kresolve
         self.kernels = _kresolve(kernels)
+        # hetuplan (docs/ANALYSIS.md "Tier C: planning"): "auto" asks the
+        # Executor to run the cost-model planner over the graph at build
+        # and adopt its comm_mode / comm_quant choice wherever this config
+        # left them unset (an explicit declaration always wins — hetulint
+        # --plan reports the divergence instead). A prebuilt analysis.Plan
+        # is adopted as-is. Env default: HETU_PLAN=auto (off/0/false/none
+        # disable — the HETU_KERNELS/HETU_COMM_QUANT convention).
+        if plan is None:
+            env_plan = os.environ.get("HETU_PLAN", "").strip().lower()
+            if env_plan and env_plan not in ("off", "0", "false", "none",
+                                             "no"):
+                plan = env_plan
+        if isinstance(plan, str) and plan not in ("auto",):
+            raise ValueError(
+                f"plan must be None, 'auto', or an analysis.Plan; "
+                f"got {plan!r}")
+        self.plan = plan
+        self.plan_adopted = None   # set by Plan.apply at executor build
         if self.comm_quant != "off" and gpipe:
             raise ValueError(
                 "comm_quant is not supported with gpipe=True: the pipeline "
@@ -1530,6 +1548,22 @@ class Executor:
             config = HetuConfig(eval_node_list=all_nodes, ctx=ctx, seed=seed,
                                 comm_mode=comm_mode, **kwargs)
         self.config = config
+        # -- hetuplan adoption (docs/ANALYSIS.md "Tier C: planning") --------
+        # Runs BEFORE comm-op insertion so the adopted comm_mode drives the
+        # same strategy rewrite a hand-declared one would. The planner only
+        # fills fields the config left unset; a declared comm_mode is never
+        # overridden (the plan-divergence lint reports the conflict).
+        self.plan = None
+        if getattr(config, "plan", None) is not None:
+            from ..analysis.planner import Plan as _Plan, plan_graph
+            if isinstance(config.plan, _Plan):
+                self.plan = config.plan
+            else:
+                n_dev = (config.mesh.size if config.mesh is not None
+                         else max(1, len(jax.devices())))
+                self.plan = plan_graph(self.eval_node_dict, config=config,
+                                       devices=n_dev)
+            self.plan.apply(config)
         self.comm_mode = config.comm_mode
 
         # -- telemetry activation (docs/OBSERVABILITY.md) -------------------

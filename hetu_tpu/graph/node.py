@@ -39,11 +39,19 @@ def _as_struct(x) -> jax.ShapeDtypeStruct:
     """Normalize a shape tuple / array / ShapeDtypeStruct into a struct.
 
     Bare shape tuples keep the historical ``infer_shape`` contract of
-    assuming float32 inputs (reference Node.py:95 is shape-only)."""
+    assuming float32 inputs (reference Node.py:95 is shape-only). A tuple
+    whose elements are themselves array-like (the IndexedRows sparse-grad
+    pair from the PR-12 rows route) is a pytree of values, not a shape —
+    it maps elementwise, preserving the NamedTuple type so downstream
+    abstract evaluation sees the same container the trace would."""
     if isinstance(x, jax.ShapeDtypeStruct):
         return x
     if hasattr(x, "shape") and hasattr(x, "dtype"):
         return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    if isinstance(x, tuple) and x and all(
+            hasattr(e, "shape") and hasattr(e, "dtype") for e in x):
+        mapped = [_as_struct(e) for e in x]
+        return type(x)(*mapped) if hasattr(x, "_fields") else tuple(mapped)
     return jax.ShapeDtypeStruct(tuple(int(s) for s in x), np.float32)
 
 

@@ -823,6 +823,23 @@ def roofline_rows(nodes, training: bool = True, target: Optional[str] = None,
     return rows
 
 
+def roofline_report(rows: List[RooflineRow],
+                    peak_tflops: float = DEFAULT_PEAK_TFLOPS,
+                    peak_gbs: float = DEFAULT_PEAK_GBS) -> dict:
+    """The machine-readable residual table (``--roofline --json``): op
+    family, predicted, measured, residual per row, with the assumed peaks
+    the predictions were computed against (an MFU or residual without its
+    peak is not a measurement — docs/ROOFLINE.md). This document is the
+    calibration input ``hetulint --plan --calibrate`` consumes and the
+    thing CI diffs run-over-run."""
+    return {
+        "kind": "roofline",
+        "peak_tflops": peak_tflops,
+        "peak_gbs": peak_gbs,
+        "rows": [r.__dict__ for r in rows],
+    }
+
+
 def format_roofline(rows: List[RooflineRow],
                     peak_tflops: float = DEFAULT_PEAK_TFLOPS,
                     peak_gbs: float = DEFAULT_PEAK_GBS) -> str:
@@ -1224,7 +1241,11 @@ def main(argv=None) -> int:
                              peak_gbs=args.peak_gbs,
                              attribution=attribution, cp=cp)
         if args.as_json:
-            print(json.dumps([r.__dict__ for r in rows], indent=2))
+            # structured residual table — the hetulint --plan --calibrate
+            # input; cost_model.load_calibration also accepts the bare
+            # row-list form this replaced
+            print(json.dumps(roofline_report(
+                rows, args.peak_tflops, args.peak_gbs), indent=2))
         else:
             print(format_roofline(rows, args.peak_tflops, args.peak_gbs))
         return 0

@@ -698,3 +698,24 @@ def test_ps_push_ignored_embed_grad_route():
     multi = build("t_multi", extra_consumer=True)
     assert lints_of(analysis.analyze_graph(multi, config=cfg),
                     "ps-push-ignored")
+
+
+def test_plan_divergence_seeded_defect():
+    """hetuplan (docs/ANALYSIS.md Tier C): a running config whose declared
+    comm strategy contradicts the planner's cost-model choice gets a
+    plan-divergence warning with provenance — the seeded defect is a CTR
+    graph (sparse table + dense towers, planner chooses Hybrid) declared
+    comm_mode='AllReduce' by hand."""
+    from hetu_tpu.analysis.examples import build_ctr_ps
+    graph, _declared = build_ctr_ps()
+    bad_cfg = analysis.AnalysisConfig(comm_mode="AllReduce")
+    plan = analysis.plan_graph(graph, config=bad_cfg, devices=8)
+    assert plan.comm_mode == "Hybrid"
+    divs = [f for f in plan.findings(config=bad_cfg)
+            if f.lint == "plan-divergence"]
+    assert divs and divs[0].severity == "warn"
+    assert divs[0].op_name is not None          # op-level provenance
+    assert "'AllReduce'" in divs[0].message
+    # suppression works like every other lint
+    from hetu_tpu.analysis.findings import is_suppressed
+    assert all(is_suppressed(f, ("plan-divergence",)) for f in divs)

@@ -19,7 +19,7 @@ import bench  # noqa: E402
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
             "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
-            "introspect", "trail", "kernels"]
+            "introspect", "trail", "kernels", "planner"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
@@ -35,6 +35,9 @@ EXPECTED_KEYS = {
     # hetutrail: the overhead A/B must actually have recorded spans, or
     # the on-leg measured nothing (docs/OBSERVABILITY.md pillar 5)
     "trail": ("trail_overhead_pct", "client_spans"),
+    # hetuplan: the cell must carry both sides of the prediction claim
+    # (docs/ANALYSIS.md Tier C)
+    "planner": ("predicted_step_ms", "measured_step_ms", "plan_err_pct"),
 }
 
 
