@@ -1,0 +1,645 @@
+"""hetuchaos: deterministic network-fault chaos engine, invariant checkers,
+and the soak driver (docs/FAULT_TOLERANCE.md "Chaos testing & transport
+hardening").
+
+The C++ engine (csrc/ps/chaos.h, armed via ``PSClient.SetChaos`` /
+``HETU_CHAOS_SPEC``, HETU_TEST_MODE-gated) injects message-level faults —
+drop, delay, duplicate, reorder, corrupt-bytes, directed partitions —
+into the PS transport from a seeded PRNG, logging every injection to a
+bounded event ring. This module is everything above the wire:
+
+- the **spec grammar** (:func:`parse_spec` / :func:`render_spec` /
+  :func:`random_spec`) mirrored against the C++ parser;
+- the **backoff schedule mirror** (:func:`backoff_ms` /
+  :func:`backoff_schedule`), bit-identical to ``csrc/ps/chaos.h`` — the
+  fake-clock tests pin both sides;
+- the **invariant checkers** past PRs proved ad hoc, formalized as
+  reusable functions: exactly-once sample consumption (the era algebra of
+  PR 11), no-double-apply / exact update-counter accounting (the dedup
+  ledger of PR 4, now checkable as ``client pushes_ok == Σ server
+  updates``), and params-untouched-on-reject (the kQI8 contract of PR 8,
+  generalized to CRC);
+- the **soak driver** (:func:`run_soak`): a live ``local_cluster``
+  training job under a seeded random schedule, all checkers asserted,
+  final loss/params compared BIT-IDENTICALLY to the fault-free twin.
+
+Everything above ``run_job`` is stdlib+numpy (``bin/hetuchaos --check``
+must run jax-free); jax/hetu imports are lazy inside the drivers.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Kind ids: the drain contract with csrc/ps/chaos.h ChaosKind
+# ---------------------------------------------------------------------------
+
+KIND_NAMES = {1: "drop", 2: "delay", 3: "dup", 4: "reorder", 5: "corrupt",
+              6: "partition", 7: "droprsp"}
+KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
+# columns of one drained chaos event row (PSClient.DrainChaosEvents)
+EVENT_COLS = ("kind", "server", "psf", "tensor", "seq", "arg")
+
+_MASK64 = (1 << 64) - 1
+
+
+def splitmix64(x: int) -> int:
+    """Python mirror of ``hetups::splitmix64`` (csrc/ps/chaos.h)."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+def backoff_ms(attempt: int, base_ms: int = 10, cap_ms: int = 2000,
+               key: int = 0) -> int:
+    """Retry backoff for attempt N (1-based): exponential ``base << (N-1)``
+    capped at ``cap_ms``, scaled by a deterministic jitter in [0.5, 1.0)
+    derived from splitmix64. Bit-identical to ``hetups::backoff_ms`` —
+    pure integer math on both sides, so the schedule the C++ transport
+    actually sleeps is exactly what these tests assert about."""
+    attempt = max(1, int(attempt))
+    exp = min(int(base_ms) << min(attempt - 1, 20), int(cap_ms))
+    j = splitmix64((int(key) ^ attempt) & _MASK64) % 500
+    return exp * (500 + j) // 1000
+
+
+def backoff_schedule(attempts: int, base_ms: int = 10, cap_ms: int = 2000,
+                     key: int = 0) -> list[int]:
+    """Per-attempt backoffs for a whole retry sequence (what a clock would
+    observe between attempt N and N+1)."""
+    return [backoff_ms(a, base_ms, cap_ms, key)
+            for a in range(1, int(attempts) + 1)]
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar (mirror of csrc/ps/chaos.h ChaosEngine::parse)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ChaosSpec:
+    """Parsed ``HETU_CHAOS_SPEC``. Probabilities are per-message and
+    cumulative-walked in the fixed order drop, droprsp, dup, corrupt,
+    delay, reorder (at most ONE scheduled fault per message); partitions
+    are (server, from, count) windows over per-(server, channel) RPC
+    ATTEMPTS — they block retries too, until the window closes."""
+
+    seed: int = 0
+    drop: float = 0.0
+    droprsp: float = 0.0
+    dup: float = 0.0
+    corrupt: float = 0.0
+    delay: float = 0.0
+    delay_ms: int = 20
+    reorder: float = 0.0
+    reorder_ms: int = 10
+    partitions: list = field(default_factory=list)  # [(server, from, count)]
+
+
+_PROB_KEYS = ("drop", "droprsp", "dup", "corrupt")
+
+
+def parse_spec(spec: str) -> ChaosSpec:
+    """Parse a chaos spec string, rejecting unknown kinds with the known
+    list (the HETU_FAULT_SPEC convention). Mirrors the C++ parser — the
+    round-trip test pins them to the same grammar."""
+    cs = ChaosSpec()
+    for ent in (spec or "").split(","):
+        ent = ent.strip()
+        if not ent:
+            continue
+        key, sep, val = ent.partition("=")
+        if not sep:
+            raise ValueError(f"chaos spec entry {ent!r}: expected key=value")
+        if key == "seed":
+            cs.seed = int(val)
+        elif key in _PROB_KEYS:
+            setattr(cs, key, _parse_p(ent, val))
+        elif key in ("delay", "reorder"):
+            p, _, ms = val.partition(":")
+            setattr(cs, key, _parse_p(ent, p))
+            if ms:
+                setattr(cs, key + "_ms", max(1, int(ms)))
+        elif key == "partition":
+            parts = val.split(":")
+            if len(parts) != 3:
+                raise ValueError(f"chaos spec entry {ent!r}: "
+                                 "partition=SERVER:FROM:COUNT")
+            cs.partitions.append((int(parts[0]), int(parts[1]),
+                                  int(parts[2])))
+        else:
+            raise ValueError(
+                f"chaos spec entry {ent!r}: unknown kind {key!r} — known: "
+                "seed, drop, droprsp, dup, corrupt, delay[:ms], "
+                "reorder[:ms], partition=SERVER:FROM:COUNT "
+                "(docs/FAULT_TOLERANCE.md)")
+    return cs
+
+
+def _parse_p(ent: str, val: str) -> float:
+    p = float(val)
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"chaos spec entry {ent!r}: probability must be "
+                         "in [0, 1]")
+    return p
+
+
+def render_spec(cs: ChaosSpec) -> str:
+    """Inverse of :func:`parse_spec` (parse(render(x)) == x)."""
+    parts = [f"seed={cs.seed}"]
+    for k in _PROB_KEYS:
+        v = getattr(cs, k)
+        if v > 0:
+            parts.append(f"{k}={v:g}")
+    if cs.delay > 0:
+        parts.append(f"delay={cs.delay:g}:{cs.delay_ms}")
+    if cs.reorder > 0:
+        parts.append(f"reorder={cs.reorder:g}:{cs.reorder_ms}")
+    for srv, frm, cnt in cs.partitions:
+        parts.append(f"partition={srv}:{frm}:{cnt}")
+    return ",".join(parts)
+
+
+def random_spec(seed: int, servers: int = 2, intensity: float = 0.06,
+                partition: bool = True) -> str:
+    """A seeded random schedule mixing every fault kind — what
+    ``bin/hetuchaos --seed S`` runs. Deterministic: the same seed yields
+    the same spec string. ``intensity`` bounds each per-message fault
+    probability; the partition window (when enabled) is short enough for
+    the default retry budget (DMLC_PS_MAX_RETRY=3 means a window of <= 3
+    attempts heals within one RPC's retries, exercising the path without
+    requiring failover to be armed)."""
+    rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+    cs = ChaosSpec(seed=int(seed))
+    kinds = ["drop", "droprsp", "dup", "corrupt", "delay", "reorder"]
+    # 3-5 scheduled kinds active per spec, probabilities in (0, intensity]
+    active = rng.choice(kinds, size=rng.randint(3, len(kinds) + 1),
+                        replace=False)
+    for k in active:
+        setattr(cs, k, round(float(rng.uniform(0.01, intensity)), 4))
+    cs.delay_ms = int(rng.randint(1, 8))
+    cs.reorder_ms = int(rng.randint(1, 8))
+    if partition and servers > 0:
+        # a transient directed partition: 1-2 consecutive failed attempts
+        # against one server, somewhere in the first ~40 attempts
+        cs.partitions.append((int(rng.randint(0, servers)),
+                              int(rng.randint(0, 40)),
+                              int(rng.randint(1, 3))))
+    return render_spec(cs)
+
+
+# ---------------------------------------------------------------------------
+# Event-log helpers
+# ---------------------------------------------------------------------------
+
+def events_to_dicts(rows) -> list[dict]:
+    """(n, 6) int64 drain rows -> dict rows with named kinds."""
+    out = []
+    for r in np.asarray(rows, np.int64).reshape(-1, len(EVENT_COLS)):
+        d = dict(zip(EVENT_COLS, (int(x) for x in r)))
+        d["kind"] = KIND_NAMES.get(d["kind"], str(d["kind"]))
+        out.append(d)
+    return out
+
+
+def canonical_log(rows) -> list[tuple]:
+    """The ORDER-FREE canonical form of a chaos event log: sorted tuples.
+    Ring append order depends on thread interleaving (the pool races
+    servers); the DECISIONS do not — each is a pure function of (seed,
+    server, psf, tensor, per-triple seq). Two runs of the same workload
+    under the same spec must produce EQUAL canonical logs; that equality
+    is the determinism acceptance test."""
+    return sorted(tuple(int(x) for x in r)
+                  for r in np.asarray(rows, np.int64)
+                  .reshape(-1, len(EVENT_COLS)))
+
+
+def fault_counts(rows) -> dict:
+    """Per-kind injected-fault totals (the hetu_chaos_faults_total{kind}
+    export)."""
+    out: dict[str, int] = {}
+    for d in events_to_dicts(rows):
+        out[d["kind"]] = out.get(d["kind"], 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Invariant checkers (the library past PRs proved ad hoc)
+# ---------------------------------------------------------------------------
+
+class InvariantViolation(AssertionError):
+    """An invariant checker found the system in a state the transport
+    hardening is supposed to make impossible."""
+
+
+def check_update_accounting(client_stats: dict,
+                            server_stats: list[dict]) -> dict:
+    """Exact no-double-apply / no-lost-update accounting (PR 4's dedup
+    ledger, as one equation): each LOGICAL write RPC the client completed
+    (``pushes_ok`` — counted once however many retries, duplicates, or
+    re-issues it took) must equal the servers' summed optimizer update
+    counters. A double-apply (a duplicate that escaped the dedup slot)
+    pushes the right side high; a lost-but-acked update pushes it low.
+    Valid for fresh servers (``restored_updates == -1``) serving one
+    worker — the soak's shape."""
+    expected = int(client_stats["pushes_ok"])
+    applied = sum(int(s["updates"]) for s in server_stats)
+    ok = expected == applied
+    report = {"name": "update_accounting", "ok": ok,
+              "client_pushes_ok": expected, "server_updates": applied}
+    if not ok:
+        raise InvariantViolation(
+            f"update-counter accounting broken: client completed {expected} "
+            f"write RPCs but servers applied {applied} updates "
+            f"({'double-apply' if applied > expected else 'lost update'})")
+    return report
+
+
+def check_exactly_once_consumption(consumed, expected) -> dict:
+    """Exactly-once sample consumption: the multiset of consumed sample
+    indices equals the expected multiset — no sample trained twice, none
+    skipped. The single-worker form of PR 11's era algebra (for elastic
+    resizes, ``elastic.era_partitions`` produces ``expected`` per
+    member)."""
+    c = np.sort(np.asarray(consumed).ravel())
+    e = np.sort(np.asarray(expected).ravel())
+    ok = c.shape == e.shape and bool(np.array_equal(c, e))
+    report = {"name": "exactly_once_consumption", "ok": ok,
+              "consumed": int(c.size), "expected": int(e.size)}
+    if not ok:
+        raise InvariantViolation(
+            f"sample consumption not exactly-once: consumed {c.size} vs "
+            f"expected {e.size} (or differing multisets)")
+    return report
+
+
+def check_bit_identical(chaos_values, baseline_values,
+                        what: str = "params") -> dict:
+    """Bit-identical final state vs the fault-free twin: every fault the
+    schedule injected was fully absorbed by the transport (retry applied
+    exactly once, rejects left params untouched, duplicates were served
+    from the dedup slot). ``allclose`` would hide a half-applied update;
+    only equality proves absorption."""
+    ca = [np.asarray(a) for a in chaos_values]
+    ba = [np.asarray(b) for b in baseline_values]
+    ok = len(ca) == len(ba) and all(
+        a.shape == b.shape and bool(np.array_equal(a, b))
+        for a, b in zip(ca, ba))
+    report = {"name": f"bit_identical_{what}", "ok": ok, "n": len(ca)}
+    if not ok:
+        bad = [i for i, (a, b) in enumerate(zip(ca, ba))
+               if a.shape != b.shape or not np.array_equal(a, b)]
+        raise InvariantViolation(
+            f"{what} diverged from the fault-free run at indices {bad[:8]} "
+            f"— a fault leaked through the transport hardening")
+    return report
+
+
+def check_rejects_left_params_untouched(client_stats: dict,
+                                        server_stats: list[dict],
+                                        parity_report: dict) -> dict:
+    """Params-untouched-on-reject: every CRC reject the servers issued
+    was a clean refusal. Meaningful only alongside bit-identical parity —
+    a reject that half-applied would break parity; this checker pins that
+    the schedule actually EXERCISED the reject path (rejects observed on
+    both sides) so the parity proof covers it."""
+    srv = sum(int(s.get("crc_rejects", 0)) for s in server_stats)
+    cli = int(client_stats.get("crc_rejects", 0))
+    ok = bool(parity_report.get("ok")) and cli >= srv > 0
+    report = {"name": "params_untouched_on_reject", "ok": ok,
+              "server_rejects": srv, "client_rejects_observed": cli}
+    if not ok:
+        raise InvariantViolation(
+            f"reject path not proven: servers rejected {srv}, client "
+            f"observed {cli}, parity={parity_report.get('ok')} — with a "
+            "corrupt fault armed the schedule must produce rejects AND "
+            "bit-identical final state")
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Soak driver (live local_cluster training job)
+# ---------------------------------------------------------------------------
+
+#: the soak job's fixed shape (kept tiny: the CI soak must stay <= 60 s)
+SOAK_ROWS, SOAK_WIDTH, SOAK_SLOTS, SOAK_BATCH = 60, 8, 4, 16
+
+
+def run_job(seed: int, steps: int, n_servers: int = 2,
+            chaos_spec: Optional[str] = None) -> dict:
+    """One live training run: scheduler + ``n_servers`` PS servers
+    (local_cluster), this process the worker, a CTR-shaped model (sparse
+    embedding + dense head, both PS-hosted via comm_mode='PS') trained
+    ``steps`` steps on deterministic batches. Synchronous I/O
+    (prefetch=False) so the run is bit-reproducible — the determinism the
+    parity checker needs is the job's, leaving any divergence
+    attributable to the transport.
+
+    Returns losses, final param values, client/server stats, the drained
+    chaos event log, and the consumed sample indices."""
+    from .ps.local_cluster import local_cluster
+    from . import ps as ps_pkg
+
+    with local_cluster(n_servers=n_servers, n_workers=1):
+        import hetu_tpu as ht
+        ps_pkg.worker_init()
+        comm = ps_pkg.get_worker_communicate()
+        embed = ht.init.random_normal((SOAK_ROWS, SOAK_WIDTH), stddev=0.1,
+                                      name="chaos_embed", is_embed=True)
+        idx = ht.Variable(name="idx", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        vec = ht.embedding_lookup_op(embed, idx)
+        flat = ht.array_reshape_op(vec, (-1, SOAK_SLOTS * SOAK_WIDTH))
+        w = ht.init.xavier_uniform((SOAK_SLOTS * SOAK_WIDTH, 1), name="w")
+        prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+        loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         seed=0, comm_mode="PS", prefetch=False)
+        try:
+            if chaos_spec:
+                comm.SetChaos(chaos_spec)
+            rng = np.random.RandomState(seed)
+            losses, consumed, step_errors = [], [], []
+            for step in range(int(steps)):
+                bidx = rng.randint(0, SOAK_ROWS,
+                                   (SOAK_BATCH, SOAK_SLOTS)).astype(
+                                       np.float32)
+                by = ((bidx >= SOAK_ROWS // 2).sum(axis=1) >
+                      SOAK_SLOTS // 2).reshape(-1, 1).astype(np.float32)
+                try:
+                    out = ex.run("train", feed_dict={idx: bidx, y_: by})
+                except Exception as e:  # noqa: BLE001 — a fault the
+                    # hardening failed to absorb: record the hole and keep
+                    # going, so the CHECKERS (not a traceback) report it —
+                    # this step's samples are missing from `consumed`,
+                    # breaking exactly-once; its loss is missing, breaking
+                    # loss parity
+                    step_errors.append((step, repr(e)))
+                    continue
+                losses.append(float(out[0].asnumpy()))
+                # recorded only for steps that COMPLETED: the consumption
+                # multiset is an observation of delivered work, falsified
+                # by any step the transport lost
+                consumed.append(step * SOAK_BATCH +
+                                np.arange(SOAK_BATCH))
+            rt = ex.ps_runtime
+            rt.drain()
+            finals = []
+            for p in sorted(rt.params.values(), key=lambda p: p.ps_id):
+                if p.sparse:
+                    finals.append(rt.pull_sparse_rows(
+                        p, np.arange(SOAK_ROWS)))
+                else:
+                    finals.append(rt.pull_dense_value(p))
+            client_stats = comm.ClientStats()
+            server_stats = [comm.ServerStats(s) for s in range(n_servers)]
+            events = comm.DrainChaosEvents()
+            if chaos_spec:
+                comm.SetChaos(None)
+        finally:
+            ex.close()
+            ps_pkg.worker_finish()
+        return {"losses": losses, "finals": finals,
+                "step_errors": step_errors,
+                "client_stats": client_stats, "server_stats": server_stats,
+                "events": events,
+                "consumed": np.concatenate(consumed) if consumed else
+                np.zeros(0, np.int64)}
+
+
+def run_soak(seed: int, steps: int = 24, n_servers: int = 2,
+             spec: Optional[str] = None) -> dict:
+    """The acceptance loop of one seeded schedule: fault-free twin first,
+    then the chaos run under ``spec`` (default: :func:`random_spec`),
+    then every invariant checker. Requires HETU_TEST_MODE (set it before
+    calling, as bin/hetuchaos does) — SetChaos refuses otherwise.
+
+    Raises :class:`InvariantViolation` on any broken invariant; returns
+    the full report dict on success."""
+    spec = spec or random_spec(seed, servers=n_servers)
+    cs = parse_spec(spec)
+    base = run_job(seed, steps, n_servers, chaos_spec=None)
+    chaos = run_job(seed, steps, n_servers, chaos_spec=spec)
+
+    if chaos["step_errors"]:
+        # surfaced FIRST with the actual exceptions: the checkers below
+        # would also fail (missing consumption/losses), but "step 7 raised
+        # X" beats "multiset differs" as a diagnosis
+        raise InvariantViolation(
+            f"{len(chaos['step_errors'])} step(s) raised under {spec!r} — "
+            "the hardening failed to absorb a fault: "
+            f"{chaos['step_errors'][:4]}")
+    checks = [
+        check_update_accounting(chaos["client_stats"],
+                                chaos["server_stats"]),
+        # single-worker form: the chaos run COMPLETED exactly the steps
+        # the fault-free twin did (consumption is recorded per completed
+        # step and a failed step is skipped-not-raised in run_job, so a
+        # lost step breaks the multiset instead of aborting the job).
+        # The multi-member era-algebra form of this checker is exercised
+        # with real resize partitions in tests/test_elastic.py.
+        check_exactly_once_consumption(chaos["consumed"],
+                                       base["consumed"]),
+        check_bit_identical(chaos["finals"], base["finals"], "params"),
+        check_bit_identical([np.asarray(chaos["losses"])],
+                            [np.asarray(base["losses"])], "losses"),
+    ]
+    parity = checks[2]
+    counts = fault_counts(chaos["events"])
+    # gate on INJECTED corrupts, not the configured probability: a small
+    # p over a short soak can legitimately roll zero corrupts, and the
+    # reject proof is only owed for faults that actually fired
+    if counts.get("corrupt", 0) > 0:
+        checks.append(check_rejects_left_params_untouched(
+            chaos["client_stats"], chaos["server_stats"], parity))
+    # the schedule must have actually injected something, or the soak
+    # proved nothing (a zero-probability spec silently "passing" is the
+    # no-silent-caps failure mode)
+    if not counts:
+        raise InvariantViolation(
+            f"schedule {spec!r} injected zero faults over {steps} steps — "
+            "raise intensity or steps; a faultless soak proves nothing")
+    report = {
+        "seed": int(seed), "steps": int(steps), "spec": spec,
+        "faults": counts,
+        "checks": checks,
+        "client_stats": chaos["client_stats"],
+        "final_loss": chaos["losses"][-1] if chaos["losses"] else None,
+        "ok": all(c["ok"] for c in checks),
+    }
+    _export_telemetry(report)
+    return report
+
+
+def _export_telemetry(report: dict) -> None:
+    """hetu_chaos_faults_total{kind} + hardening counters through the
+    telemetry bus (no-op when telemetry is off). Never raises."""
+    try:
+        from . import telemetry as _telemetry
+        tel = _telemetry.get()
+        if tel is None:
+            return
+        reg = tel.metrics
+        for kind, n in report.get("faults", {}).items():
+            reg.gauge("hetu_chaos_faults_total", {"kind": kind}).set(n)
+        cs = report.get("client_stats", {})
+        reg.gauge("hetu_rpc_timeouts_total").set(cs.get("timeouts", 0))
+        reg.gauge("hetu_rpc_backoff_ms").set(cs.get("backoff_ms", 0))
+        reg.gauge("hetu_crc_rejects_total").set(cs.get("crc_rejects", 0))
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+
+
+# ---------------------------------------------------------------------------
+# jax-free self-test (bin/hetuchaos --check)
+# ---------------------------------------------------------------------------
+
+def self_check(out=None) -> int:
+    """CI smoke with no cluster and no jax: grammar round-trip, unknown-
+    kind rejection, backoff mirror values, random_spec determinism,
+    canonical-log algebra, and each checker's accept AND reject paths.
+    Returns 0 on success (the bin/hetu* --check convention)."""
+    import sys
+    out = out or sys.stdout
+
+    cs = parse_spec("seed=42,drop=0.1,delay=0.2:7,partition=1:10:30")
+    assert cs.seed == 42 and cs.drop == 0.1 and cs.delay_ms == 7
+    assert cs.partitions == [(1, 10, 30)]
+    assert parse_spec(render_spec(cs)) == cs
+    for bad in ("flood=0.5", "drop=1.5", "partition=1:2"):
+        try:
+            parse_spec(bad)
+            raise AssertionError(f"{bad!r} accepted")
+        except ValueError:
+            pass
+
+    sched = backoff_schedule(4, base_ms=10, cap_ms=2000, key=7)
+    assert len(sched) == 4 and all(b >= 1 for b in sched)
+    for a, b in enumerate(sched, 1):
+        exp = min(10 << (a - 1), 2000)
+        assert exp // 2 <= b < exp, (a, b)   # jitter in [0.5, 1.0)
+    assert sched == backoff_schedule(4, base_ms=10, cap_ms=2000, key=7)
+
+    assert random_spec(3) == random_spec(3)
+    assert random_spec(3) != random_spec(4)
+    parse_spec(random_spec(5))  # every generated spec must parse
+
+    rows = np.array([[1, 0, 20, 1, 3, 0], [5, 1, 20, 1, 1, 9]], np.int64)
+    assert canonical_log(rows) == canonical_log(rows[::-1])
+    assert fault_counts(rows) == {"drop": 1, "corrupt": 1}
+
+    ok_cs = {"pushes_ok": 4, "crc_rejects": 2}
+    ok_ss = [{"updates": 3, "crc_rejects": 1}, {"updates": 1,
+                                                "crc_rejects": 1}]
+    assert check_update_accounting(ok_cs, ok_ss)["ok"]
+    try:
+        check_update_accounting({"pushes_ok": 4}, [{"updates": 5}])
+        raise AssertionError("double-apply not caught")
+    except InvariantViolation:
+        pass
+    assert check_exactly_once_consumption([2, 0, 1], [0, 1, 2])["ok"]
+    try:
+        check_exactly_once_consumption([0, 0, 1], [0, 1, 2])
+        raise AssertionError("double-consumption not caught")
+    except InvariantViolation:
+        pass
+    a = [np.arange(6).reshape(2, 3).astype(np.float32)]
+    assert check_bit_identical(a, [a[0].copy()])["ok"]
+    try:
+        check_bit_identical(a, [a[0] + 1e-7])
+        raise AssertionError("divergence not caught")
+    except InvariantViolation:
+        pass
+    parity = {"ok": True}
+    assert check_rejects_left_params_untouched(ok_cs, ok_ss, parity)["ok"]
+    try:
+        check_rejects_left_params_untouched(
+            {"pushes_ok": 4, "crc_rejects": 0},
+            [{"updates": 4, "crc_rejects": 0}], parity)
+        raise AssertionError("unexercised reject path not caught")
+    except InvariantViolation:
+        pass
+
+    print("hetuchaos --check: spec grammar, backoff mirror, canonical "
+          "log, and all invariant checkers OK", file=out)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI (bin/hetuchaos)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    """``hetuchaos --seed S --steps N``: live seeded soak (fault-free twin
+    + chaos run + every invariant checker). ``--seeds A,B,C`` runs several
+    schedules; ``--spec`` overrides the generated schedule; ``--check``
+    is the jax-free CI self-test. Exit 0 = all invariants green."""
+    import argparse
+    import json as _json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="hetuchaos",
+        description="deterministic PS-transport chaos soak "
+                    "(docs/FAULT_TOLERANCE.md)")
+    ap.add_argument("--check", action="store_true",
+                    help="jax-free self-test (CI smoke); exit 0/1")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--seeds", type=str, default=None,
+                    help="comma-separated seed list (overrides --seed)")
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--servers", type=int, default=2)
+    ap.add_argument("--spec", type=str, default=None,
+                    help="explicit chaos spec (default: random_spec(seed))")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON report line per seed")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        try:
+            return self_check()
+        except AssertionError as e:
+            print(f"hetuchaos --check FAILED: {e}", file=sys.stderr)
+            return 1
+
+    # the soak arms destructive hooks by definition — it IS the test mode
+    os.environ.setdefault("HETU_TEST_MODE", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the package form of this module (the bin script loads this file by
+    # path, which cannot resolve the relative imports the drivers need)
+    import hetu_tpu.chaos as chaos_pkg
+
+    seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
+             if args.seeds else [args.seed])
+    rc = 0
+    for seed in seeds:
+        try:
+            rep = chaos_pkg.run_soak(seed, steps=args.steps,
+                                     n_servers=args.servers,
+                                     spec=args.spec)
+        except chaos_pkg.InvariantViolation as e:
+            spec = args.spec or chaos_pkg.random_spec(
+                seed, servers=args.servers)
+            print(f"# seed {seed} VIOLATION under {spec!r}: {e}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            print(_json.dumps(rep, default=str))
+        else:
+            faults = " ".join(f"{k}:{v}" for k, v in
+                              sorted(rep["faults"].items()))
+            checks = " ".join(
+                f"{c['name']}={'ok' if c['ok'] else 'FAIL'}"
+                for c in rep["checks"])
+            print(f"# seed {seed} spec {rep['spec']!r}\n"
+                  f"#   faults {faults}\n"
+                  f"#   {checks}\n"
+                  f"#   final loss {rep['final_loss']:.6f} "
+                  f"(bit-identical to fault-free twin)")
+    return rc

@@ -20,7 +20,7 @@ _TARGETS = {
     "libhetu_ps.so": {
         "srcs": ["ps/capi.cc", "cache/cache_capi.cc"],
         "deps": ["ps/net.h", "ps/store.h", "ps/server.h", "ps/scheduler.h",
-                 "ps/worker.h", "ps/ring.h", "cache/cache.h"],
+                 "ps/worker.h", "ps/ring.h", "ps/chaos.h", "cache/cache.h"],
     },
 }
 

@@ -340,7 +340,7 @@ const char* getLoads() {
 // Per-server HA + health counters: fills up to n of [updates,
 // snapshot_updates, restored_updates (-1 = fresh), snapshot_version,
 // n_params, requests, apply_ns, apply_count, snapshot_age_ms (-1 = none),
-// dedup_clients] (server.h kServerStats).
+// dedup_clients, crc_rejects] (server.h kServerStats).
 void QueryServerStats(int server, long long* out, int n) {
   guard([&] {
     auto v = worker().server_stats(static_cast<size_t>(server));
@@ -375,6 +375,44 @@ int RefreshServers() {
 // push/pull traffic. mode != 0 enables; the env default is HETU_COMM_QUANT.
 void SetCommQuant(int mode) {
   guard([&] { worker().set_quant(mode != 0); });
+}
+
+// -- hetuchaos (docs/FAULT_TOLERANCE.md "Chaos testing") --------------------
+
+// CRC32C payload checksums on this worker's PS traffic (default ON; the
+// env default is HETU_PS_CRC at Init — 0 disables). The server side needs
+// no knob: it verifies and checksums per request via the kFlagCrc
+// negotiation, so a live A/B toggles both legs from the client alone.
+void SetPsCrc(int on) {
+  guard([&] { worker().set_crc(on != 0); });
+}
+
+// Arm a seeded chaos schedule on this worker's transport (empty/NULL spec
+// disarms). Destructive by design, so arming requires HETU_TEST_MODE —
+// the HETU_CHAOS_SPEC env arming in the worker ctor is gated the same way.
+// Grammar: csrc/ps/chaos.h / hetu_tpu.chaos.parse_spec.
+void SetChaos(const char* spec) {
+  guard([&] {
+    const std::string s = spec ? spec : "";
+    if (!s.empty() && !hetups::env_test_mode())
+      throw std::runtime_error("SetChaos requires HETU_TEST_MODE");
+    worker().set_chaos(s);
+  });
+}
+
+// Drain up to max_rows injected-fault events (oldest first) into out as
+// 6-wide i64 rows: [kind, server, psf, tensor, seq, arg] — kind ids in
+// csrc/ps/chaos.h (mirrored by hetu_tpu.chaos.KIND_NAMES). Deterministic
+// given the spec's seed and the workload: the SORTED log of a replay is
+// identical. Returns the row count (0 when chaos was never armed).
+long DrainChaosEvents(long long* out, int max_rows) {
+  long n = 0;
+  guard([&] {
+    n = static_cast<long>(worker().drain_chaos(
+        reinterpret_cast<int64_t*>(out),
+        max_rows > 0 ? static_cast<size_t>(max_rows) : 0));
+  });
+  return n;
 }
 
 // hetuq test hook (inert without HETU_TEST_MODE): corrupt the scale bytes
@@ -436,8 +474,9 @@ void TestSlowApply(int server, int ms) {
 }
 
 // Worker-side RPC counters: fills up to n of [rpcs, retries, failovers,
-// quant raw value bytes, quant wire value bytes] (worker.h client_stats —
-// the telemetry twin of QueryServerStats).
+// quant raw value bytes, quant wire value bytes, rpc timeouts, backoff ms
+// slept, crc rejects observed, chaos faults injected, write RPCs landed]
+// (worker.h client_stats — the telemetry twin of QueryServerStats).
 void QueryClientStats(long long* out, int n) {
   guard([&] {
     auto v = worker().client_stats();

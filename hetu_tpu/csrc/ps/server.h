@@ -38,15 +38,8 @@ namespace hetups {
 inline thread_local int64_t g_trail_apply_t0 = 0;
 inline thread_local int64_t g_trail_apply_us = 0;
 
-// The single truthy-env convention shared with the Python side
-// (resilience.env_truthy): destructive test hooks are inert without it.
-inline bool env_test_mode() {
-  const char* v = std::getenv("HETU_TEST_MODE");
-  if (!v) return false;
-  std::string s(v);
-  for (auto& c : s) c = static_cast<char>(std::tolower(c));
-  return s == "1" || s == "true" || s == "yes" || s == "on";
-}
+// env_test_mode (the single truthy-env gate for destructive test hooks)
+// moved to net.h so the worker's chaos arming shares it.
 
 class PsServer {
  public:
@@ -227,6 +220,36 @@ class PsServer {
           continue;
         }
       }
+      // hetuchaos transport hardening: verify payload CRCs BEFORE the
+      // dedup slot and BEFORE any handling — a corrupted request must
+      // leave params, update counters, AND the dedup ledger untouched
+      // (advancing slot->last_id on garbage would make the clean resend
+      // look like a stale straggler and silently drop it). The reject is
+      // an error response marked "retryable:" so the client resends
+      // instead of surfacing an app-level failure — exactly the malformed
+      // kQI8 contract, applied to every payload.
+      if (req.head.flags != -1 && (req.head.flags & kFlagCrc)) {
+        std::string cerr;
+        if (!verify_msg_crc(req, &cerr)) {
+          crc_reject_count_.fetch_add(1, std::memory_order_relaxed);
+          Message rej;
+          rej.head.type = static_cast<int32_t>(PsfType::kAck);
+          rej.head.tensor_id = req.head.tensor_id;
+          rej.head.req_id = req.head.req_id;
+          rej.head.flags = -1;
+          rej.args.push_back(Arg::str(
+              "retryable: payload CRC mismatch on psf " +
+              std::to_string(req.head.type) + " tensor " +
+              std::to_string(req.head.tensor_id) + " (" + cerr +
+              ") — request not applied; resend"));
+          try {
+            send_msg(fd, rej);
+          } catch (...) {
+            break;
+          }
+          continue;
+        }
+      }
       ClientSlot* slot =
           (req.head.client_id >= 0 && req.head.req_id > 0)
               ? client_slot(req.head.client_id)
@@ -279,6 +302,12 @@ class PsServer {
         rsp.args.clear();
         rsp.args.push_back(Arg::str(e.what()));
       }
+      // answer a CRC-speaking client in kind: send_msg checksums the
+      // response args so the client can reject a corrupted return leg
+      // (error responses stay flags == -1, never checksummed)
+      if (req.head.flags != -1 && (req.head.flags & kFlagCrc) &&
+          rsp.head.flags != -1)
+        rsp.head.flags |= kFlagCrc;
       if (wseq != 0) {
         // apply latency (kServerStats): wall time of requests that applied
         // a write, accumulated as ns + count so the client derives the avg
@@ -959,9 +988,10 @@ class PsServer {
         // update counter restored from (-1 = fresh start), snapshot version,
         // live param count, requests served, apply ns total, apply count,
         // snapshot age ms (-1 = none taken by THIS incarnation), dedup-
-        // ledger occupancy]. Slots 0-4 are the PR-4 lost-update accounting
-        // surface; 5-9 are the telemetry health extension (clients that ask
-        // for fewer slots still get a valid prefix — the reply is
+        // ledger occupancy, CRC-rejected requests]. Slots 0-4 are the PR-4
+        // lost-update accounting surface; 5-9 the telemetry health
+        // extension; 10 the hetuchaos transport-hardening counter (clients
+        // that ask for fewer slots still get a valid prefix — the reply is
         // length-prefixed and QueryServerStats copies min(n, len)).
         int64_t n_params = 0;
         store_.for_each([&](int32_t, Param&) { ++n_params; });
@@ -972,7 +1002,7 @@ class PsServer {
         }
         const int64_t snap_at = last_snapshot_steady_ms_.load();
         const int64_t age_ms = snap_at ? steady_now_ms() - snap_at : -1;
-        int64_t stats[10] = {
+        int64_t stats[11] = {
             static_cast<int64_t>(update_count_.load()),
             static_cast<int64_t>(last_snapshot_counter_.load()),
             restored_counter_.load(),
@@ -982,8 +1012,9 @@ class PsServer {
             static_cast<int64_t>(apply_ns_.load()),
             static_cast<int64_t>(apply_count_.load()),
             age_ms,
-            dedup_clients};
-        rsp->args.push_back(Arg::i64(stats, 10));
+            dedup_clients,
+            static_cast<int64_t>(crc_reject_count_.load())};
+        rsp->args.push_back(Arg::i64(stats, 11));
         break;
       }
       default:
@@ -1456,8 +1487,9 @@ class PsServer {
   // idle-check reads (take_snapshot itself serializes via snap_take_mu_)
   std::atomic<size_t> last_snapshot_params_{0};
   std::atomic<uint64_t> last_snapshot_write_seq_{0};
-  // -- telemetry health counters (kServerStats slots 5-9) ------------------
+  // -- telemetry health counters (kServerStats slots 5-10) -----------------
   std::atomic<uint64_t> req_count_{0};      // requests served (all types)
+  std::atomic<uint64_t> crc_reject_count_{0};  // hetuchaos: CRC rejects
   std::atomic<uint64_t> apply_ns_{0};       // wall ns spent in write applies
   std::atomic<uint64_t> apply_count_{0};
   std::atomic<int64_t> last_snapshot_steady_ms_{0};  // 0 = none yet

@@ -23,6 +23,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "chaos.h"
 #include "net.h"
 #include "store.h"
 
@@ -149,6 +150,25 @@ class PsWorker {
     // for a replacement server to register instead of throwing (0 = off)
     failover_ms_ = env_int_or("DMLC_PS_FAILOVER_DEADLINE_MS", 0);
     failover_poll_ms_ = env_int_or("DMLC_PS_FAILOVER_POLL_MS", 500);
+    // hetuchaos transport hardening (docs/FAULT_TOLERANCE.md): retries
+    // back off exponentially with deterministic jitter instead of
+    // hammering a struggling server in a tight loop, and an optional
+    // per-RPC wall deadline bounds the whole retry phase (0 = the retry
+    // count alone bounds it, the pre-chaos semantics).
+    backoff_base_ms_ = env_int_or("DMLC_PS_BACKOFF_BASE_MS", 10);
+    backoff_cap_ms_ = env_int_or("DMLC_PS_BACKOFF_CAP_MS", 2000);
+    rpc_timeout_ms_ = env_int_or("DMLC_PS_RPC_TIMEOUT_MS", 0);
+    // CRC32C end-to-end payload checksums, default ON (HETU_PS_CRC=0 opts
+    // out): requests checksum their args and ask the server (kFlagCrc) to
+    // reject mismatches before any apply and to checksum its response.
+    {
+      const char* c = std::getenv("HETU_PS_CRC");
+      crc_on_.store(!(c && *c == '0'));
+    }
+    // chaos engine env arming (SetChaos is the runtime path). Doubly
+    // gated: a leaked HETU_CHAOS_SPEC is inert without HETU_TEST_MODE.
+    if (const char* cs = std::getenv("HETU_CHAOS_SPEC"))
+      if (*cs && env_test_mode()) set_chaos(cs);
     sched_ = std::make_unique<Conn>(connect_to(sched_host, sched_port));
     // register with the scheduler, receive the server address book
     Message reg;
@@ -310,6 +330,54 @@ class PsWorker {
   // -- hetu-elastic membership (docs/FAULT_TOLERANCE.md) ------------------
   void set_world_version(uint64_t v) { world_version_.store(v); }
   uint64_t world_version() const { return world_version_.load(); }
+
+  // -- hetuchaos (docs/FAULT_TOLERANCE.md "Chaos testing") ----------------
+  // Arm a seeded fault schedule ("" disarms). Gating on HETU_TEST_MODE
+  // lives in capi.cc / the env-arming ctor path; this setter is the
+  // mechanism. Retired engines are kept until finalize so a concurrent
+  // RPC that loaded the old pointer never dereferences freed memory.
+  void set_chaos(const std::string& spec) {
+    if (spec.empty()) {
+      chaos_.store(nullptr, std::memory_order_release);
+      return;
+    }
+    auto eng = ChaosEngine::parse(spec);
+    ChaosEngine* raw = eng.get();
+    {
+      std::lock_guard<std::mutex> g(chaos_mu_);
+      chaos_owned_.push_back(std::move(eng));
+    }
+    chaos_.store(raw, std::memory_order_release);
+  }
+
+  // Drain injected-fault events (6-wide i64 rows, oldest first) across
+  // EVERY engine armed this session, in arming order — a test that
+  // re-arms per phase (or disarms before reading) still gets the full
+  // log. Returns 0 when no engine was ever armed.
+  size_t drain_chaos(int64_t* out, size_t max_rows) {
+    std::lock_guard<std::mutex> g(chaos_mu_);
+    size_t n = 0;
+    for (auto& eng : chaos_owned_) {
+      if (n >= max_rows) break;
+      n += eng->drain(out + n * ChaosEngine::kEventCols, max_rows - n);
+    }
+    return n;
+  }
+
+  uint64_t chaos_faults() const {
+    // injected-fault total across every engine armed this session (0
+    // with none armed): reading through chaos_ alone would go blind the
+    // moment a test disarms or re-arms
+    std::lock_guard<std::mutex> g(chaos_mu_);
+    uint64_t n = 0;
+    for (const auto& eng : chaos_owned_) n += eng->fault_count();
+    return n;
+  }
+
+  // CRC32C payload checksums on/off for this worker's traffic (the env
+  // default is HETU_PS_CRC at Init; the bench A/B toggles it live).
+  void set_crc(bool on) { crc_on_.store(on); }
+  bool crc_enabled() const { return crc_on_.load(); }
 
   // Re-sync the server set with the scheduler's address book after a
   // committed resize: joined servers get fresh bulk+fast connections and
@@ -872,17 +940,29 @@ class PsWorker {
 
   // Worker-side RPC counters (telemetry: kServerStats' client-side twin):
   // [rpc round trips issued, fast-retry attempts, successful failover
-  // re-issues, raw value-payload bytes, wire value-payload bytes]. The two
+  // re-issues, raw value-payload bytes, wire value-payload bytes,
+  // recv/deadline timeouts, total backoff slept (ms), CRC rejects
+  // observed (server rejections + local response-verify failures),
+  // chaos faults injected, successful write-RPC round trips]. The two
   // byte counters cover every quantizable payload leg in BOTH modes
   // (raw == wire with quantization off), so raw/wire is the measured
-  // compression ratio. Relaxed atomics bumped on the rpc path — counting
-  // costs nothing whether or not anyone ever reads them.
+  // compression ratio. `pushes_ok` counts each LOGICAL write RPC once no
+  // matter how many retries/duplicates it took — with a fresh single-
+  // worker cluster it must equal the sum of the servers' update counters
+  // EXACTLY (the no-double-apply / no-lost-update accounting invariant
+  // hetu_tpu.chaos checks). Relaxed atomics bumped on the rpc path —
+  // counting costs nothing whether or not anyone ever reads them.
   std::vector<int64_t> client_stats() const {
     return {static_cast<int64_t>(rpc_count_.load()),
             static_cast<int64_t>(retry_count_.load()),
             static_cast<int64_t>(failover_count_.load()),
             static_cast<int64_t>(val_raw_bytes_.load()),
-            static_cast<int64_t>(val_wire_bytes_.load())};
+            static_cast<int64_t>(val_wire_bytes_.load()),
+            static_cast<int64_t>(timeout_count_.load()),
+            static_cast<int64_t>(backoff_ms_total_.load()),
+            static_cast<int64_t>(crc_reject_count_.load()),
+            static_cast<int64_t>(chaos_faults()),
+            static_cast<int64_t>(push_ok_count_.load())};
   }
 
   // Per-server HA counters (kServerStats; rides the fast channel):
@@ -1042,7 +1122,18 @@ class PsWorker {
     return server_addrs_[server];
   }
 
-  std::pair<std::string, bool> query_server_status(size_t server) {
+  // One liveness probe of `server` via the scheduler's heartbeat ledger.
+  // `sched_ok` distinguishes the two unreachability shapes the escalation
+  // logic must tell apart: scheduler reachable + heartbeat fresh + RPCs
+  // failing = a DIRECTED PARTITION between this worker and that server;
+  // scheduler unreachable = this worker may be the isolated one.
+  struct ServerStatus {
+    std::string addr;
+    bool alive = true;
+    bool sched_ok = false;
+  };
+
+  ServerStatus query_server_status(size_t server) {
     try {
       Conn c(connect_to(sched_host_, sched_port_, /*retries=*/20,
                         /*wait_ms=*/100));
@@ -1052,7 +1143,7 @@ class PsWorker {
       c.send(q);
       Message rsp;
       if (!c.recv(&rsp) || rsp.args.size() < 2)
-        return {cached_addr(server), true};
+        return {cached_addr(server), true, false};
       std::vector<std::string> addrs;
       std::istringstream ss(rsp.args[0].as_str());
       std::string line;
@@ -1060,12 +1151,17 @@ class PsWorker {
         if (!line.empty()) addrs.push_back(line);
       const int32_t* alive = rsp.args[1].as_i32();
       if (server < addrs.size())
-        return {addrs[server], alive[server] != 0};
+        return {addrs[server], alive[server] != 0, true};
+      // beyond the scheduler's address book: the scheduler answered but
+      // has NO heartbeat for this server — report not-alive, or the
+      // partition diagnosis would claim a fresh heartbeat that does not
+      // exist and steer recovery away from the departure path
+      return {cached_addr(server), false, true};
     } catch (...) {
       // scheduler unreachable: fall back to the cached address and let the
       // reconnect below decide
     }
-    return {cached_addr(server), true};
+    return {cached_addr(server), true, false};
   }
 
   // One reliable request/response round trip (the role of the reference's
@@ -1102,20 +1198,69 @@ class PsWorker {
     }
   }
 
+  // A server-side rejection that is SAFE to retry: the request was never
+  // applied (CRC reject happens before any dedup/handle work) and the
+  // stream is still in sync, so the client resends instead of surfacing
+  // an application error. Distinguished from "server error:" (app-level,
+  // no retry) by the server's "retryable:" message prefix.
+  struct RetryableReject : std::runtime_error {
+    using std::runtime_error::runtime_error;
+  };
+
   // One send/recv over the current connection. Returns true with *rsp
-  // filled on success; false (error recorded, connection closed) on a
-  // transport failure; rethrows app-level server errors (no retry).
+  // filled on success; false (error recorded) on a transport failure or a
+  // retryable server reject (the connection is closed only in the former
+  // — a reject leaves a healthy, in-sync stream, and sets *rejected so
+  // the retry loop resends immediately on it instead of paying backoff +
+  // scheduler query + reconnect); rethrows app-level server errors (no
+  // retry).
   bool try_roundtrip(std::vector<std::unique_ptr<Conn>>& conns, size_t server,
-                     Message& req, Message* rsp, std::string* last_err) {
+                     Message& req, Message* rsp, std::string* last_err,
+                     size_t corrupt_arg = static_cast<size_t>(-1),
+                     size_t corrupt_off = 0, bool* rejected = nullptr) {
     try {
       auto& conn = *conns[server];
-      conn.send(req);
-      if (!conn.recv(rsp))
+      conn.send(req, corrupt_arg, corrupt_off);
+      // cleared first: a clean peer close (recv() == 0) returns false
+      // WITHOUT touching errno, and a stale EAGAIN from an earlier
+      // timeout would misclassify a dead server as a timing-out one
+      errno = 0;
+      if (!conn.recv(rsp)) {
+        // SO_RCVTIMEO expiry surfaces as EAGAIN/EWOULDBLOCK; anything else
+        // is a closed/error'd peer. Counted apart (hetu_rpc_timeouts_total)
+        // because a timing-out server and a dead one are different faults.
+        const bool to = errno == EAGAIN || errno == EWOULDBLOCK;
+        if (to) timeout_count_.fetch_add(1, std::memory_order_relaxed);
         throw std::runtime_error("server " + std::to_string(server) +
-                                 " timed out or closed");
-      if (rsp->head.flags == -1)
-        throw std::runtime_error("server error: " + rsp->args[0].as_str());
+                                 (to ? " timed out" : " closed connection"));
+      }
+      if (rsp->head.flags == -1) {
+        const std::string msg =
+            rsp->args.empty() ? "(no diagnostic)" : rsp->args[0].as_str();
+        if (msg.rfind("retryable:", 0) == 0) {
+          if (msg.find("CRC") != std::string::npos)
+            crc_reject_count_.fetch_add(1, std::memory_order_relaxed);
+          throw RetryableReject("server " + std::to_string(server) +
+                                " rejected: " + msg);
+        }
+        throw std::runtime_error("server error: " + msg);
+      }
+      // response integrity: a payload corrupted on the return leg must be
+      // re-pulled, never handed to the caller (dedup makes resend safe)
+      if (crc_on_.load(std::memory_order_relaxed) &&
+          (rsp->head.flags & kFlagCrc)) {
+        std::string cerr;
+        if (!verify_msg_crc(*rsp, &cerr)) {
+          crc_reject_count_.fetch_add(1, std::memory_order_relaxed);
+          throw std::runtime_error("server " + std::to_string(server) +
+                                   " response CRC mismatch: " + cerr);
+        }
+      }
       return true;
+    } catch (const RetryableReject& e) {
+      *last_err = e.what();
+      if (rejected) *rejected = true;
+      return false;  // stream intact — no close, just resend
     } catch (const std::exception& e) {
       std::string what = e.what();
       if (what.rfind("server error:", 0) == 0) throw;  // app-level: no retry
@@ -1123,6 +1268,117 @@ class PsWorker {
       conns[server]->close();
       return false;
     }
+  }
+
+  // try_roundtrip plus the chaos engine's faults: `cd` is this MESSAGE's
+  // scheduled fault (applied on the first attempt only — retries go
+  // clean, like a real network where the fault hit one packet), while the
+  // directed-partition check applies to EVERY attempt (a real partition
+  // blocks retries too, until its window closes).
+  bool try_roundtrip_chaos(std::vector<std::unique_ptr<Conn>>& conns,
+                           size_t server, int ch, Message& req, Message* rsp,
+                           std::string* last_err, const ChaosDecision& cd,
+                           ChaosEngine* ce, bool* rejected = nullptr) {
+    if (ce && ce->partition_blocked(static_cast<int32_t>(server), ch,
+                                    req.head.type, req.head.tensor_id)) {
+      *last_err = "chaos: directed partition to server " +
+                  std::to_string(server) + " (injected)";
+      conns[server]->close();  // a real partition kills the stream too
+      return false;
+    }
+    // events are recorded HERE, when a fault actually fires — a decision
+    // preempted by the partition block above (or a corrupt that degrades)
+    // leaves no event, so the drained log never over-claims
+    const auto applied = [&](ChaosKind k, int64_t arg) {
+      ce->record_applied(k, static_cast<int32_t>(server), req.head.type,
+                         req.head.tensor_id, cd.seq, arg);
+    };
+    switch (cd.kind) {
+      case ChaosKind::kNone:
+        return try_roundtrip(conns, server, req, rsp, last_err,
+                             static_cast<size_t>(-1), 0, rejected);
+      case ChaosKind::kDelay:
+      case ChaosKind::kReorder:
+        // the held request lets sibling RPCs (other servers, the other
+        // channel) overtake it — delivery reordering at message level
+        applied(cd.kind, cd.arg);
+        std::this_thread::sleep_for(std::chrono::milliseconds(cd.arg));
+        return try_roundtrip(conns, server, req, rsp, last_err,
+                             static_cast<size_t>(-1), 0, rejected);
+      case ChaosKind::kDrop:
+        // request lost on the wire: never sent, stream untouched
+        applied(cd.kind, cd.arg);
+        *last_err = "chaos: request dropped (injected)";
+        return false;
+      case ChaosKind::kDropRsp: {
+        // the applied-but-unacked window: the server executes, the
+        // response is lost. The retry resends the SAME req_id and must be
+        // answered from the dedup slot without a second apply. Recorded
+        // only when the server actually executed (a transport failure
+        // here means no response existed to drop).
+        if (!try_roundtrip(conns, server, req, rsp, last_err,
+                           static_cast<size_t>(-1), 0, rejected))
+          return false;
+        applied(cd.kind, cd.arg);
+        *rsp = Message();
+        *last_err = "chaos: response dropped after execution (injected)";
+        return false;
+      }
+      case ChaosKind::kDup: {
+        // duplicate delivery: the same req_id arrives twice back-to-back;
+        // the second copy must be served from the dedup slot (we return
+        // ITS response, so a divergence would surface immediately)
+        if (!try_roundtrip(conns, server, req, rsp, last_err,
+                           static_cast<size_t>(-1), 0, rejected))
+          return false;
+        applied(cd.kind, cd.arg);
+        Message second;
+        if (!try_roundtrip(conns, server, req, &second, last_err,
+                           static_cast<size_t>(-1), 0, rejected))
+          return false;
+        *rsp = std::move(second);
+        return true;
+      }
+      case ChaosKind::kCorrupt: {
+        // flip one payload byte ON THE WIRE — after the checksums are
+        // computed (net.h send_msg), exactly where a real bit-flip lands,
+        // so the server's CRC verify is what must catch it; the clean
+        // retry must then apply exactly once. Requires the CRC leg
+        // (without it the corruption would be APPLIED, which is the
+        // disease, not the test); with CRC off or no payload the fault
+        // degrades to a clean send.
+        size_t ai = 0, best = 0;
+        for (size_t i = 0; i < req.args.size(); ++i)
+          if (req.args[i].buf.size() > best) {
+            best = req.args[i].buf.size();
+            ai = i;
+          }
+        if (best == 0 || !crc_on_.load(std::memory_order_relaxed))
+          return try_roundtrip(conns, server, req, rsp, last_err,
+                               static_cast<size_t>(-1), 0, rejected);
+        bool rej = false;
+        const bool ok = try_roundtrip(conns, server, req, rsp, last_err, ai,
+                                      static_cast<size_t>(cd.arg), &rej);
+        // recorded only when the corrupted bytes actually REACHED a
+        // receiver — a reject (the expected path) or, hypothetically, a
+        // CRC collision that got through. A send that failed at the
+        // transport (peer closed first) put nothing on the wire, and
+        // logging it would over-claim; the clean retry resends anyway
+        // (the corruption lived only in the wire buffer).
+        if (ok || rej)
+          ce->record_applied(ChaosKind::kCorrupt,
+                             static_cast<int32_t>(server), req.head.type,
+                             req.head.tensor_id, cd.seq,
+                             static_cast<int64_t>(
+                                 static_cast<uint64_t>(cd.arg) % best));
+        if (rej && rejected) *rejected = true;
+        return ok;
+      }
+      case ChaosKind::kPartition:
+        break;  // never scheduled by decide(); handled per-attempt above
+    }
+    return try_roundtrip(conns, server, req, rsp, last_err,
+                         static_cast<size_t>(-1), 0, rejected);
   }
 
   Message rpc(size_t server, Message& req) {
@@ -1147,41 +1403,83 @@ class PsWorker {
     // default, non-elastic runs) is always accepted
     req.head.world_ver = static_cast<int32_t>(
         world_version_.load(std::memory_order_relaxed));
+    // hetuchaos hardening: checksum the payload and ask the server to
+    // verify + checksum its response (net.h kFlagCrc)
+    if (crc_on_.load(std::memory_order_relaxed)) req.head.flags |= kFlagCrc;
+    // one scheduled-fault roll per logical RPC (off-mode: one relaxed load)
+    ChaosEngine* ce = chaos_.load(std::memory_order_acquire);
+    ChaosDecision cd;
+    if (ce) cd = ce->decide(static_cast<int32_t>(server), req.head.type,
+                            req.head.tensor_id);
+    using Clock = std::chrono::steady_clock;
+    const auto rpc_deadline =
+        rpc_timeout_ms_ > 0
+            ? Clock::now() + std::chrono::milliseconds(rpc_timeout_ms_)
+            : Clock::time_point::max();
     std::string last_err;
+    bool sched_saw_alive = false;  // partition-vs-dead classification
     Message rsp;
-    // phase 1: bounded fast retries (the pre-failover semantics)
+    // phase 1: bounded retries with exponential backoff + jitter. The
+    // resend rides the (client_id, req_id) dedup ledger, so a request
+    // that EXECUTED but whose response was lost is answered from the
+    // slot, never applied twice — PR 4's re-issue proof generalized from
+    // failover-only to every retry.
+    bool was_reject = false;  // last failure was a retryable server reject
     for (int attempt = 0; attempt <= max_retry_; ++attempt) {
       if (attempt > 0) {
         retry_count_.fetch_add(1, std::memory_order_relaxed);
-        auto st = query_server_status(server);
-        {
-          // both channels' retry paths may relocate the same server
-          // concurrently (they hold different per-channel mutexes)
-          std::lock_guard<std::mutex> ag(addr_mu_);
-          server_addrs_[server] = st.first;
-        }
-        if (!st.second && attempt == max_retry_) break;  // declared dead
-        try {
-          conns[server] = std::make_unique<Conn>(
-              connect_addr(st.first, /*retries=*/30, /*wait_ms=*/100));
-        } catch (const std::exception& e) {
-          last_err = e.what();
-          continue;
+        // a retryable reject (CRC mismatch) came from a HEALTHY server
+        // over an in-sync stream: resend immediately on the live socket —
+        // backoff is a congestion/death signal, and the scheduler query +
+        // reconnect would throw away the intact connection for nothing
+        if (!was_reject) {
+          const int64_t bo = backoff_ms(attempt, backoff_base_ms_,
+                                        backoff_cap_ms_, req.head.req_id);
+          backoff_ms_total_.fetch_add(static_cast<uint64_t>(bo),
+                                      std::memory_order_relaxed);
+          std::this_thread::sleep_for(std::chrono::milliseconds(bo));
+          if (Clock::now() >= rpc_deadline) {
+            timeout_count_.fetch_add(1, std::memory_order_relaxed);
+            last_err += " (DMLC_PS_RPC_TIMEOUT_MS=" +
+                        std::to_string(rpc_timeout_ms_) + " exhausted)";
+            break;
+          }
+          auto st = query_server_status(server);
+          sched_saw_alive = st.sched_ok && st.alive;
+          {
+            // both channels' retry paths may relocate the same server
+            // concurrently (they hold different per-channel mutexes)
+            std::lock_guard<std::mutex> ag(addr_mu_);
+            server_addrs_[server] = st.addr;
+          }
+          if (!st.alive && attempt == max_retry_) break;  // declared dead
+          try {
+            conns[server] = std::make_unique<Conn>(
+                connect_addr(st.addr, /*retries=*/30, /*wait_ms=*/100));
+          } catch (const std::exception& e) {
+            last_err = e.what();
+            continue;
+          }
         }
       }
-      if (try_roundtrip(conns, server, req, &rsp, &last_err)) {
+      was_reject = false;
+      if (try_roundtrip_chaos(conns, server, ch, req, &rsp, &last_err,
+                              attempt == 0 ? cd : ChaosDecision(), ce,
+                              &was_reject)) {
         if (trail) trail_record(req, rsp, server, tr0);
+        if (is_write_apply(static_cast<PsfType>(req.head.type)))
+          push_ok_count_.fetch_add(1, std::memory_order_relaxed);
         return rsp;
       }
     }
-    // phase 2 (opt-in): the server is gone — block-with-deadline until the
-    // supervisor's replacement registers with the scheduler, then re-issue
-    // the SAME request (unchanged req_id: the server's (client_id, req_id)
-    // dedup — live slot or snapshot-restored ledger — makes re-issue safe).
-    // On deadline, fall through to the same error the non-failover path
+    // phase 2 (opt-in): the server is gone OR partitioned from this
+    // worker — block-with-deadline until the supervisor's replacement
+    // registers (or the partition heals), then re-issue the SAME request
+    // (unchanged req_id: the server's (client_id, req_id) dedup — live
+    // slot or snapshot-restored ledger — makes re-issue safe). On
+    // deadline, fall through to the same error the non-failover path
     // raises, so supervise() still catches the unrecoverable case.
     if (failover_ms_ > 0) {
-      using Clock = std::chrono::steady_clock;
       const auto deadline =
           Clock::now() + std::chrono::milliseconds(failover_ms_);
       std::fprintf(stderr,
@@ -1190,26 +1488,31 @@ class PsWorker {
                    rank_, server, last_err.c_str(), failover_ms_);
       while (Clock::now() < deadline) {
         auto st = query_server_status(server);
+        sched_saw_alive = st.sched_ok && st.alive;
         {
           std::lock_guard<std::mutex> ag(addr_mu_);
-          server_addrs_[server] = st.first;
+          server_addrs_[server] = st.addr;
         }
-        if (st.second) {  // heartbeat fresh again: a replacement registered
+        if (st.alive) {  // heartbeat fresh: replacement or healed partition
           bool connected = false;
           try {
             conns[server] = std::make_unique<Conn>(
-                connect_addr(st.first, /*retries=*/5, /*wait_ms=*/100));
+                connect_addr(st.addr, /*retries=*/5, /*wait_ms=*/100));
             connected = true;
           } catch (const std::exception& e) {
             last_err = e.what();
           }
-          if (connected && try_roundtrip(conns, server, req, &rsp, &last_err)) {
+          if (connected &&
+              try_roundtrip_chaos(conns, server, ch, req, &rsp, &last_err,
+                                  ChaosDecision(), ce)) {
             if (trail) trail_record(req, rsp, server, tr0);
+            if (is_write_apply(static_cast<PsfType>(req.head.type)))
+              push_ok_count_.fetch_add(1, std::memory_order_relaxed);
             failover_count_.fetch_add(1, std::memory_order_relaxed);
             std::fprintf(stderr,
                          "[hetups worker %d] server %zu recovered at %s; "
                          "request re-issued\n",
-                         rank_, server, st.first.c_str());
+                         rank_, server, st.addr.c_str());
             return rsp;
           }
         }
@@ -1219,11 +1522,50 @@ class PsWorker {
       throw std::runtime_error(
           "PS server " + std::to_string(server) +
           " unreachable: no replacement within the failover deadline (" +
-          std::to_string(failover_ms_) + " ms; " + last_err + ")");
+          std::to_string(failover_ms_) + " ms; " + last_err + ")" +
+          partition_diag(server, sched_saw_alive));
     }
     throw std::runtime_error(
         "PS server " + std::to_string(server) + " unreachable after " +
-        std::to_string(max_retry_ + 1) + " attempts (" + last_err + ")");
+        std::to_string(max_retry_ + 1) + " attempts (" + last_err + ")" +
+        partition_diag(server, sched_saw_alive));
+  }
+
+  // Partial-partition escalation diagnosis: when the scheduler is
+  // reachable and reports the server's heartbeat FRESH while this
+  // worker's RPCs keep failing, the fault is a directed client<->server
+  // partition, not a dead server — the caller should take the PR 4
+  // failover / PR 11 departure path instead of blocking on a respawn
+  // that will never come (the server isn't down). Scheduler-unreachable
+  // keeps the plain error (the Python side's typed SchedulerUnreachable
+  // owns that case).
+  static std::string partition_diag(size_t server, bool sched_saw_alive) {
+    if (!sched_saw_alive) return "";
+    return " — directed partition suspected: the scheduler is reachable "
+           "and server " +
+           std::to_string(server) +
+           "'s heartbeat is fresh, but this worker cannot complete an RPC "
+           "to it; escalate via the failover/departure path "
+           "(DMLC_PS_FAILOVER_DEADLINE_MS / hetu-elastic) instead of "
+           "waiting for a respawn";
+  }
+
+  // PSF types whose success ticks the server's optimizer update counter
+  // exactly once (begin_req) — the client-side half of the update-counter
+  // accounting invariant (see client_stats).
+  static bool is_write_apply(PsfType t) {
+    switch (t) {
+      case PsfType::kDensePush:
+      case PsfType::kDDPushPull:
+      case PsfType::kSparsePush:
+      case PsfType::kSDPushPull:
+      case PsfType::kSSPushPull:
+      case PsfType::kPushEmbedding:
+      case PsfType::kPushSyncEmbedding:
+        return true;
+      default:
+        return false;
+    }
   }
 
   // hetutrail: bounded ring append (drop-new + counter when full — the
@@ -1348,6 +1690,18 @@ class PsWorker {
   std::atomic<uint64_t> rpc_count_{0};       // telemetry (client_stats)
   std::atomic<uint64_t> retry_count_{0};
   std::atomic<uint64_t> failover_count_{0};
+  // hetuchaos hardening counters + engine (docs/FAULT_TOLERANCE.md)
+  std::atomic<uint64_t> timeout_count_{0};     // recv/deadline timeouts
+  std::atomic<uint64_t> backoff_ms_total_{0};  // retry backoff slept
+  std::atomic<uint64_t> crc_reject_count_{0};  // server rejects + rsp fails
+  std::atomic<uint64_t> push_ok_count_{0};     // logical write RPCs landed
+  std::atomic<bool> crc_on_{true};             // HETU_PS_CRC / SetPsCrc
+  int backoff_base_ms_ = 10;                   // DMLC_PS_BACKOFF_BASE_MS
+  int backoff_cap_ms_ = 2000;                  // DMLC_PS_BACKOFF_CAP_MS
+  int rpc_timeout_ms_ = 0;                     // DMLC_PS_RPC_TIMEOUT_MS
+  std::atomic<ChaosEngine*> chaos_{nullptr};
+  mutable std::mutex chaos_mu_;                // guards chaos_owned_
+  std::vector<std::unique_ptr<ChaosEngine>> chaos_owned_;
   // hetuq: quantized-wire state + raw-vs-wire accounting over every
   // quantizable value payload (pushes and pull responses; counted in BOTH
   // modes so off==raw is the A/B denominator)

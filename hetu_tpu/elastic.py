@@ -282,11 +282,11 @@ def server_set_world(addr: str, version: int) -> None:
 
 
 def server_stats_raw(addr: str, timeout: float = 3.0) -> list[int]:
-    """kServerStats over a raw socket (no native lib): the 10 HA/health
+    """kServerStats over a raw socket (no native lib): the 11 HA/health
     slots — [updates, snapshot_updates, restored_updates, snapshot_version,
     n_params, requests, apply_ns, apply_count, snapshot_age_ms,
-    dedup_clients]. The jax-free twin of ``PSClient.ServerStats`` for
-    supervisor-side scale policies."""
+    dedup_clients, crc_rejects]. The jax-free twin of
+    ``PSClient.ServerStats`` for supervisor-side scale policies."""
     host, port = _split_addr(addr)
     _, out = _rpc(host, port, K_SERVER_STATS, timeout=timeout,
                   who=f"ps server {addr}")

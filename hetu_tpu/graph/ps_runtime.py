@@ -694,6 +694,17 @@ class PSRuntime:
             reg.gauge("hetu_ps_rpcs_total").set(cs["rpcs"])
             reg.gauge("hetu_ps_retries_total").set(cs["retries"])
             reg.gauge("hetu_ps_failovers_total").set(cs["failovers"])
+            # hetuchaos transport hardening (docs/FAULT_TOLERANCE.md):
+            # recv/deadline timeouts, total retry backoff slept, CRC
+            # rejects observed (server + response-leg), and faults an
+            # armed chaos schedule injected (0 in production — arming is
+            # HETU_TEST_MODE-gated)
+            reg.gauge("hetu_rpc_timeouts_total").set(cs.get("timeouts", 0))
+            reg.gauge("hetu_rpc_backoff_ms").set(cs.get("backoff_ms", 0))
+            reg.gauge("hetu_crc_rejects_total").set(
+                cs.get("crc_rejects", 0))
+            reg.gauge("hetu_chaos_faults_total").set(
+                cs.get("chaos_faults", 0))
             # hetuq raw-vs-wire accounting (worker.h value payloads; with
             # quantization off raw == wire) — what hetutop's PS panel shows
             # as the measured compression ratio

@@ -43,6 +43,8 @@ def _load_lib():
     lib.SetTrailStep.argtypes = [ctypes.c_longlong]
     lib.DrainTrailSpans.restype = ctypes.c_long
     lib.TrailDropped.restype = ctypes.c_longlong
+    lib.SetChaos.argtypes = [ctypes.c_char_p]
+    lib.DrainChaosEvents.restype = ctypes.c_long
     return lib
 
 
@@ -134,13 +136,16 @@ class PSClient:
         ``requests`` served, ``apply_ms_avg`` (mean wall ms per applied
         write), ``snapshot_age_ms`` since THIS incarnation's latest
         snapshot (-1 = none yet, including right after a restore), and
-        ``dedup_clients`` (resend-dedup ledger occupancy). After a
-        recovery, ``acked-before-death updates - restored_updates`` is
-        exactly how many updates that shard lost."""
-        out = np.zeros(10, np.int64)
+        ``dedup_clients`` (resend-dedup ledger occupancy), and
+        ``crc_rejects`` (requests rejected before any apply because their
+        payload CRC32C did not verify — docs/FAULT_TOLERANCE.md "Chaos
+        testing & transport hardening"). After a recovery,
+        ``acked-before-death updates - restored_updates`` is exactly how
+        many updates that shard lost."""
+        out = np.zeros(11, np.int64)
         self._lib.QueryServerStats(ctypes.c_int(int(server)),
                                    out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(10))
+                                   ctypes.c_int(11))
         self._check()
         apply_cnt = int(out[7])
         return {"updates": int(out[0]), "snapshot_updates": int(out[1]),
@@ -150,23 +155,35 @@ class PSClient:
                 "apply_ms_avg": (round(int(out[6]) / apply_cnt / 1e6, 6)
                                  if apply_cnt else None),
                 "snapshot_age_ms": int(out[8]),
-                "dedup_clients": int(out[9])}
+                "dedup_clients": int(out[9]),
+                "crc_rejects": int(out[10])}
 
     def ClientStats(self) -> dict:
         """This worker's RPC counters: round trips issued, fast-retry
-        attempts, successful failover re-issues, plus the hetuq raw-vs-wire
+        attempts, successful failover re-issues, the hetuq raw-vs-wire
         byte counters over every quantizable value payload (pushes and pull
         responses; with quantization off raw == wire, so an off-vs-int8 A/B
         reads its compression ratio straight from here — worker.h
-        client_stats, docs/COMM_QUANT.md)."""
-        out = np.zeros(5, np.int64)
+        client_stats, docs/COMM_QUANT.md), plus the hetuchaos hardening
+        counters: ``timeouts`` (recv/deadline expiries), ``backoff_ms``
+        (total retry backoff slept), ``crc_rejects`` (server CRC
+        rejections observed + local response-verify failures),
+        ``chaos_faults`` (injected by an armed schedule), and
+        ``pushes_ok`` — each LOGICAL write RPC counted once no matter how
+        many retries it took, so with a fresh single-worker cluster it
+        equals the servers' summed update counters EXACTLY (the
+        no-double-apply accounting invariant ``hetu_tpu.chaos`` checks)."""
+        out = np.zeros(10, np.int64)
         self._lib.QueryClientStats(out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(5))
+                                   ctypes.c_int(10))
         self._check()
         return {"rpcs": int(out[0]), "retries": int(out[1]),
                 "failovers": int(out[2]),
                 "quant_raw_bytes": int(out[3]),
-                "quant_wire_bytes": int(out[4])}
+                "quant_wire_bytes": int(out[4]),
+                "timeouts": int(out[5]), "backoff_ms": int(out[6]),
+                "crc_rejects": int(out[7]), "chaos_faults": int(out[8]),
+                "pushes_ok": int(out[9])}
 
     def SetWorldVersion(self, version):
         """hetu-elastic: stamp this worker's committed membership epoch
@@ -202,6 +219,42 @@ class PSClient:
         on = mode not in (0, False, None, "", "off")
         self._lib.SetCommQuant(ctypes.c_int(1 if on else 0))
         self._check()
+
+    # -- hetuchaos (docs/FAULT_TOLERANCE.md "Chaos testing") ----------------
+    def SetPsCrc(self, on):
+        """CRC32C payload checksums on this worker's PS traffic (default
+        ON via ``HETU_PS_CRC``; 0 disables). Per-request negotiation
+        (``kFlagCrc``) means the server verifies requests and checksums
+        its responses only for CRC-speaking clients — so this one toggle
+        A/Bs both legs live on the singleton worker."""
+        self._lib.SetPsCrc(ctypes.c_int(1 if on else 0))
+        self._check()
+
+    def SetChaos(self, spec):
+        """Arm a seeded chaos schedule on this worker's transport
+        (``None``/``""`` disarms). Requires ``HETU_TEST_MODE`` — the same
+        gate as every destructive hook. Spec grammar:
+        ``hetu_tpu.chaos.parse_spec`` / csrc/ps/chaos.h. Every injected
+        fault is logged to a bounded event ring (:meth:`DrainChaosEvents`)
+        and decided purely from (seed, server, psf, tensor, sequence), so
+        a failing schedule replays bit-identically from its seed."""
+        s = (spec or "").encode()
+        self._lib.SetChaos(s)
+        self._check()
+
+    def DrainChaosEvents(self, max_rows=65536) -> np.ndarray:
+        """Drain injected-fault events across EVERY schedule armed this
+        session, in arming order (oldest first within each) — a test that
+        re-arms per phase still gets the full log on one drain at the
+        end. Returns an (n, 6) int64 array with columns
+        ``chaos.EVENT_COLS``: kind, server, psf, tensor, seq, arg. The
+        array is a fresh copy (unlike the reused trail buffer) — chaos is
+        a test-mode surface, not a hot path."""
+        buf = np.zeros((int(max_rows), 6), np.int64)
+        n = self._lib.DrainChaosEvents(buf.ctypes.data_as(_i64p),
+                                       ctypes.c_int(int(max_rows)))
+        self._check()
+        return buf[:max(0, int(n))].copy()
 
     # -- hetutrail (docs/OBSERVABILITY.md pillar 5) -------------------------
     def SetTrail(self, on):

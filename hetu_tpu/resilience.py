@@ -156,6 +156,21 @@ class FaultInjector:
       ``HETU_PS_SLOW_SERVER`` (default 0); the server-side hook
       (``kTestSlowApply``) is additionally HETU_TEST_MODE-gated in capi
       AND on the server.
+    - ``ps_partition@S[:SERVER]`` — arm a transient directed partition
+      between this worker and PS server ``SERVER`` (default 0) at step S
+      via the hetuchaos engine: the next ``HETU_PS_PARTITION_ATTEMPTS``
+      (default 2) RPC attempts *per wire channel* (bulk push + fast pull
+      — up to 2x that many attempts total) to that server fail,
+      exercising the retry-with-backoff path (a window past the
+      per-channel retry budget escalates to the failover/departure path
+      instead — docs/FAULT_TOLERANCE.md "Chaos testing & transport
+      hardening"). For full seeded schedules use ``HETU_CHAOS_SPEC`` /
+      ``bin/hetuchaos`` directly.
+
+    The full injector catalogue (args, gating, which subsystem each kind
+    exercises, plus the native ``HETU_PS_TEST_EXIT_AFTER_UPDATES`` and
+    ``HETU_CHAOS_SPEC`` hooks) lives in docs/FAULT_TOLERANCE.md
+    "Fault-kind catalogue".
 
     ``from_env()`` (the only path wired into the executor by default) returns
     None unless :func:`test_mode_enabled` — direct construction is itself an
@@ -164,7 +179,7 @@ class FaultInjector:
 
     KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
              "ps_kill", "quant_corrupt", "worker_lost", "ps_join",
-             "ps_slow")
+             "ps_slow", "ps_partition")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -177,7 +192,8 @@ class FaultInjector:
             if not sep or kind not in self.KINDS:
                 raise ValueError(
                     f"bad fault entry {part!r}: expected kind@step[:arg] with "
-                    f"kind in {self.KINDS}")
+                    f"kind in {self.KINDS} — see the fault-kind catalogue in "
+                    f"docs/FAULT_TOLERANCE.md")
             step_s, _, arg_s = rest.partition(":")
             # nan_op's arg is an OP NAME, every other kind's a number
             arg = None
@@ -254,6 +270,15 @@ class FaultInjector:
             comm.TestSlowApply(
                 server=int(os.environ.get("HETU_PS_SLOW_SERVER", "0")),
                 ms=100 if e["arg"] is None else int(e["arg"]))
+        e = self.take("ps_partition", step)
+        if e is not None:
+            from . import ps as ps_pkg
+            comm = ps_pkg.get_worker_communicate()
+            srv = 0 if e["arg"] is None else int(e["arg"])
+            n = int(os.environ.get("HETU_PS_PARTITION_ATTEMPTS", "2"))
+            # chaos-engine partition window over the next n attempts to
+            # srv (SetChaos is HETU_TEST_MODE-gated like this injector)
+            comm.SetChaos(f"seed={step},partition={srv}:0:{n}")
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:

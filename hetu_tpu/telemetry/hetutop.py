@@ -527,6 +527,26 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
         if stragglers:
             line += f"  stragglers {int(stragglers)}"
         lines.append(line)
+    # hetuchaos transport hardening (docs/FAULT_TOLERANCE.md "Chaos
+    # testing & transport hardening"): retry/timeout/CRC health summed
+    # across ranks, plus any injected-fault count when a chaos schedule
+    # is armed (test runs only). Absent (no line) while every counter is
+    # zero — the healthy-wire steady state.
+    ch = {k: 0.0 for k in ("hetu_rpc_timeouts_total", "hetu_rpc_backoff_ms",
+                           "hetu_crc_rejects_total",
+                           "hetu_chaos_faults_total")}
+    for rk in state["ranks"].values():
+        m = rk["metrics"]
+        for k in ch:
+            ch[k] += _defloat(m.get(k)) or 0.0
+    if any(ch.values()):
+        line = (f"chaos: timeouts {int(ch['hetu_rpc_timeouts_total'])}  "
+                f"backoff {ch['hetu_rpc_backoff_ms']:.0f}ms  "
+                f"crc rejects {int(ch['hetu_crc_rejects_total'])}")
+        if ch["hetu_chaos_faults_total"]:
+            line += (f"  injected faults "
+                     f"{int(ch['hetu_chaos_faults_total'])} (chaos armed)")
+        lines.append(line)
     if state["ps"]:
         lines.append("PS servers:")
         for sid in sorted(state["ps"]):
