@@ -354,6 +354,89 @@ def bench_chaos_hardening(batch_size=128, iters=60, rows=5000, width=16,
                 "windows": windows}
 
 
+def bench_snapshot_overhead(batch_size=128, iters=200, rows=5000, width=16,
+                            warmup=10, windows=4, snap_every=200):
+    """hetusave coordinated-snapshot cost (docs/FAULT_TOLERANCE.md
+    acceptance: snapshot stall < 5%/step amortized at the measured
+    cadence): the SAME PS-mode embedding trainer against one live
+    cluster, with leg B taking a full coordinated job snapshot (quiesce
+    barrier + per-server kSnapshotNow + worker pickle + manifest commit)
+    every ``snap_every`` steps — the stall is the AMORTIZED per-step
+    delta, the number an operator actually pays. Interleaved best-of-N
+    windows, min per leg, same noise reasoning as the trail/chaos cells.
+    The raw wall time of one snapshot is also reported (from the last
+    committed manifest), so the amortization arithmetic is auditable:
+    stall% ~= snapshot_wall_ms / (snap_every * step_ms)."""
+    import shutil
+    import tempfile
+    from hetu_tpu.recovery import latest_committed_manifest, \
+        take_job_snapshot
+    snaproot = tempfile.mkdtemp(prefix="bench_snap_")
+    jobdir = tempfile.mkdtemp(prefix="bench_snapjob_")
+    saved = os.environ.get("DMLC_PS_SNAPSHOT_DIR")
+    os.environ["DMLC_PS_SNAPSHOT_DIR"] = snaproot
+    try:
+        from hetu_tpu.ps.local_cluster import local_cluster
+        with local_cluster(n_servers=2, n_workers=1):
+            import hetu_tpu as ht
+            embed = ht.init.random_normal((rows, width), stddev=0.05,
+                                          name="embed_snap", is_embed=True)
+            idx = ht.Variable(name="idx", trainable=False)
+            y_ = ht.Variable(name="y_", trainable=False)
+            vec = ht.embedding_lookup_op(embed, idx)
+            flat = ht.array_reshape_op(vec, (-1, 4 * width))
+            w = ht.init.random_normal((4 * width, 1), stddev=0.1,
+                                      name="w_snap")
+            prob = ht.sigmoid_op(ht.matmul_op(flat, w))
+            loss = ht.reduce_mean_op(ht.binarycrossentropy_op(prob, y_),
+                                     [0])
+            train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+            ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                             comm_mode="PS", seed=0, prefetch=False)
+            rng = np.random.RandomState(7)
+            feeds = {idx: rng.randint(0, rows, (batch_size, 4))
+                     .astype(np.float32),
+                     y_: rng.randint(0, 2, (batch_size, 1))
+                     .astype(np.float32)}
+
+            def window(snap_on):
+                for _ in range(warmup):
+                    ex.run("train", feed_dict=feeds)
+                n = 0
+                t0 = time.time()
+                for i in range(iters):
+                    ex.run("train", feed_dict=feeds)
+                    if snap_on and (i + 1) % snap_every == 0:
+                        take_job_snapshot(ex, jobdir)
+                        n += 1
+                return (time.time() - t0) / iters * 1000, n
+
+            off_w, on_w, n_snaps = [], [], 0
+            for _ in range(windows):   # interleaved: drift hits both legs
+                off_w.append(window(False)[0])
+                ms, n = window(True)
+                on_w.append(ms)
+                n_snaps += n
+            ms_off, ms_on = min(off_w), min(on_w)
+            got = latest_committed_manifest(jobdir)
+            snap_ms = float(got[0].get("wall_ms", -1)) if got else -1.0
+            ex.close()
+            return {"step_ms_off": round(ms_off, 4),
+                    "step_ms_on": round(ms_on, 4),
+                    "snapshot_stall_pct": round(
+                        (ms_on - ms_off) / ms_off * 100, 2),
+                    "snapshot_wall_ms": round(snap_ms, 3),
+                    "snap_every": snap_every, "snapshots": n_snaps,
+                    "windows": windows}
+    finally:
+        if saved is None:
+            os.environ.pop("DMLC_PS_SNAPSHOT_DIR", None)
+        else:
+            os.environ["DMLC_PS_SNAPSHOT_DIR"] = saved
+        shutil.rmtree(snaproot, ignore_errors=True)
+        shutil.rmtree(jobdir, ignore_errors=True)
+
+
 def _capture_trace(out, step_twice, trace_dir, label):
     """Post-window jax.profiler capture shared by the LM cells (bert,
     transformer/350): runs AFTER the timed window so tracing overhead
@@ -1261,6 +1344,14 @@ def _run_section(name):
               if smoke else {})
         out = bench_chaos_hardening(**kw)
         out["servers"] = 2
+    elif name == "snapshot":
+        # hetusave coordinated-snapshot cell (docs/FAULT_TOLERANCE.md):
+        # the <5%/step amortized stall claim is MEASURED here, not
+        # asserted
+        kw = (dict(batch_size=32, iters=10, rows=500, warmup=2,
+                   windows=2, snap_every=5) if smoke else {})
+        out = bench_snapshot_overhead(**kw)
+        out["servers"] = 2
     elif name == "kernels":
         kw = (dict(vocab=5000, dim=32, batch=512, lookups=2, warmup=1,
                    iters=3) if smoke else {})
@@ -1306,6 +1397,10 @@ SECTION_ENV = {
     # hetuchaos CRC-hardening A/B: same reasoning as trail — the checksum
     # cost being measured is host-side and far below tunnel jitter
     "chaos": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetusave coordinated-snapshot A/B: the quiesce barrier + shard
+    # write being measured are host/disk-side; tunnel jitter would drown
+    # a single-digit-percent stall
+    "snapshot": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
     # hetuplan predicted-vs-measured (docs/ANALYSIS.md Tier C): the
     # calibration round-trip is framework-relative and must be
     # deterministic; the tunnel's RTT jitter would drown the residual
@@ -1476,7 +1571,8 @@ class _Ledger:
                       "dense_step_ms", "rows_step_ms", "speedup_rows",
                       "equality_ok", "measured_step_ms",
                       "predicted_step_ms", "plan_err_pct",
-                      "plan_comm_mode", "crc_overhead_pct", "crc_rejects"):
+                      "plan_comm_mode", "crc_overhead_pct", "crc_rejects",
+                      "snapshot_stall_pct", "snapshot_wall_ms"):
                 if result.get(k) is not None:
                     rec[k] = result[k]
         try:
@@ -1645,6 +1741,7 @@ def main():
                      ("introspect_overhead", "introspect", 420),
                      ("trail_overhead", "trail", 600),
                      ("chaos_overhead", "chaos", 600),
+                     ("snapshot_overhead", "snapshot", 600),
                      ("kernels_tier", "kernels", 600),
                      ("planner_residual", "planner", 420)]
     # 900s not 420s: these cells DID run green in a round-3 session (30.8k

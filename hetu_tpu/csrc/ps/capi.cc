@@ -349,6 +349,20 @@ void QueryServerStats(int server, long long* out, int n) {
   });
 }
 
+// hetusave (docs/FAULT_TOLERANCE.md "Coordinated job snapshots"): drive one
+// server's epoch-stamped snapshot NOW; fills out with up to n of
+// [snapshot_version, covered_update_counter, update_count, epoch].
+// Synchronous — returns only after the snapshot is on disk and its LATEST
+// pointer flipped. A production checkpoint primitive: NOT test-gated.
+void ServerSnapshotNow(int server, long long epoch, long long* out, int n) {
+  guard([&] {
+    auto v = worker().snapshot_now(static_cast<size_t>(server),
+                                   static_cast<int64_t>(epoch));
+    for (int i = 0; i < n && i < static_cast<int>(v.size()); ++i)
+      out[i] = static_cast<long long>(v[i]);
+  });
+}
+
 // -- hetu-elastic membership (docs/FAULT_TOLERANCE.md) ----------------------
 
 // Stamp this worker's committed membership epoch onto every subsequent

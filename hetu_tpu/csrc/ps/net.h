@@ -81,6 +81,10 @@ enum class PsfType : int32_t {
   // server-side membership surface:
   kListParams = 65,       // any -> server: param key/meta inventory
   kSetWorldVersion = 66,  // coordinator -> server: arm stale-epoch rejection
+  // hetusave (docs/FAULT_TOLERANCE.md "Coordinated job snapshots"):
+  // coordinator -> server inside the drain window: write one epoch-stamped
+  // full-state snapshot NOW and reply {version, counter, updates, epoch}
+  kSnapshotNow = 67,
   // hetutrail (docs/OBSERVABILITY.md pillar 5): deterministic test lever —
   // delay the server's NEXT optimizer apply by i64[ms] (inert without
   // HETU_TEST_MODE), so critical-path and straggler tests have a knowable

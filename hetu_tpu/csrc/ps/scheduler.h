@@ -362,7 +362,7 @@ class Scheduler {
           std::unique_lock<std::mutex> g(mu_);
           ensure_members_locked();
           const auto survivors = survivors_locked();
-          int64_t vals[10] = {
+          int64_t vals[11] = {
               static_cast<int64_t>(world_version_),
               static_cast<int64_t>(pending_version_),
               num_workers_,
@@ -372,11 +372,15 @@ class Scheduler {
               static_cast<int64_t>(drained_survivors_locked(survivors)),
               pending_version_ ? static_cast<int64_t>(survivors.size()) : 0,
               new_servers_ready_locked() ? 1 : 0,
-              static_cast<int64_t>(members_.size())};
+              static_cast<int64_t>(members_.size()),
+              // slot 10 (hetusave): completed coordinated-snapshot epochs
+              // this scheduler incarnation — a pure suffix extension, so
+              // pre-hetusave clients reading 10 slots stay valid
+              static_cast<int64_t>(snapshot_epochs_)};
           Message rsp;
           rsp.head.type = static_cast<int32_t>(PsfType::kAck);
           rsp.head.req_id = req.head.req_id;
-          rsp.args.push_back(Arg::i64(vals, 10));
+          rsp.args.push_back(Arg::i64(vals, 11));
           rsp.args.push_back(Arg::i32(members_.data(), members_.size()));
           g.unlock();
           try {
@@ -439,6 +443,14 @@ class Scheduler {
           if (pending_version_ == 0) {
             rsp = error_reply(req.head.req_id, "no resize is pending");
           } else if (abort) {
+            // hetusave rides propose-identical-world -> drain-park ->
+            // abort as its quiesce barrier: an aborted "resize" to the
+            // SAME world with nobody removed is a completed snapshot
+            // epoch, stamped here so kResizeState exposes a monotonic
+            // epoch counter to coordinators and telemetry.
+            if (pending_nw_ == num_workers_ && pending_ns_ == num_servers_ &&
+                pending_removed_.empty())
+              ++snapshot_epochs_;
             std::fprintf(stderr,
                          "[hetups scheduler] resize v%llu ABORTED; world "
                          "v%llu continues\n",
@@ -647,6 +659,9 @@ class Scheduler {
   std::map<int32_t, int64_t> drained_;  // rank -> step at drain commit
   uint64_t resize_gen_ = 0;             // bumps at finish/abort
   std::condition_variable resize_cv_;   // parks kCommitResize callers
+  uint64_t snapshot_epochs_ = 0;        // hetusave: completed coordinated
+                                        // snapshot epochs (abort of an
+                                        // identical-world propose)
 
   // members_/world_log_ materialize lazily — the launch world is fixed by
   // config, so this is valid whether it runs before or after assembly

@@ -38,6 +38,14 @@ namespace hetups {
 inline thread_local int64_t g_trail_apply_t0 = 0;
 inline thread_local int64_t g_trail_apply_us = 0;
 
+// The dedup slot this dispatch thread holds locked while executing the
+// current request. take_snapshot's ledger walk locks EVERY client slot;
+// when the snapshot is driven through the RPC path itself (kSnapshotNow),
+// re-locking the requester's own slot would self-deadlock the dispatch
+// thread — the walk reads that one slot lock-free instead (safe: this
+// thread owns its mutex for the whole handle() window).
+inline thread_local const void* g_dedup_slot_held = nullptr;
+
 // env_test_mode (the single truthy-env gate for destructive test hooks)
 // moved to net.h so the worker's chaos arming shares it.
 
@@ -293,6 +301,7 @@ class PsServer {
       }
       const int64_t tr_h0 = trail ? trail_mono_us() : 0;
       const auto handle_t0 = std::chrono::steady_clock::now();
+      g_dedup_slot_held = slot;
       try {
         handle(req, &rsp, skip_apply, &wseq);
       } catch (const std::exception& e) {
@@ -302,6 +311,7 @@ class PsServer {
         rsp.args.clear();
         rsp.args.push_back(Arg::str(e.what()));
       }
+      g_dedup_slot_held = nullptr;
       // answer a CRC-speaking client in kind: send_msg checksums the
       // response args so the client can reject a corrupted return leg
       // (error responses stay flags == -1, never checksummed)
@@ -983,6 +993,30 @@ class PsServer {
                             std::memory_order_relaxed);
         break;
       }
+      case PsfType::kSnapshotNow: {
+        // hetusave coordinated snapshot epoch: inside the drain window
+        // (workers parked, pushes_ok == updates proven by the coordinator)
+        // write one full-state snapshot NOW and report exactly which
+        // version the job manifest should pin. The optional i64[epoch]
+        // stamp is recorded for telemetry/ServerStats cross-checks. NOT
+        // test-gated — this is the production checkpoint path.
+        if (snapshot_dir_.empty())
+          throw std::runtime_error(
+              "kSnapshotNow: server has no DMLC_PS_SNAPSHOT_DIR");
+        const int64_t epoch =
+            (!req.args.empty() && req.args[0].size() >= 8)
+                ? req.args[0].as_i64()[0]
+                : -1;
+        const uint64_t version = take_snapshot();
+        last_snapshot_epoch_.store(epoch, std::memory_order_relaxed);
+        const int64_t out[4] = {
+            static_cast<int64_t>(version),
+            static_cast<int64_t>(last_snapshot_counter_.load()),
+            static_cast<int64_t>(update_count_.load()),
+            epoch};
+        rsp->args.push_back(Arg::i64(out, 4));
+        break;
+      }
       case PsfType::kServerStats: {
         // reply: i64[updates applied, updates covered by latest snapshot,
         // update counter restored from (-1 = fresh start), snapshot version,
@@ -1339,8 +1373,10 @@ class PsServer {
   // rename it into place, then flip the LATEST pointer (tmp+rename as well).
   // A crash at ANY point leaves either the previous complete snapshot or a
   // garbage .tmp dir that restore never looks at. Runs entirely under the
-  // per-param shared locks — the serving path is never paused.
-  void take_snapshot() {
+  // per-param shared locks — the serving path is never paused. Returns the
+  // published version (hetusave's kSnapshotNow reports it to the
+  // coordinator so the job manifest can pin this exact snapshot).
+  uint64_t take_snapshot() {
     namespace fs = std::filesystem;
     // serializes the periodic thread against the test hook's final snapshot
     std::lock_guard<std::mutex> take_g(snap_take_mu_);
@@ -1383,7 +1419,13 @@ class PsServer {
         for (auto& kv : clients_) slots.push_back({kv.first, kv.second.get()});
       }
       for (auto& [cid, slot] : slots) {
-        std::lock_guard<std::mutex> g(slot->mu);
+        // the in-flight kSnapshotNow requester's slot is already locked
+        // by THIS thread (g_dedup_slot_held) — read it lock-free; its
+        // last_id still names the previous request, which is exactly
+        // right: the in-flight request's response is not recorded yet
+        std::unique_lock<std::mutex> g;
+        if (static_cast<const void*>(slot) != g_dedup_slot_held)
+          g = std::unique_lock<std::mutex>(slot->mu);
         if (slot->last_id == 0) continue;
         if (slot->write_seq > 0) {
           // provenance filter: the client's last write landed AFTER its
@@ -1417,6 +1459,16 @@ class PsServer {
     fs::remove_all(root / name, ec);
     fs::rename(tmp, root / name, ec);
     if (ec) throw std::runtime_error("cannot publish snapshot " + name);
+    // crash-window fault hook pinning the pointer-flip atomicity contract:
+    // die AFTER the snapshot dir is published but BEFORE the pointer moves,
+    // exactly when the matching version lands. Restore must then follow the
+    // still-pointing-at-the-predecessor LATEST to a COMPLETE snapshot —
+    // tests/test_recovery.py holds this. Inert without HETU_TEST_MODE.
+    if (env_test_mode()) {
+      const char* kill_v = std::getenv("HETU_PS_TEST_KILL_BEFORE_POINTER");
+      if (kill_v && std::strtoull(kill_v, nullptr, 10) == version)
+        std::_Exit(137);
+    }
     // flip the pointer
     const fs::path ptr_tmp = root / (".LATEST_s" + std::to_string(rank_) +
                                      ".tmp");
@@ -1452,6 +1504,7 @@ class PsServer {
       if (is_tmp ? std::stoull(v) < version : std::stoull(v) + 1 < version)
         fs::remove_all(ent.path(), ec);
     }
+    return version;
   }
 
   struct PairHash {
@@ -1493,6 +1546,8 @@ class PsServer {
   std::atomic<uint64_t> apply_ns_{0};       // wall ns spent in write applies
   std::atomic<uint64_t> apply_count_{0};
   std::atomic<int64_t> last_snapshot_steady_ms_{0};  // 0 = none yet
+  std::atomic<int64_t> last_snapshot_epoch_{-1};  // hetusave epoch stamp on
+  // the latest kSnapshotNow-driven snapshot; -1 = none this incarnation
   long test_exit_after_updates_ = -1;              // test hook (gated)
   bool test_exit_snap_ = false;
   // hetutrail: per-request span ring + ps_slow fault state

@@ -965,6 +965,23 @@ class PsWorker {
             static_cast<int64_t>(push_ok_count_.load())};
   }
 
+  // hetusave coordinated-snapshot trigger: ask one server to write an
+  // epoch-stamped full-state snapshot NOW (synchronous — returns after the
+  // snapshot is published and its LATEST pointer flipped). Reply:
+  // [snapshot_version, covered_update_counter, update_count, epoch].
+  std::vector<int64_t> snapshot_now(size_t server, int64_t epoch) {
+    if (server >= servers_.size())
+      throw std::runtime_error("snapshot_now: server index " +
+                               std::to_string(server) + " out of range");
+    Message req;
+    req.head.type = static_cast<int32_t>(PsfType::kSnapshotNow);
+    req.head.tensor_id = -1;
+    req.args.push_back(Arg::i64(&epoch, 1));
+    Message rsp = rpc(server, req);
+    const int64_t* s = rsp.args[0].as_i64();
+    return std::vector<int64_t>(s, s + rsp.args[0].n_i64());
+  }
+
   // Per-server HA counters (kServerStats; rides the fast channel):
   // [updates, snapshot_updates, restored_updates(-1 fresh), snapshot_version,
   // n_params]. After a recovery, `updates acked before death -

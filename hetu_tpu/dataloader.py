@@ -98,8 +98,10 @@ class Dataloader:
         any peeked-but-unconsumed batch — restoring reproduces the exact
         batch sequence an uninterrupted run would have seen."""
         key, pos, has_gauss, cached = self._rng.get_state()[1:5]
+        # copy: the epoch-wrap reshuffle mutates _order IN PLACE, and a
+        # state captured mid-epoch must keep naming the permutation it saw
         d = {"cursor": np.asarray(self._cursor, np.int64),
-             "order": np.asarray(self._order),
+             "order": np.array(self._order, copy=True),
              "rng_key": np.asarray(key),
              "rng_pos": np.asarray(pos, np.int64),
              "rng_has_gauss": np.asarray(has_gauss, np.int64),

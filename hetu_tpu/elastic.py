@@ -75,6 +75,7 @@ K_FINISH_RESIZE = 63
 K_RESIZE_LOG = 64
 K_LIST_PARAMS = 65
 K_SET_WORLD_VERSION = 66
+K_SNAPSHOT_NOW = 67
 
 # ArgType values (net.h)
 _AT_F32, _AT_I64, _AT_F64, _AT_BYTES, _AT_I32, _AT_U64 = 0, 1, 2, 3, 4, 5
@@ -182,11 +183,16 @@ def resize_state(host, port, timeout: float = 5.0) -> dict:
     _, out = _rpc(host, port, K_RESIZE_STATE, timeout=timeout)
     v = _i64s(out[0])
     members = _i32s(out[1]).tolist() if len(out) > 1 else []
-    return {"world_version": int(v[0]), "pending_version": int(v[1]),
-            "n_workers": int(v[2]), "n_servers": int(v[3]),
-            "pending_n_workers": int(v[4]), "pending_n_servers": int(v[5]),
-            "drain_count": int(v[6]), "drain_needed": int(v[7]),
-            "new_servers_ready": bool(v[8]), "members": members}
+    state = {"world_version": int(v[0]), "pending_version": int(v[1]),
+             "n_workers": int(v[2]), "n_servers": int(v[3]),
+             "pending_n_workers": int(v[4]), "pending_n_servers": int(v[5]),
+             "drain_count": int(v[6]), "drain_needed": int(v[7]),
+             "new_servers_ready": bool(v[8]), "members": members}
+    if len(v) > 10:
+        # hetusave suffix extension: completed coordinated-snapshot epochs
+        # this scheduler incarnation (abort of an identical-world propose)
+        state["snapshot_epochs"] = int(v[10])
+    return state
 
 
 def commit_resize(host, port, rank: int, step: int,
@@ -291,6 +297,22 @@ def server_stats_raw(addr: str, timeout: float = 3.0) -> list[int]:
     _, out = _rpc(host, port, K_SERVER_STATS, timeout=timeout,
                   who=f"ps server {addr}")
     return [int(x) for x in _i64s(out[0])]
+
+
+def server_snapshot_now(addr: str, epoch: int = -1,
+                        timeout: float = 60.0) -> dict:
+    """kSnapshotNow over a raw socket (no native lib): drive one PS
+    server's epoch-stamped full-state snapshot and return
+    {version, counter, updates, epoch}. Synchronous — the snapshot dir is
+    published and its LATEST pointer flipped before the reply. The
+    jax-free twin of ``PSClient.SnapshotNow`` for coordinator tooling
+    (bin/hetusave) that must not import jax."""
+    host, port = _split_addr(addr)
+    _, out = _rpc(host, port, K_SNAPSHOT_NOW, [_arg_i64([int(epoch)])],
+                  timeout=timeout, who=f"ps server {addr}")
+    v = _i64s(out[0])
+    return {"version": int(v[0]), "counter": int(v[1]),
+            "updates": int(v[2]), "epoch": int(v[3])}
 
 
 def _rpc_with_tensor(addr: str, msg_type: int, tensor_id: int,

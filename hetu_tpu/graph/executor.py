@@ -1798,6 +1798,14 @@ class Executor:
                 # after subexecutors exist: a late joiner's bootstrap
                 # re-partitions their dataloaders from the world log
                 self.elastic.bootstrap()
+            # heturun --restore (docs/FAULT_TOLERANCE.md "Coordinated job
+            # snapshots"): re-impose this rank's persisted state from the
+            # newest committed job epoch and verify the update-counter
+            # algebra against the manifest BEFORE any training step runs
+            restore_dir = os.environ.get("HETU_RESTORE_DIR", "")
+            if restore_dir:
+                from ..recovery import restore_executor_from_env
+                restore_executor_from_env(self, restore_dir)
 
     # ------------------------------------------------------------------
     def _lint(self, lint):

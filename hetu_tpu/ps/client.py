@@ -45,6 +45,8 @@ def _load_lib():
     lib.TrailDropped.restype = ctypes.c_longlong
     lib.SetChaos.argtypes = [ctypes.c_char_p]
     lib.DrainChaosEvents.restype = ctypes.c_long
+    lib.ServerSnapshotNow.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                      _i64p, ctypes.c_int]
     return lib
 
 
@@ -290,6 +292,29 @@ class PSClient:
     def TrailDropped(self) -> int:
         """Spans dropped because the bounded client ring was full."""
         return int(self._lib.TrailDropped())
+
+    def SnapshotNow(self, server: int, epoch: int = -1) -> dict:
+        """hetusave coordinated-snapshot trigger: drive PS server
+        ``server`` to write one epoch-stamped full-state snapshot NOW
+        (synchronous — returns only after the snapshot dir is published
+        and its ``LATEST_s<rank>`` pointer flipped, so the returned
+        ``version`` is durable). The server must have been launched with
+        ``DMLC_PS_SNAPSHOT_DIR``. Returns ``version`` (the per-server
+        snapshot version the job manifest pins), ``counter`` (the update
+        counter the snapshot covers), ``updates`` (the server's live
+        counter at reply time — inside a quiesced drain window the two
+        are EQUAL, the consistency proof hetusave checks), and the
+        echoed ``epoch``. A production checkpoint primitive — not
+        test-gated (docs/FAULT_TOLERANCE.md "Coordinated job
+        snapshots")."""
+        out = np.zeros(4, np.int64)
+        self._lib.ServerSnapshotNow(ctypes.c_int(int(server)),
+                                    ctypes.c_longlong(int(epoch)),
+                                    out.ctypes.data_as(_i64p),
+                                    ctypes.c_int(4))
+        self._check()
+        return {"version": int(out[0]), "counter": int(out[1]),
+                "updates": int(out[2]), "epoch": int(out[3])}
 
     def TestSlowApply(self, server=0, ms=100):
         """Test hook (requires HETU_TEST_MODE): delay PS server ``server``'s

@@ -166,6 +166,15 @@ class FaultInjector:
       instead — docs/FAULT_TOLERANCE.md "Chaos testing & transport
       hardening"). For full seeded schedules use ``HETU_CHAOS_SPEC`` /
       ``bin/hetuchaos`` directly.
+    - ``job_kill@S[:PHASE]`` — whole-job death (hetusave,
+      docs/FAULT_TOLERANCE.md "Coordinated job snapshots"). With no
+      PHASE: at step S every live local-cluster PS process is SIGKILLed
+      and then this worker SIGKILLs itself — the power-loss/pool-sweep
+      shape only a committed job epoch recovers from. With PHASE (one of
+      ``pre_barrier|server_write|pre_commit|post_commit``): arms the
+      crash window INSIDE the next coordinated snapshot at step >= S,
+      consumed by ``recovery.take_job_snapshot`` at exactly that phase —
+      how the soak proves torn epochs are never restore-eligible.
 
     The full injector catalogue (args, gating, which subsystem each kind
     exercises, plus the native ``HETU_PS_TEST_EXIT_AFTER_UPDATES`` and
@@ -179,7 +188,7 @@ class FaultInjector:
 
     KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
              "ps_kill", "quant_corrupt", "worker_lost", "ps_join",
-             "ps_slow", "ps_partition")
+             "ps_slow", "ps_partition", "job_kill")
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -195,10 +204,19 @@ class FaultInjector:
                     f"kind in {self.KINDS} — see the fault-kind catalogue in "
                     f"docs/FAULT_TOLERANCE.md")
             step_s, _, arg_s = rest.partition(":")
-            # nan_op's arg is an OP NAME, every other kind's a number
+            # nan_op's arg is an OP NAME, job_kill's a snapshot PHASE,
+            # every other kind's a number
             arg = None
             if arg_s:
-                arg = arg_s if kind == "nan_op" else float(arg_s)
+                if kind == "job_kill":
+                    from .recovery import PHASES
+                    if arg_s not in PHASES:
+                        raise ValueError(
+                            f"bad fault entry {part!r}: job_kill phase "
+                            f"{arg_s!r} not in {PHASES}")
+                    arg = arg_s
+                else:
+                    arg = arg_s if kind == "nan_op" else float(arg_s)
             self.entries.append({
                 "kind": kind, "step": int(step_s),
                 "arg": arg, "fired": False,
@@ -279,6 +297,18 @@ class FaultInjector:
             # chaos-engine partition window over the next n attempts to
             # srv (SetChaos is HETU_TEST_MODE-gated like this injector)
             comm.SetChaos(f"seed={step},partition={srv}:0:{n}")
+        e = self.take("job_kill", step)
+        if e is not None:
+            from . import recovery
+            if e["arg"] is None:
+                # whole-job death at a step boundary: every PS process dies
+                # with the worker, no grace, no cleanup — only a committed
+                # hetusave epoch can bring the job back
+                recovery.kill_whole_job(step)
+            else:
+                # phase-targeted: arm the crash window inside the NEXT
+                # coordinated snapshot (consumed by take_job_snapshot)
+                recovery.arm_job_kill(e["arg"])
         if self.take("sigterm", step) is not None:
             os.kill(os.getpid(), _signal.SIGTERM)
         if self.take("sigint", step) is not None:
@@ -664,7 +694,13 @@ class Supervisor:
                  anomaly: Optional[AnomalyPolicy] = None,
                  watchdog: Optional[Watchdog] = None,
                  preemption: Optional[PreemptionHandler] = None,
-                 fault_injector: Any = "env"):
+                 fault_injector: Any = "env", job_ckptr=None):
+        # job_ckptr: a recovery.JobCheckpointer — when attached (the job
+        # runs under a live hetusave coordinator), the SIGTERM grace window
+        # upgrades from a worker-local emergency save to a COORDINATED job
+        # snapshot, so the preemption leaves a globally consistent epoch
+        # (worker + PS shards + cursors) instead of worker state alone.
+        self.job_ckptr = job_ckptr
         self.ckptr = ckptr
         self.ckpt_every = ckpt_every
         self.anomaly = anomaly if anomaly is not None else AnomalyPolicy()
@@ -751,11 +787,32 @@ class Supervisor:
             # already-durable checkpoint's state, and writing it under id
             # ``step`` would break the 'checkpoint id = last completed
             # step' invariant resume arithmetic relies on.
-            if self.ckptr is not None and self.last_saved_step != step \
+            coordinated = False
+            if self.job_ckptr is not None and action != "rollback":
+                # coordinated upgrade: quiesce the whole job and commit one
+                # consistent epoch inside the grace window. Best-effort —
+                # a failed coordination (e.g. scheduler already gone) falls
+                # back to the worker-local emergency save below.
+                try:
+                    self.job_ckptr.save(ex, step)
+                    coordinated = True
+                    self.last_saved_step = step
+                    _tel_event("emergency_save", step=step,
+                               coordinated=True)
+                except Exception as je:  # noqa: BLE001 — grace window:
+                    # any failure must not cost the worker-local save
+                    print(f"# hetu supervisor: coordinated snapshot failed "
+                          f"({je!r}); falling back to worker-local save",
+                          file=sys.stderr)
+            if not coordinated and self.ckptr is not None \
+                    and self.last_saved_step != step \
                     and action != "rollback":
                 self.save(ex, step)
                 _tel_event("emergency_save", step=step)
-            durable = ("no checkpointer attached — resume will cold-start"
+            durable = (f"durable coordinated epoch: step "
+                       f"{self.last_saved_step} (heturun --restore)"
+                       if coordinated else
+                       "no checkpointer attached — resume will cold-start"
                        if self.ckptr is None else
                        f"durable checkpoint: step {self.last_saved_step}")
             print(f"# hetu supervisor: preemption signal "

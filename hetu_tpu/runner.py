@@ -212,6 +212,21 @@ def main(argv=None):
                              "Workers run with HETU_ELASTIC=1 and drain/"
                              "commit at step boundaries (see 'Elastic "
                              "membership' in docs/FAULT_TOLERANCE.md)")
+    parser.add_argument("--restore", metavar="JOBDIR", default="",
+                        help="reconstruct the whole job from the newest "
+                             "COMMITTED coordinated snapshot epoch under "
+                             "JOBDIR (written by hetusave / "
+                             "resilience.JobCheckpointer): servers restore "
+                             "the epoch's pinned shard snapshots "
+                             "(DMLC_PS_RESTORE_DIR), workers re-impose "
+                             "params/optimizer/dataloader/RNG state and "
+                             "verify the update-counter algebra before "
+                             "step one. The epoch may be restored into a "
+                             "DIFFERENT world size — key ranges re-split "
+                             "offline, optimizer state rides bit-for-bit "
+                             "(single-host PS mode; see "
+                             "docs/FAULT_TOLERANCE.md 'Coordinated job "
+                             "snapshots')")
     parser.add_argument("--telemetry-dir", default="",
                         help="shared telemetry directory: workers run with "
                              "HETU_TELEMETRY_DIR set (HETU_TELEMETRY "
@@ -271,6 +286,33 @@ def main(argv=None):
         # failover. Explicit env wins over the defaults.
         from hetu_tpu.ps.supervisor import apply_ha_env_defaults
         ps_snap_created = apply_ha_env_defaults(env)
+    if args.restore:
+        if not (enable_ps and len(hosts) == 1):
+            print("# heturun: --restore requires single-host PS mode",
+                  file=sys.stderr)
+            return 2
+        # resolve (and, on a world-size change, re-split) BEFORE any role
+        # spawns: a job must never half-start against an unrestorable dir
+        from hetu_tpu.recovery import RecoveryError, prepare_restore
+        try:
+            prep = prepare_restore(os.path.abspath(args.restore),
+                                   num_servers)
+        except RecoveryError as e:
+            print(f"# heturun: --restore failed: {e}", file=sys.stderr)
+            return 2
+        m = prep["manifest"]
+        env["DMLC_PS_RESTORE_DIR"] = prep["server_restore_dir"]
+        # workers: Executor re-imposes this rank's state from the job dir
+        # and verifies the counter algebra (recovery.restore_executor_from_env)
+        env["HETU_RESTORE_DIR"] = os.path.abspath(args.restore)
+        # restored workers are JOINERS: InitTensor must not push fresh
+        # values over the restored tables, and init barriers are moot
+        env["HETU_ELASTIC_JOIN"] = "1"
+        rs = prep["resplit"]
+        print(f"# heturun --restore: epoch {m['epoch']} (step {m['step']}, "
+              f"{m['total_updates']} updates) from {args.restore}"
+              + (f"; re-split {rs['old_n_servers']} -> "
+                 f"{rs['new_n_servers']} servers" if rs else ""))
     elastic_on = args.elastic and enable_ps and len(hosts) == 1
     elastic_dir = None
     if args.elastic and not elastic_on:

@@ -547,6 +547,22 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
             line += (f"  injected faults "
                      f"{int(ch['hetu_chaos_faults_total'])} (chaos armed)")
         lines.append(line)
+    # hetusave coordinated job snapshots (docs/FAULT_TOLERANCE.md
+    # "Coordinated job snapshots"): newest committed epoch + the wall
+    # cost of taking it, from take_job_snapshot's gauges. Absent (no
+    # line) for jobs that never committed a coordinated epoch.
+    ep, ep_ms = None, None
+    for rk in state["ranks"].values():
+        m = rk["metrics"]
+        v = _defloat(m.get("hetu_job_epoch"))
+        if v is not None and (ep is None or v > ep):
+            ep = v
+            ep_ms = _defloat(m.get("hetu_snapshot_last_ms"))
+    if ep is not None:
+        line = f"snapshot: job epoch {int(ep)} committed"
+        if ep_ms is not None:
+            line += f"  last stall {ep_ms:.0f}ms"
+        lines.append(line)
     if state["ps"]:
         lines.append("PS servers:")
         for sid in sorted(state["ps"]):

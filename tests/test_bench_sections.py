@@ -19,7 +19,8 @@ import bench  # noqa: E402
 SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
             "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
-            "introspect", "trail", "chaos", "kernels", "planner"]
+            "introspect", "trail", "chaos", "kernels", "planner",
+            "snapshot"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
@@ -41,6 +42,9 @@ EXPECTED_KEYS = {
     # hetuplan: the cell must carry both sides of the prediction claim
     # (docs/ANALYSIS.md Tier C)
     "planner": ("predicted_step_ms", "measured_step_ms", "plan_err_pct"),
+    # hetusave: the stall A/B must have actually taken snapshots, and the
+    # cell carries the per-epoch wall cost behind the stall headline
+    "snapshot": ("snapshot_stall_pct", "snapshot_wall_ms", "snapshots"),
 }
 
 
