@@ -437,6 +437,15 @@ class Scheduler {
           const bool abort =
               !req.args.empty() && req.args[0].size() >= 4 &&
               req.args[0].as_i32()[0] != 0;
+          // optional second i32 (suffix extension, hetusave only): the
+          // coordinator tags the abort that releases a COMMITTED snapshot
+          // epoch. Only tagged aborts advance snapshot_epochs_ — shape
+          // inference (identical world, nobody removed) would miscount a
+          // genuine same-size resize aborted after a drain timeout, or a
+          // failed snapshot's best-effort release, as a completed epoch.
+          const bool snapshot_done =
+              abort && req.args[0].size() >= 8 &&
+              req.args[0].as_i32()[1] != 0;
           std::unique_lock<std::mutex> g(mu_);
           ensure_members_locked();
           Message rsp;
@@ -444,13 +453,11 @@ class Scheduler {
             rsp = error_reply(req.head.req_id, "no resize is pending");
           } else if (abort) {
             // hetusave rides propose-identical-world -> drain-park ->
-            // abort as its quiesce barrier: an aborted "resize" to the
-            // SAME world with nobody removed is a completed snapshot
-            // epoch, stamped here so kResizeState exposes a monotonic
-            // epoch counter to coordinators and telemetry.
-            if (pending_nw_ == num_workers_ && pending_ns_ == num_servers_ &&
-                pending_removed_.empty())
-              ++snapshot_epochs_;
+            // abort as its quiesce barrier; when the coordinator tagged
+            // this abort as the release AFTER its job manifest committed,
+            // stamp the completed snapshot epoch so kResizeState exposes
+            // a monotonic epoch counter to coordinators and telemetry.
+            if (snapshot_done) ++snapshot_epochs_;
             std::fprintf(stderr,
                          "[hetups scheduler] resize v%llu ABORTED; world "
                          "v%llu continues\n",
@@ -660,8 +667,8 @@ class Scheduler {
   uint64_t resize_gen_ = 0;             // bumps at finish/abort
   std::condition_variable resize_cv_;   // parks kCommitResize callers
   uint64_t snapshot_epochs_ = 0;        // hetusave: completed coordinated
-                                        // snapshot epochs (abort of an
-                                        // identical-world propose)
+                                        // snapshot epochs (snapshot-tagged
+                                        // kFinishResize aborts only)
 
   // members_/world_log_ materialize lazily — the launch world is fixed by
   // config, so this is valid whether it runs before or after assembly

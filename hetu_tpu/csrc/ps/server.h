@@ -39,11 +39,15 @@ inline thread_local int64_t g_trail_apply_t0 = 0;
 inline thread_local int64_t g_trail_apply_us = 0;
 
 // The dedup slot this dispatch thread holds locked while executing the
-// current request. take_snapshot's ledger walk locks EVERY client slot;
-// when the snapshot is driven through the RPC path itself (kSnapshotNow),
-// re-locking the requester's own slot would self-deadlock the dispatch
-// thread — the walk reads that one slot lock-free instead (safe: this
-// thread owns its mutex for the whole handle() window).
+// current request. take_snapshot's ledger walk locks EVERY client slot,
+// so no caller may enter it while holding one: serve_conn drops the
+// requester's slot BEFORE kSnapshotNow's handle() (holding it while
+// take_snapshot waits on snap_take_mu_ would ABBA-deadlock against the
+// periodic snapshot_loop thread, which holds snap_take_mu_ and then
+// locks slots during the ledger walk). This thread_local remains as
+// belt-and-braces: if a future caller does reach take_snapshot with a
+// slot held, the walk reads that one slot lock-free instead of
+// self-deadlocking.
 inline thread_local const void* g_dedup_slot_held = nullptr;
 
 // env_test_mode (the single truthy-env gate for destructive test hooks)
@@ -301,7 +305,20 @@ class PsServer {
       }
       const int64_t tr_h0 = trail ? trail_mono_us() : 0;
       const auto handle_t0 = std::chrono::steady_clock::now();
-      g_dedup_slot_held = slot;
+      // kSnapshotNow's handle() acquires snap_take_mu_ and then walks
+      // every dedup slot; the periodic snapshot_loop thread takes those
+      // same locks in that order. Holding this requester's slot across
+      // handle() would close an ABBA cycle (dispatch: slot ->
+      // snap_take_mu_; periodic: snap_take_mu_ -> slot), so the snapshot
+      // path releases the slot for the handle() window and re-locks it to
+      // record the response. A concurrent resend executing meanwhile is
+      // harmless: take_snapshot serializes on snap_take_mu_, both
+      // snapshots are complete, and the last recorded response wins.
+      const bool drop_slot_for_snapshot =
+          slot != nullptr &&
+          req.head.type == static_cast<int32_t>(PsfType::kSnapshotNow);
+      if (drop_slot_for_snapshot) slot_g.unlock();
+      g_dedup_slot_held = drop_slot_for_snapshot ? nullptr : slot;
       try {
         handle(req, &rsp, skip_apply, &wseq);
       } catch (const std::exception& e) {
@@ -312,6 +329,7 @@ class PsServer {
         rsp.args.push_back(Arg::str(e.what()));
       }
       g_dedup_slot_held = nullptr;
+      if (drop_slot_for_snapshot) slot_g.lock();
       // answer a CRC-speaking client in kind: send_msg checksums the
       // response args so the client can reject a corrupted return leg
       // (error responses stay flags == -1, never checksummed)
@@ -328,12 +346,18 @@ class PsServer {
             std::memory_order_relaxed);
         apply_count_.fetch_add(1, std::memory_order_relaxed);
       }
-      if (slot) {
+      // req_id >= last_id always holds on the normal path (the lock was
+      // held since the dedup check); on the snapshot path a newer request
+      // may have executed while the slot was dropped — never regress the
+      // ledger below it (the reply still goes out from rsp directly).
+      bool recorded = false;
+      if (slot && req.head.req_id >= slot->last_id) {
         slot->last_id = req.head.req_id;
         slot->rsp = std::move(rsp);  // no payload copy; slot mutex still held
         slot->has_rsp = true;
         slot->write_seq = wseq;
         slot->write_key = req.head.tensor_id;
+        recorded = true;
       }
       if (test_exit_after_updates_ >= 0 &&
           update_count_.load() >=
@@ -357,7 +381,7 @@ class PsServer {
       const int64_t tr_h1 = trail ? trail_mono_us() : 0;
       bool sent = true;
       try {
-        send_msg(fd, slot ? slot->rsp : rsp);
+        send_msg(fd, recorded ? slot->rsp : rsp);
       } catch (...) {
         sent = false;  // peer gone mid-reply
       }
@@ -1419,10 +1443,14 @@ class PsServer {
         for (auto& kv : clients_) slots.push_back({kv.first, kv.second.get()});
       }
       for (auto& [cid, slot] : slots) {
-        // the in-flight kSnapshotNow requester's slot is already locked
-        // by THIS thread (g_dedup_slot_held) — read it lock-free; its
-        // last_id still names the previous request, which is exactly
-        // right: the in-flight request's response is not recorded yet
+        // No live caller reaches here holding a slot mutex (serve_conn
+        // drops the kSnapshotNow requester's slot before handle() — the
+        // ABBA-deadlock fix against the periodic snapshot thread), so
+        // every slot locks normally; the in-flight requester's last_id
+        // still names the last RECORDED request, which is exactly right.
+        // g_dedup_slot_held stays as same-thread self-deadlock defense
+        // for any future caller that does hold one: read that slot
+        // lock-free instead of re-locking.
         std::unique_lock<std::mutex> g;
         if (static_cast<const void*>(slot) != g_dedup_slot_held)
           g = std::unique_lock<std::mutex>(slot->mu);

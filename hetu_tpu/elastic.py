@@ -190,7 +190,8 @@ def resize_state(host, port, timeout: float = 5.0) -> dict:
              "new_servers_ready": bool(v[8]), "members": members}
     if len(v) > 10:
         # hetusave suffix extension: completed coordinated-snapshot epochs
-        # this scheduler incarnation (abort of an identical-world propose)
+        # this scheduler incarnation (snapshot-tagged finish_resize aborts
+        # only — the coordinator tags after its job manifest committed)
         state["snapshot_epochs"] = int(v[10])
     return state
 
@@ -212,12 +213,18 @@ def commit_resize(host, port, rank: int, step: int,
             "book": out[2].decode() if len(out) > 2 else ""}
 
 
-def finish_resize(host, port, abort: bool = False) -> int:
+def finish_resize(host, port, abort: bool = False,
+                  snapshot: bool = False) -> int:
     """Phase 2: atomically flip the world (or abort the pending proposal)
     and release every parked worker. Requires the drain barrier to be
-    complete unless aborting. Returns the now-current world version."""
+    complete unless aborting. ``snapshot=True`` (hetusave's success path
+    only, with ``abort=True``) tags the abort as the release of a
+    COMMITTED coordinated-snapshot epoch so the scheduler's monotonic
+    ``snapshot_epochs`` counter advances; untagged aborts — drain
+    timeouts, failed migrations, a snapshot that died before its manifest
+    committed — never count. Returns the now-current world version."""
     _, out = _rpc(host, port, K_FINISH_RESIZE,
-                  [_arg_i32([1 if abort else 0])])
+                  [_arg_i32([1 if abort else 0, 1 if snapshot else 0])])
     return int(_i64s(out[0])[0])
 
 

@@ -445,6 +445,11 @@ def take_job_snapshot(ex, jobdir: str, *,
     Any failure (or armed job_kill) aborts the barrier best-effort and
     re-raises; a death at any point leaves either the previous committed
     epoch or a torn epoch restore never selects.
+
+    Multi-worker jobs are refused up front (:class:`RecoveryError`,
+    before the barrier is proposed): this coordinator persists only its
+    own rank's worker state, and an epoch missing ranks must never
+    commit — it would pass every completeness check yet be unrestorable.
     """
     from . import ps as ps_pkg
     from .elastic import (commit_resize, finish_resize, propose_resize,
@@ -477,6 +482,19 @@ def take_job_snapshot(ex, jobdir: str, *,
     rt.drain()
     st = resize_state(host, port)
     nw, ns = int(st["n_workers"]), int(st["n_servers"])
+    if nw != 1:
+        # this coordinator captures only its OWN rank's worker state; a
+        # committed epoch for a bigger world would pass every on-disk
+        # completeness check yet be unrestorable (load_worker_state raises
+        # for every other rank). Refuse up front — before the barrier is
+        # even proposed — rather than hand the operator an epoch that
+        # looks restorable and is not. Multi-rank capture is the lift
+        # required to relax this.
+        raise RecoveryError(
+            f"coordinated snapshot with {nw} workers is not supported: "
+            "the coordinator persists only its own rank's state, so the "
+            "committed epoch could never restore the other ranks — "
+            "refusing to write an unrestorable epoch")
     propose_resize(host, port, nw, ns)
 
     parked: dict = {}
@@ -512,16 +530,15 @@ def take_job_snapshot(ex, jobdir: str, *,
             # yielding the GIL to the parked commit thread
             time.sleep(0.002)
 
-        # quiesce proof — the dedup-ledger accounting invariant, checked
-        # EXACTLY for the single-worker coordinator (with more workers,
-        # pushes_ok is per-worker and the sum lives with the launcher;
-        # the barrier itself still guarantees no worker is mid-step)
+        # quiesce proof — the dedup-ledger accounting invariant, exact
+        # because the nw == 1 gate above guarantees this worker's
+        # pushes_ok is the WHOLE job's push count
         cs = comm.ClientStats()
         sstats = [comm.ServerStats(s) for s in range(ns)]
         applied = sum(int(s["updates"]) - max(int(s["restored_updates"]), 0)
                       for s in sstats)
         pushed = int(cs["pushes_ok"])
-        if nw == 1 and pushed != applied:
+        if pushed != applied:
             raise RecoveryError(
                 f"quiesce proof failed: client pushes_ok {pushed} != "
                 f"servers' applied updates {applied} — in-flight writes "
@@ -581,7 +598,11 @@ def take_job_snapshot(ex, jobdir: str, *,
         commit_manifest(jobdir, manifest)
         _phase("post_commit")
 
-        finish_resize(host, port, abort=True)
+        # snapshot=True tags this abort as the release of a COMMITTED
+        # epoch — the scheduler counts snapshot_epochs from the tag, so a
+        # failed snapshot's best-effort abort (the except path below)
+        # never inflates the counter
+        finish_resize(host, port, abort=True, snapshot=True)
         released = True
         th.join(timeout=timeout)
         if "error" in parked:
@@ -622,22 +643,62 @@ class JobCheckpointer:
     coordinated epoch into ``jobdir`` and prunes old ones; wire it as
     ``Supervisor(job_ckptr=...)`` so a SIGTERM grace window upgrades the
     worker-local emergency save to a globally consistent epoch, and/or
-    call :meth:`maybe_save` at a step cadence."""
+    call :meth:`maybe_save` at a step cadence.
+
+    ``barrier_timeout`` bounds the drain barrier (and every other wait
+    inside :func:`take_job_snapshot`) for cadence saves; ``None`` means
+    take_job_snapshot's 120s default. :meth:`save_preempt` — the
+    Supervisor's SIGTERM-grace upgrade path — instead bounds the barrier
+    by the preemption grace budget (``grace_s`` or the
+    ``HETU_PREEMPT_GRACE_S`` env var, defaulting to heturun's 30s
+    window) minus 5s of headroom (floor 2s): a coordinated save
+    attempted inside a grace window must fail with time LEFT, so the
+    worker-local fallback save still lands before the SIGKILL."""
+
+    #: headroom (seconds) reserved inside the grace window for the
+    #: worker-local fallback save after a hung/failed barrier
+    GRACE_HEADROOM_S = 5.0
 
     def __init__(self, jobdir: str, every: Optional[int] = None,
                  keep: int = 2,
-                 on_phase: Optional[Callable[[str], None]] = None):
+                 on_phase: Optional[Callable[[str], None]] = None,
+                 barrier_timeout: Optional[float] = None,
+                 grace_s: Optional[float] = None):
         self.jobdir = jobdir
         self.every = every
         self.keep = max(1, int(keep))
         self.on_phase = on_phase
+        if grace_s is None:
+            env = os.environ.get("HETU_PREEMPT_GRACE_S")
+            # heturun's SIGTERM grace default is 30s; assume it rather
+            # than let a hung barrier ride a 120s default into SIGKILL
+            grace_s = float(env) if env else 30.0
+        self.grace_s = float(grace_s)
+        self.barrier_timeout = barrier_timeout
         self.last_manifest: Optional[dict] = None
 
-    def save(self, ex, step: int) -> dict:
-        m = take_job_snapshot(ex, self.jobdir, on_phase=self.on_phase)
+    def grace_timeout(self) -> float:
+        """Barrier bound for a save inside the preemption grace window."""
+        t = max(2.0, self.grace_s - self.GRACE_HEADROOM_S)
+        if self.barrier_timeout is not None:
+            t = min(t, float(self.barrier_timeout))
+        return t
+
+    def save(self, ex, step: int, *,
+             timeout: Optional[float] = None) -> dict:
+        t = timeout if timeout is not None else self.barrier_timeout
+        kw = {"timeout": float(t)} if t is not None else {}
+        m = take_job_snapshot(ex, self.jobdir, on_phase=self.on_phase,
+                              **kw)
         self.last_manifest = m
         self._prune()
         return m
+
+    def save_preempt(self, ex, step: int) -> dict:
+        """The SIGTERM grace-window save: same epoch, but the drain
+        barrier is bounded a few seconds below the known grace period so
+        the caller's except-based worker-local fallback still runs."""
+        return self.save(ex, step, timeout=self.grace_timeout())
 
     def maybe_save(self, ex, step: int) -> Optional[dict]:
         if self.every and (int(step) + 1) % int(self.every) == 0:

@@ -793,8 +793,13 @@ class Supervisor:
                 # consistent epoch inside the grace window. Best-effort —
                 # a failed coordination (e.g. scheduler already gone) falls
                 # back to the worker-local emergency save below.
+                # save_preempt bounds the drain barrier by the grace
+                # budget (JobCheckpointer grace_s / HETU_PREEMPT_GRACE_S,
+                # minus headroom) so a hung barrier fails with time LEFT
+                # in the window — otherwise the SIGKILL would land
+                # mid-coordination and cost the worker-local save too.
                 try:
-                    self.job_ckptr.save(ex, step)
+                    self.job_ckptr.save_preempt(ex, step)
                     coordinated = True
                     self.last_saved_step = step
                     _tel_event("emergency_save", step=step,

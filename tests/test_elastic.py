@@ -425,6 +425,53 @@ def test_resize_abort_releases_workers():
         cl.close(worker_ranks=(0,))
 
 
+def test_snapshot_epochs_count_only_tagged_aborts():
+    """Regression: the scheduler's snapshot_epochs counter advances ONLY
+    on hetusave's snapshot-tagged abort (sent after its job manifest
+    committed) — an identical-world resize aborted for any other reason
+    (drain timeout, failed migration, a snapshot that died pre-commit)
+    must never be miscounted as a completed coordinated epoch."""
+    cl = _Cluster(n_workers=1, n_servers=1)
+    try:
+        regs = {}
+        _register_fake_worker(cl.port, 0, regs)
+
+        def park_then_abort(**abort_kw):
+            out = {}
+
+            def commit():
+                out["w"] = elastic.commit_resize("127.0.0.1", cl.port, 0, 3)
+
+            t = threading.Thread(target=commit)
+            t.start()
+            deadline = time.time() + 30
+            while elastic.resize_state("127.0.0.1",
+                                       cl.port)["drain_count"] < 1:
+                assert time.time() < deadline
+                time.sleep(0.05)
+            elastic.finish_resize("127.0.0.1", cl.port, abort=True,
+                                  **abort_kw)
+            t.join(timeout=30)
+            assert out["w"]["world_version"] == 1
+
+        def epochs():
+            return elastic.resize_state("127.0.0.1",
+                                        cl.port)["snapshot_epochs"]
+
+        assert epochs() == 0
+        # identical-world propose aborted UNTAGGED (the failed-snapshot /
+        # drain-timeout shape): not a completed epoch
+        elastic.propose_resize("127.0.0.1", cl.port, 1, 1)
+        park_then_abort()
+        assert epochs() == 0
+        # hetusave's post-commit tagged release: exactly one epoch
+        elastic.propose_resize("127.0.0.1", cl.port, 1, 1)
+        park_then_abort(snapshot=True)
+        assert epochs() == 1
+    finally:
+        cl.close(worker_ranks=(0,))
+
+
 # ---------------------------------------------------------------------------
 # multi-process worker bodies (module level: spawn pickles by reference)
 # ---------------------------------------------------------------------------

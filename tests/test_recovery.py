@@ -217,6 +217,39 @@ def test_snapshot_now_psf_publishes_durable_versions(tmp_path, monkeypatch):
             ps_pkg.worker_finish()
 
 
+def test_snapshot_now_concurrent_with_periodic_snapshots(tmp_path,
+                                                         monkeypatch):
+    """Regression (ABBA deadlock): the kSnapshotNow dispatch thread used
+    to hold the requester's dedup-slot mutex while waiting on
+    snap_take_mu_, while the periodic snapshot thread held snap_take_mu_
+    and locked that same slot during its ledger walk. With the periodic
+    snapshotter spinning at a 1ms interval and every push dirtying state,
+    this loop deadlocked within a few iterations; now the dispatch path
+    drops the slot across handle() and every RPC snapshot completes."""
+    from hetu_tpu.ps.local_cluster import local_cluster
+    from hetu_tpu import ps as ps_pkg
+    snapdir = str(tmp_path / "snap")
+    monkeypatch.setenv("DMLC_PS_SNAPSHOT_DIR", snapdir)
+    monkeypatch.setenv("DMLC_PS_SNAPSHOT_MS", "1")
+    with local_cluster(n_servers=1, n_workers=1):
+        ps_pkg.worker_init()
+        try:
+            comm = ps_pkg.get_worker_communicate()
+            comm.InitTensor(0, sparse=False, length=8, width=1,
+                            init_type="constant", init_a=0.0)
+            last = None
+            for i in range(30):
+                comm.Push(0, np.ones(8, np.float32))
+                comm.Wait(0)
+                last = comm.SnapshotNow(0, epoch=i)
+            assert last["updates"] == 30
+            # quiesced between pushes: the RPC snapshot covers the live
+            # counter exactly, periodic-thread races notwithstanding
+            assert last["counter"] == 30
+        finally:
+            ps_pkg.worker_finish()
+
+
 def test_kill_between_publish_and_pointer_restores_previous(tmp_path,
                                                             monkeypatch):
     """Satellite regression: the server dies AFTER publishing the v2
@@ -287,6 +320,76 @@ def test_kill_between_publish_and_pointer_restores_previous(tmp_path,
             assert not np.array_equal(got, val_later)
         finally:
             ps_pkg.worker_finish()
+
+
+# ---------------------------------------------------------------------------
+# coordinator guards: multi-worker refusal + grace-budget barrier timeout
+# ---------------------------------------------------------------------------
+
+def test_take_job_snapshot_refuses_multi_worker(tmp_path, monkeypatch):
+    """Regression: the coordinator captures only its own rank's worker
+    state, so a multi-worker job must be refused BEFORE the barrier is
+    even proposed — a committed epoch missing ranks would pass every
+    completeness check yet be unrestorable for every other rank."""
+    from hetu_tpu import elastic, recovery
+    from hetu_tpu import ps as ps_pkg
+
+    class Rt:
+        def drain(self):
+            pass
+
+    class Ex:
+        ps_runtime = Rt()
+        state = {"step": 3}
+
+    jobdir = str(tmp_path / "job")
+    monkeypatch.setenv("DMLC_PS_SNAPSHOT_DIR", str(tmp_path / "snap"))
+    monkeypatch.setattr(ps_pkg, "get_worker_communicate", lambda: object())
+    monkeypatch.setattr(elastic, "resize_state",
+                        lambda host, port: {"n_workers": 2, "n_servers": 1})
+
+    def no_propose(*a, **k):
+        raise AssertionError("barrier proposed for an unrestorable epoch")
+
+    monkeypatch.setattr(elastic, "propose_resize", no_propose)
+    with pytest.raises(recovery.RecoveryError, match="2 workers"):
+        recovery.take_job_snapshot(Ex(), jobdir)
+    assert recovery.latest_committed_manifest(jobdir) is None
+
+
+def test_job_checkpointer_grace_budget_barrier_timeout(tmp_path,
+                                                       monkeypatch):
+    """Regression: the SIGTERM-grace coordinated save must bound its
+    drain barrier BELOW the preemption grace period (grace_s /
+    HETU_PREEMPT_GRACE_S), leaving headroom for the worker-local
+    fallback — take_job_snapshot's 120s default would ride a 30s grace
+    window straight into the SIGKILL and cost BOTH saves."""
+    from hetu_tpu import recovery
+    jd = str(tmp_path)
+    monkeypatch.delenv("HETU_PREEMPT_GRACE_S", raising=False)
+    ck = recovery.JobCheckpointer(jd)
+    assert ck.grace_s == 30.0                    # heturun's default window
+    assert ck.grace_timeout() == 25.0
+    assert recovery.JobCheckpointer(jd, grace_s=4).grace_timeout() == 2.0
+    monkeypatch.setenv("HETU_PREEMPT_GRACE_S", "60")
+    assert recovery.JobCheckpointer(jd).grace_timeout() == 55.0
+    # an explicit barrier_timeout below the grace bound wins
+    assert recovery.JobCheckpointer(
+        jd, barrier_timeout=7.5, grace_s=60).grace_timeout() == 7.5
+
+    # save_preempt threads the bound into take_job_snapshot; a cadence
+    # save keeps the 120s default
+    seen = []
+
+    def fake_take(ex, jobdir, *, on_phase=None, timeout=120.0):
+        seen.append(timeout)
+        return {"epoch": 1}
+
+    monkeypatch.setattr(recovery, "take_job_snapshot", fake_take)
+    ck = recovery.JobCheckpointer(jd, grace_s=30)
+    ck.save_preempt(None, 5)
+    ck.save(None, 6)
+    assert seen == [25.0, 120.0]
 
 
 # ---------------------------------------------------------------------------
