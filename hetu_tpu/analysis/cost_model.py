@@ -279,6 +279,33 @@ def load_calibration(path: str) -> Calibration:
         cal.step_ms = means.get("step_ms")
         cal.legs_ms = {k: float(v)
                        for k, v in _prof.cp_legs(means).items()}
+    # live hetuwatch stream (docs/OBSERVABILITY.md pillar 6): a watched
+    # run's kind:"watch" rows carry per-family EWMA residuals and measured
+    # legs continuously — calibration no longer needs a dedicated offline
+    # run. The last (most-converged) row wins; rows from a stale elastic
+    # era abstain and carry no residuals, so they contribute nothing.
+    watch_rows = [r for r in records
+                  if r.get("kind") == "watch" and "abstain" not in r]
+    if watch_rows:
+        last = watch_rows[-1]
+        fams = last.get("families")
+        if isinstance(fams, dict):
+            for fam, resid in fams.items():
+                if isinstance(resid, (int, float)) and resid > 0 \
+                        and math.isfinite(resid):
+                    cal.family_residual.setdefault(fam, float(resid))
+        if not cal.legs_ms:
+            # no step records in the dir (e.g. a pruned watch-only
+            # stream): the watch rows themselves supply the legs
+            legs_sum: Dict[str, float] = {}
+            for r in watch_rows:
+                for leg, v in (r.get("legs") or {}).items():
+                    legs_sum[leg] = legs_sum.get(leg, 0.0) + float(v)
+            cal.legs_ms = {k: v / len(watch_rows)
+                           for k, v in legs_sum.items()}
+            cal.step_ms = sum(float(r.get("step_ms", 0.0))
+                              for r in watch_rows) / len(watch_rows)
+    # explicit roofline docs override the watch stream's leg-level prior
     for p in sorted(glob.glob(os.path.join(path, "roofline*.json"))):
         try:
             with open(p) as f:

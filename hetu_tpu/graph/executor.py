@@ -102,7 +102,7 @@ class HetuConfig:
                  telemetry=None, introspect=None, comm_quant=None,
                  comm_quant_block=None, comm_quant_min_size=None,
                  comm_quant_error_feedback=None, comm_quant_force=(),
-                 kernels=None, plan=None, **kwargs):
+                 kernels=None, plan=None, watch=None, slo=None, **kwargs):
         self.eval_node_list = eval_node_list
         self.ctx = ctx
         self.seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
@@ -147,6 +147,20 @@ class HetuConfig:
         # trips. Env default: HETU_INTROSPECT (+ HETU_INTROSPECT_EVERY).
         from ..telemetry.scope import resolve_introspect
         self.introspect = resolve_introspect(introspect)
+        # hetuwatch (docs/OBSERVABILITY.md pillar 6): runtime plan-
+        # divergence sentinel. 0 = off (default, zero per-step watch work —
+        # one attribute check, same contract as telemetry/introspect), N =
+        # judge the measured critical-path legs against the adopted plan's
+        # prediction every N steps, export residual gauges + kind:"watch"
+        # JSONL, and latch plan_divergence / SLO-breach events. Env
+        # default: HETU_WATCH (+ HETU_WATCH_EVERY). SLO budgets come from
+        # slo= / HETU_SLO_SPEC (e.g. "step_ms<25,ps_pull_frac<0.3") and
+        # are validated here so a bad spec fails at build, not mid-run.
+        from ..telemetry.watch import parse_slo_spec, resolve_watch
+        self.watch = resolve_watch(watch)
+        self.slo = slo if slo is not None \
+            else os.environ.get("HETU_SLO_SPEC", "")
+        parse_slo_spec(self.slo)
         # hetuq (docs/COMM_QUANT.md): quantized communication policy. "off"
         # (default) leaves every comm path bit-identical to pre-hetuq
         # behavior; "int8"/"fp8" compresses the DP AllReduce broadcast half
@@ -556,6 +570,7 @@ class SubExecutor:
             "HETU_TELEMETRY_PS_EVERY", "20")))
         self.last_phases: Optional[dict] = None
         self._tel_cp_cache: dict = {}   # hetutrail critical-path gauges
+        self._tel_watch_cache: dict = {}   # hetuwatch residual gauges
 
         # -- PS bookkeeping (comm_mode PS/Hybrid) --------------------------
         ps = executor.ps_runtime
@@ -973,6 +988,14 @@ class SubExecutor:
         _trail_mod.export_critical_path(
             tel.metrics, _trail_mod.step_legs(phases),
             cache=self._tel_cp_cache)
+        # hetuwatch (pillar 6): judge this step against the adopted plan's
+        # stamped prediction on the watch cadence. None when unarmed — the
+        # only cost the default run pays is this attribute check.
+        # compile steps are excluded (the step_phase_means convention):
+        # trace+compile wall time is warm-up, not plan divergence
+        pw = ex.plan_watch
+        if pw is not None and not compiled_now and step % pw.every == 0:
+            self._watch_observe(tel, ex, pw, step, step_ms, phases)
         if compiled_now:
             tm["compiles"].inc()
             # recompile churn counts distinct SHAPE signatures, not the
@@ -1021,6 +1044,81 @@ class SubExecutor:
         if ps is not None and step % self._tel_ps_every == 0:
             for row in ps.telemetry_stats():
                 tel.record(**row)
+
+    # -- hetuwatch (docs/OBSERVABILITY.md pillar 6) -------------------------
+    def _watch_observe(self, tel, ex, pw, step, step_ms, phases):
+        """One cadence observation of the plan-divergence sentinel: fold
+        this step's measured legs into the residual windows, export the
+        residual/divergence gauges, stream the kind:"watch" JSONL row
+        (what ``hetulint --plan --calibrate`` and ``hetuprof --gate`` read
+        back), and route any latched events through the resilience bus.
+        Runs on the watch cadence only; never raises — the sentinel must
+        not take the step down with it."""
+        from ..resilience import _flight_flush, _tel_event
+        from ..telemetry import trail as _trail_mod
+        from ..telemetry import watch as _watch_mod
+        try:
+            if pw.families is None:
+                # op-family -> leg identities (the roofline's op_family
+                # naming): every traced family executes inside dispatch =
+                # the compute leg; PS-staged pulls and gradient pushes own
+                # the boundary legs. Built once, on the first observation.
+                from ..telemetry.profiler import op_family
+                fams = {}
+                pull = {id(n) for n in self.ps_staged_ops}
+                push = {id(n) for n in self.ps_comm_ops}
+                for n in self.topo:
+                    if not n.inputs:   # placeholders aren't a family
+                        continue
+                    leg = ("ps_pull" if id(n) in pull
+                           else "ps_push" if id(n) in push else "compute")
+                    fams.setdefault(op_family(n.name), leg)
+                pw.families = fams
+            wv = getattr(getattr(ex, "elastic", None), "world_version",
+                         None)
+            row, events = pw.observe(step, phases=phases, step_ms=step_ms,
+                                     world_version=wv)
+            _watch_mod.export_watch(tel.metrics, pw._ewma,
+                                    row.get("divergence"),
+                                    cache=self._tel_watch_cache)
+            tel.record("watch", **row)
+            for e in events:
+                name = e.pop("name")
+                if name == "plan_divergence":
+                    # name the blocking server+param via hetutrail's span
+                    # join (rare-event path; requires HETU_TRAIL_DIR).
+                    # This step's own spans may still be in the native
+                    # ring, so fall back one step — the breach is K
+                    # windows old by the time the latch fires.
+                    trail_dir = _trail_mod.armed()
+                    if trail_dir and e.get("leg", "").startswith("ps_"):
+                        loaded = _trail_mod.load_dir(trail_dir)
+                        joined, _rate = _trail_mod.join_spans(
+                            loaded["client"], loaded["server"])
+                        for s in (int(step), int(step) - 1):
+                            by_server, by_tensor = \
+                                _trail_mod._ps_attribution(joined, s,
+                                                           tel.rank)
+                            if by_server:
+                                e["server"] = max(by_server,
+                                                  key=by_server.get)
+                                if by_tensor:
+                                    e["param"] = max(by_tensor,
+                                                     key=by_tensor.get)
+                                break
+                    rec = _watch_mod.recommend(pw.plan, e.get("leg", ""),
+                                               e.get("ratio", 0.0))
+                    e["recommendation"] = rec["message"]
+                    # the bounded plan delta as the suppressible finding
+                    # shape hetulint emits (advisory — never actuated here)
+                    tel.record("finding", **rec)
+                _tel_event(name, sub=self.name, **e)
+                if name == "slo_breach":
+                    # the flight ring holds the steps AROUND the breach —
+                    # flush it while they are still in the window
+                    _flight_flush(f"slo_breach:{e.get('slo')}")
+        except Exception:  # noqa: BLE001 — sentinel must never kill a step
+            pass
 
     # -- hetuscope helpers --------------------------------------------------
     def _default_poison_scope(self) -> Optional[str]:
@@ -1616,6 +1714,42 @@ class Executor:
                                              "hetu_telemetry"))
             self.introspector = _scope.Introspector(config.introspect,
                                                     scope_dir)
+
+        # -- hetuwatch: plan stamp + divergence sentinel (pillar 6) ---------
+        # The adopted plan's per-leg prediction is stamped into telemetry
+        # unconditionally (one kind:"plan" record — the judge's denominator
+        # and the run's layout provenance, which heturun's run_summary and
+        # hetulint --calibrate both read back). The live sentinel arms only
+        # when the watch cadence is set AND there is something to judge: a
+        # plan to diverge from, or SLO budgets to enforce. Off, plan_watch
+        # is None and the step-boundary hook is one attribute check.
+        self.plan_watch = None
+        if self.telemetry is not None:
+            from ..telemetry import watch as _watch_mod
+            plan_dict = None
+            if self.plan is not None:
+                plan_dict = self.plan.as_dict()
+                self.telemetry.record(
+                    "plan", **_watch_mod.stamp_fields(plan_dict))
+            if config.watch and (plan_dict is not None or config.slo):
+                self.plan_watch = _watch_mod.PlanWatch(
+                    predicted=(_watch_mod.predicted_legs(
+                        plan_dict.get("breakdown") or {})
+                        if plan_dict is not None else None),
+                    predicted_step_ms=(plan_dict or {}).get(
+                        "predicted_step_ms"),
+                    every=config.watch,
+                    window=int(os.environ.get(
+                        "HETU_WATCH_WINDOW",
+                        str(_watch_mod.DEFAULT_WINDOW))),
+                    k=int(os.environ.get("HETU_WATCH_K",
+                                         str(_watch_mod.DEFAULT_K))),
+                    ratio=float(os.environ.get(
+                        "HETU_WATCH_RATIO", str(_watch_mod.DEFAULT_RATIO))),
+                    min_ms=float(os.environ.get(
+                        "HETU_WATCH_MIN_MS",
+                        str(_watch_mod.DEFAULT_MIN_MS))),
+                    slo=config.slo, plan=plan_dict)
 
         full_topo = find_topo_sort(all_nodes)
         # any variable read through an embedding lookup is a sparse embedding

@@ -44,6 +44,7 @@ def _scan_rank_jsonl(tel_dir):
     final_steps = {}
     resizes = []
     world_versions = set()
+    plan = None
     paths = sorted(glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl"))
                    + glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl.1")))
     for path in paths:
@@ -78,8 +79,18 @@ def _scan_rank_jsonl(tel_dir):
                     resizes.append(ev)
                     if rec.get("world_version") is not None:
                         world_versions.add(int(rec["world_version"]))
+                elif rec.get("kind") == "plan" and plan is None:
+                    # the hetuwatch plan stamp (docs/OBSERVABILITY.md
+                    # pillar 6): the adopted layout, per-param comm
+                    # decisions and predicted step — rank 0 stamps first;
+                    # every rank adopts the same plan, so first wins
+                    plan = {k: rec.get(k) for k in
+                            ("mesh", "comm_mode", "comm_quant", "zero1",
+                             "remat", "predicted_step_ms",
+                             "predicted_legs", "params")
+                            if rec.get(k) is not None}
     resizes.sort(key=lambda e: e.get("ts", 0))
-    return final_steps, resizes, sorted(world_versions)
+    return final_steps, resizes, sorted(world_versions), plan
 
 
 def _write_telemetry_summary(rc, preempted, num_workers):
@@ -92,7 +103,7 @@ def _write_telemetry_summary(rc, preempted, num_workers):
         return
     import glob
     import json
-    final_steps, resizes, world_versions = _scan_rank_jsonl(_tel_dir)
+    final_steps, resizes, world_versions, plan = _scan_rank_jsonl(_tel_dir)
     summary = {
         "workers": num_workers,
         "exit_code": rc,
@@ -106,6 +117,8 @@ def _write_telemetry_summary(rc, preempted, num_workers):
     if resizes:
         summary["resizes"] = resizes
         summary["world_versions"] = world_versions
+    if plan:
+        summary["plan"] = plan
     try:
         with open(os.path.join(_tel_dir, "run_summary.json"), "w") as f:
             json.dump(summary, f, indent=1)

@@ -527,6 +527,43 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
         if stragglers:
             line += f"  stragglers {int(stragglers)}"
         lines.append(line)
+    # hetuwatch plan-divergence sentinel (docs/OBSERVABILITY.md pillar 6):
+    # per-leg measured/predicted residual EWMAs + the worst-leg divergence
+    # gauge (1.0 = on plan) from the latest-reporting watched rank, plus
+    # any latched divergence / SLO-breach event counts. Absent (no line)
+    # when no rank armed the watch.
+    w_rank = None
+    for rk in sorted(state["ranks"].values(),
+                     key=lambda r: r.get("last_ts") or 0):
+        if any(k.startswith("hetu_plan_residual") for k in rk["metrics"]):
+            w_rank = rk
+    if w_rank is not None:
+        m = w_rank["metrics"]
+        resid = {child.split("=", 1)[1]: _defloat(v) or 0.0
+                 for child, v in _metric_children(
+                     m, "hetu_plan_residual", "") if "=" in child}
+        parts = [f"{leg} {resid[leg]:.2f}x" for leg in
+                 ("feed", "ps_pull", "compute", "ps_push", "poststep")
+                 if leg in resid]
+        line = "watch: residual " + " | ".join(parts)
+        div = _defloat(m.get("hetu_plan_divergence"))
+        if div is not None:
+            line += f"  divergence {div:.2f}"
+            if div > 1.5:
+                line += " DIVERGED"
+        div_evs = slo_evs = 0.0
+        for rk in state["ranks"].values():
+            for child, v in _metric_children(
+                    rk["metrics"], "hetu_events_total", ""):
+                if child == "event=plan_divergence":
+                    div_evs += _defloat(v) or 0.0
+                elif child == "event=slo_breach":
+                    slo_evs += _defloat(v) or 0.0
+        if div_evs:
+            line += f"  divergence events {int(div_evs)}"
+        if slo_evs:
+            line += f"  slo breaches {int(slo_evs)}"
+        lines.append(line)
     # hetuchaos transport hardening (docs/FAULT_TOLERANCE.md "Chaos
     # testing & transport hardening"): retry/timeout/CRC health summed
     # across ranks, plus any injected-fault count when a chaos schedule

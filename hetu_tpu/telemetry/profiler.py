@@ -494,6 +494,7 @@ def step_breakdown(phase_means: dict, attribution=None) -> dict:
 
 
 _TRAIL_MOD = None
+_WATCH_MOD = None
 
 
 def _trail_mod():
@@ -875,17 +876,44 @@ def format_roofline(rows: List[RooflineRow],
 # pillar 3 — the perf-regression gate
 # ---------------------------------------------------------------------------
 
+def _watch_mod():
+    """The hetuwatch module, loadable BOTH ways this file is (the
+    ``_trail_mod`` pattern) — watch.py is stdlib-only."""
+    global _WATCH_MOD
+    if _WATCH_MOD is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "watch.py")
+        spec = importlib.util.spec_from_file_location("_hetuprof_watch",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hetuprof_watch"] = mod
+        spec.loader.exec_module(mod)
+        _WATCH_MOD = mod
+    return _WATCH_MOD
+
+
 def load_summary(path: str) -> Tuple[Dict[str, dict], dict]:
     """Normalize any of the bench artifacts into ``(cells, meta)``:
 
     - the bench final line (``{"metric", ..., "detail": {cell: {...}}}``),
     - a driver ``BENCH_rNN.json`` wrapper (``{"rc", "parsed": <line>}``),
     - a ``BENCH_PARTIAL.json`` ledger (``{"cells": {k: {"result": ...}}}``),
-    - or a bare ``{cell: {...}}`` mapping.
+    - a bare ``{cell: {...}}`` mapping,
+    - or a telemetry DIRECTORY carrying a live hetuwatch residual stream
+      (``kind:"watch"`` rows -> a ``plan_watch`` cell whose ``divergence``
+      / ``residual_*`` metrics gate lower-is-better — CI fails a PR that
+      regresses plan fidelity).
 
     ``meta['incomplete']`` is True when the artifact itself says the run
     did not finish (rc != 0, ``error``/``incomplete_cells`` markers, or a
     null ``parsed``)."""
+    if os.path.isdir(path):
+        cells = _watch_mod().summary_cells(path)
+        if not cells:
+            return {}, {"incomplete": True,
+                        "why": f"no hetuwatch rows under {path}"}
+        return cells, {"incomplete": False, "why": None}
     with open(path) as f:
         data = json.load(f)
     return normalize_summary(data)
@@ -929,14 +957,21 @@ def normalize_summary(data) -> Tuple[Dict[str, dict], dict]:
 
 _HIGHER_HINTS = ("per_sec", "speedup", "samples_per", "tokens_per")
 _LOWER_SUFFIXES = ("_ms", "_mib", "_bytes", "_us", "_s")
+# hetuwatch plan-fidelity metrics: a residual ratio of 1.0 is on-plan and
+# anything above is drift, so lower always wins (event COUNTS stay
+# ungated — an extra recovered event is not a regression)
+_LOWER_HINTS = ("residual", "divergence")
 
 
 def metric_direction(key: str) -> Optional[int]:
     """+1 higher-is-better, -1 lower-is-better, None not gated."""
     leaf = key.rsplit(".", 1)[-1]
+    if leaf.endswith("_events") or leaf.endswith("_rows"):
+        return None
     if leaf.startswith("mfu") or any(h in leaf for h in _HIGHER_HINTS):
         return 1
-    if leaf.startswith("ms_") or leaf.endswith(_LOWER_SUFFIXES):
+    if leaf.startswith("ms_") or leaf.endswith(_LOWER_SUFFIXES) \
+            or any(h in leaf for h in _LOWER_HINTS):
         return -1
     return None
 
@@ -1169,7 +1204,10 @@ def main(argv=None) -> int:
                          "exit 0 clean / 1 regressed / 2 incomplete run / "
                          "3 unusable baseline")
     ap.add_argument("--current", metavar="SUMMARY",
-                    help="current summary for --gate")
+                    help="current summary for --gate: a bench artifact, "
+                         "or a telemetry dir carrying a hetuwatch "
+                         "residual stream (gates plan fidelity — "
+                         "hetu_plan_divergence / worst-leg residual)")
     ap.add_argument("--tolerance", type=float, default=10.0, metavar="PCT",
                     help="gate tolerance percent (default 10)")
     ap.add_argument("--check", action="store_true",

@@ -1,0 +1,868 @@
+"""hetuwatch — runtime plan-divergence sentinel, live residual streaming,
+and SLO watch (observability pillar 6, docs/OBSERVABILITY.md).
+
+hetuplan chooses a layout once at build time; hetutrail measures who
+blocked whom but never compares the measurement against what the planner
+PROMISED. This module is the runtime judge between them:
+
+- **Prediction stamping.** When a :class:`~hetu_tpu.analysis.planner.Plan`
+  is adopted, the executor writes one ``kind:"plan"`` JSONL record
+  (:func:`stamp_fields`): the per-leg predicted step decomposition in
+  hetutrail's leg space (:func:`predicted_legs`), the per-param decisions
+  with their rationale, and the cost-model inputs (calibration source +
+  breakdown) — so every later step can be judged against the promise.
+- **Live residual stream.** :class:`PlanWatch` joins each step's measured
+  critical-path legs (``trail.step_legs``) against the stamped prediction,
+  maintaining an EWMA and a windowed mean of the measured/predicted ratio
+  per leg (and per op-family, mapped onto the leg each family executes
+  in — the ``profiler.roofline_rows`` cp assignment). The executor exports
+  ``hetu_plan_residual{leg=…}`` / ``hetu_plan_divergence`` gauges
+  (:func:`export_watch`) and ``kind:"watch"`` JSONL rows that
+  ``hetulint --plan --calibrate TELEMETRY_DIR`` consumes directly
+  (cost_model.load_calibration) — calibration no longer needs a dedicated
+  offline run.
+- **Divergence detection + SLO watch.** A K-consecutive detector with
+  latched hysteresis (:class:`_Latch` — fire once, stay silent while the
+  condition persists, re-arm only after K consecutive recoveries below a
+  LOWER threshold) turns sustained residuals into one ``plan_divergence``
+  event through the resilience event bus, naming the diverging leg and —
+  via hetutrail's span join — the blocking server and param, plus the
+  bounded plan delta hetuplan would now choose (:func:`recommend`;
+  advisory only, rendered as the same suppressible finding shape hetulint
+  emits). Declarative SLOs (``HETU_SLO_SPEC``, e.g.
+  ``step_ms<25,ps_pull_frac<0.3``) ride the same latch; breaches emit
+  ``slo_breach`` events and flush the hetuscope flight ring.
+
+Activation mirrors hetuscope: ``HETU_WATCH`` (or ``HetuConfig(watch=…)``)
+resolves to a step cadence via :func:`resolve_watch`, 0 = off. Off — the
+default — the executor holds ``plan_watch = None`` and every step pays
+exactly one attribute check, nothing else (asserted in tests). Everything
+here is stdlib-only so ``bin/hetuwatch`` runs jax-free on a login node or
+in CI.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from collections import deque
+from typing import Optional
+
+DEFAULT_CADENCE = 10          # steps between residual observations
+DEFAULT_WINDOW = 8            # residual-window depth (observations)
+DEFAULT_K = 3                 # consecutive windows to fire / recover
+DEFAULT_RATIO = 1.5           # measured/predicted breach threshold
+DEFAULT_MIN_MS = 1.0          # absolute excess floor (noise guard)
+DEFAULT_ALPHA = 0.25          # EWMA smoothing
+# a leg the plan prices at ~0 ms still gets a denominator: measured time
+# on a "free" leg is exactly the divergence worth flagging, but µs jitter
+# must not explode the ratio
+PRED_FLOOR_MS = 0.25
+
+_OFFISH = ("", "0", "off", "false", "no", "none")
+_ONISH = ("1", "on", "true", "yes")
+
+# mirrors trail.LEGS (self_check pins them equal — one definition of the
+# blocking chain, re-stated here so the hot helpers never need the import)
+LEGS = ("feed", "ps_pull", "compute", "ps_push", "poststep")
+
+# event names this module owns on the resilience bus
+WATCH_EVENTS = ("plan_divergence", "plan_divergence_recovered",
+                "slo_breach", "slo_recovered", "watch_abstain")
+
+_TRAIL = None
+
+
+def _trail():
+    """The hetutrail module, loadable BOTH ways this file is: as the
+    package module and by file path (bin/hetuwatch — trail.py is
+    stdlib-only, so file-path loading it is always safe)."""
+    global _TRAIL
+    if _TRAIL is None:
+        try:
+            from . import trail as mod          # package context
+        except ImportError:
+            import importlib.util
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "trail.py")
+            spec = importlib.util.spec_from_file_location("_hetuwatch_trail",
+                                                          path)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules["_hetuwatch_trail"] = mod
+            spec.loader.exec_module(mod)
+        _TRAIL = mod
+    return _TRAIL
+
+
+# ---------------------------------------------------------------------------
+# arming + SLO grammar
+# ---------------------------------------------------------------------------
+
+def resolve_watch(value=None) -> int:
+    """One spelling of the arming resolution (the ``resolve_introspect``
+    contract): returns the observation cadence in steps, 0 = off.
+    ``True``/``"on"``/``"1"`` arm at :data:`DEFAULT_CADENCE` (overridable
+    via ``HETU_WATCH_EVERY``); an integer >= 1 is an explicit cadence;
+    ``None`` falls back to the ``HETU_WATCH`` env var."""
+    if value is None:
+        value = os.environ.get("HETU_WATCH", "")
+    if isinstance(value, bool):
+        value = "on" if value else "off"
+    if isinstance(value, (int, float)):
+        n = int(value)
+        if n < 0:
+            raise ValueError(f"watch cadence must be >= 0, got {n}")
+        return n
+    value = str(value).strip().lower()
+    if value in _OFFISH:
+        return 0
+    if value in _ONISH:
+        return max(1, int(os.environ.get("HETU_WATCH_EVERY",
+                                         str(DEFAULT_CADENCE))))
+    n = int(value)
+    if n < 0:
+        raise ValueError(f"watch cadence must be >= 0, got {n}")
+    return max(1, n)
+
+
+_SLO_METRICS = ("step_ms",) + tuple(f"{leg}_ms" for leg in LEGS) \
+    + tuple(f"{leg}_frac" for leg in LEGS)
+_SLO_OPS = ("<=", ">=", "<", ">")   # two-char ops first for the scan
+
+
+def parse_slo_spec(spec: str) -> list:
+    """``HETU_SLO_SPEC`` grammar: comma-separated ``METRIC OP LIMIT``
+    budgets, e.g. ``step_ms<25,ps_pull_frac<0.3``. Metrics: ``step_ms``,
+    ``<leg>_ms``, ``<leg>_frac`` (leg share of the blocking chain). A
+    malformed spec raises — a silently ignored SLO is worse than none."""
+    rules = []
+    for ent in str(spec or "").split(","):
+        ent = ent.strip()
+        if not ent:
+            continue
+        op = next((o for o in _SLO_OPS if o in ent), None)
+        if op is None:
+            raise ValueError(f"SLO entry {ent!r}: no comparison operator "
+                             f"(use one of {', '.join(_SLO_OPS)})")
+        metric, _, limit = ent.partition(op)
+        metric = metric.strip()
+        if metric not in _SLO_METRICS:
+            raise ValueError(f"SLO entry {ent!r}: unknown metric "
+                             f"{metric!r} (know {', '.join(_SLO_METRICS)})")
+        try:
+            lim = float(limit)
+        except ValueError:
+            raise ValueError(f"SLO entry {ent!r}: limit {limit!r} is not "
+                             "a number") from None
+        rules.append({"spec": ent, "metric": metric, "op": op, "limit": lim})
+    return rules
+
+
+def _violates(value: float, rule: dict) -> bool:
+    op, lim = rule["op"], rule["limit"]
+    if op == "<":
+        return not value < lim
+    if op == "<=":
+        return not value <= lim
+    if op == ">":
+        return not value > lim
+    return not value >= lim
+
+
+# ---------------------------------------------------------------------------
+# prediction stamping
+# ---------------------------------------------------------------------------
+
+def predicted_legs(breakdown: dict, pull_frac: float = 0.5,
+                   feed_frac: float = 0.5) -> dict:
+    """The planner's step breakdown mapped into hetutrail's leg space.
+
+    ``allreduce_ms`` folds into ``compute`` (in-program collectives run
+    inside the dispatched XLA program — the same convention as
+    ``trail.step_legs``); ``ps_ms`` covers both boundary waits and splits
+    pull/push evenly absent a finer model; ``host_ms`` splits across
+    feed/poststep. The splits are priors the residual stream corrects —
+    what matters is that every measured leg has a judged denominator."""
+    b = {k: float(v or 0.0) for k, v in (breakdown or {}).items()}
+    ps = b.get("ps_ms", 0.0)
+    host = b.get("host_ms", 0.0)
+    return {
+        "feed": host * feed_frac,
+        "ps_pull": ps * pull_frac,
+        "compute": b.get("compute_ms", 0.0) + b.get("allreduce_ms", 0.0),
+        "ps_push": ps * (1.0 - pull_frac),
+        "poststep": host * (1.0 - feed_frac),
+    }
+
+
+def stamp_fields(plan: dict, world_version: int = 0) -> dict:
+    """Fields of the ``kind:"plan"`` JSONL record from ``Plan.as_dict()``
+    output: the adopted layout, per-leg prediction, per-decision rationale
+    and the cost-model inputs. ``candidates`` are deliberately excluded
+    (bulky; ``hetulint --plan --json`` renders them offline)."""
+    breakdown = plan.get("breakdown") or {}
+    return {
+        "mesh": plan.get("mesh"),
+        "comm_mode": plan.get("comm_mode"),
+        "comm_quant": plan.get("comm_quant"),
+        "zero1": plan.get("zero1"),
+        "remat": plan.get("remat"),
+        "predicted_step_ms": plan.get("predicted_step_ms"),
+        "breakdown": breakdown,
+        "predicted_legs": {k: round(v, 4)
+                           for k, v in predicted_legs(breakdown).items()},
+        "params": (plan.get("params") or [])[:64],
+        "calibration": plan.get("calibration"),
+        "world_version": int(world_version),
+    }
+
+
+# ---------------------------------------------------------------------------
+# detection: K-consecutive + latched hysteresis
+# ---------------------------------------------------------------------------
+
+class _Latch:
+    """K-consecutive breach → ONE "fired" signal, then latched: silence
+    while the condition persists (a flapping signal can never oscillate
+    the detector — the PR 13 StragglerDetector re-fires every K, which is
+    right for a ScalePolicy but wrong for an advisory event a human
+    reads). K consecutive "clean" observations while latched → one
+    "recovered" signal and re-arm. "dead"-zone observations (between the
+    breach and re-arm thresholds) reset BOTH streaks without firing."""
+
+    def __init__(self, k: int = DEFAULT_K):
+        self.k = max(1, int(k))
+        self.latched = False
+        self._breach = 0
+        self._clean = 0
+
+    def observe(self, state: str) -> Optional[str]:
+        if state == "breach":
+            self._clean = 0
+            if self.latched:
+                return None
+            self._breach += 1
+            if self._breach >= self.k:
+                self._breach = 0
+                self.latched = True
+                return "fired"
+            return None
+        self._breach = 0
+        if state == "clean" and self.latched:
+            self._clean += 1
+            if self._clean >= self.k:
+                self._clean = 0
+                self.latched = False
+                return "recovered"
+        elif state != "clean":
+            self._clean = 0
+        return None
+
+    def reset(self) -> None:
+        self.latched = False
+        self._breach = self._clean = 0
+
+
+class PlanWatch:
+    """The runtime judge: per-leg residual stream + divergence/SLO latch.
+
+    ``predicted`` is the stamped per-leg prediction (``None`` for an
+    SLO-only watch — no plan, nothing to diverge from); ``families`` maps
+    op-family names to the leg each executes in (``profiler.roofline_rows``
+    identities), populated lazily by the executor. ``observe`` is the ONLY
+    hot entry point and does dict arithmetic over five legs — no I/O, no
+    imports; the caller owns gauge export and JSONL emission.
+
+    Elastic abstain: an observation carrying a new ``world_version``
+    resets every window and streak and contributes nothing — stale-era
+    legs are never compared against the new world's prediction, and the
+    straddling step is dropped entirely."""
+
+    def __init__(self, predicted: Optional[dict] = None,
+                 predicted_step_ms: Optional[float] = None,
+                 every: int = DEFAULT_CADENCE, window: int = DEFAULT_WINDOW,
+                 k: int = DEFAULT_K, ratio: float = DEFAULT_RATIO,
+                 min_ms: float = DEFAULT_MIN_MS, alpha: float = DEFAULT_ALPHA,
+                 slo=None, world_version: int = 0,
+                 families: Optional[dict] = None, plan: Optional[dict] = None):
+        self.predicted = {leg: float(v) for leg, v in
+                          (predicted or {}).items() if v is not None}
+        self.predicted_step_ms = (float(predicted_step_ms)
+                                  if predicted_step_ms else None)
+        self.every = max(1, int(every))
+        self.window = max(1, int(window))
+        self.k = max(1, int(k))
+        self.ratio = float(ratio)
+        # re-arm threshold sits BELOW the breach threshold: recovery must
+        # clear a margin, so a signal hovering at the line stays latched
+        self.rearm = 1.0 + (self.ratio - 1.0) * 0.5
+        self.min_ms = float(min_ms)
+        self.alpha = float(alpha)
+        self.plan = plan or {}
+        self.families = families          # {family: leg} | None
+        self.slo = (parse_slo_spec(slo) if isinstance(slo, str)
+                    else list(slo or []))
+        self.world_version = int(world_version)
+        self._win = {leg: deque(maxlen=self.window) for leg in LEGS}
+        self._ewma: dict = {}
+        self._det = _Latch(self.k)
+        self._slo_latch = [_Latch(self.k) for _ in self.slo]
+        self.observations = 0
+        self.abstains = 0
+
+    def reset(self) -> None:
+        for d in self._win.values():
+            d.clear()
+        self._ewma.clear()
+        self._det.reset()
+        for latch in self._slo_latch:
+            latch.reset()
+
+    # ------------------------------------------------------------------
+    def observe(self, step: int, phases: Optional[dict] = None,
+                step_ms: Optional[float] = None,
+                world_version: Optional[int] = None,
+                legs: Optional[dict] = None):
+        """One cadence observation. Returns ``(row, events)``: ``row`` is
+        the ``kind:"watch"`` JSONL payload (or an abstain marker), and
+        ``events`` the resilience-bus events that latched this step."""
+        events: list = []
+        if world_version is not None \
+                and int(world_version) != self.world_version:
+            old = self.world_version
+            self.world_version = int(world_version)
+            self.reset()
+            self.abstains += 1
+            events.append({"name": "watch_abstain", "step": int(step),
+                           "from_world": old,
+                           "world_version": self.world_version})
+            return ({"step": int(step), "abstain": "world_version",
+                     "world_version": self.world_version}, events)
+        if legs is None:
+            legs = _trail().step_legs(phases or {})
+        if step_ms is None:
+            step_ms = sum(legs.values())
+        self.observations += 1
+
+        resid: dict = {}
+        win_ratio: dict = {}
+        win_excess: dict = {}
+        for leg in LEGS:
+            pred = self.predicted.get(leg)
+            if pred is None:
+                continue
+            m = float(legs.get(leg, 0.0))
+            r = m / max(pred, PRED_FLOOR_MS)
+            resid[leg] = r
+            prev = self._ewma.get(leg)
+            self._ewma[leg] = (r if prev is None
+                               else self.alpha * r
+                               + (1.0 - self.alpha) * prev)
+            d = self._win[leg]
+            d.append((r, m - pred))
+            win_ratio[leg] = sum(x for x, _ in d) / len(d)
+            win_excess[leg] = sum(x for _, x in d) / len(d)
+
+        worst = max(win_ratio, key=win_ratio.get) if win_ratio else None
+        divergence = (max(self._ewma.values()) if self._ewma else None)
+        if worst is not None:
+            wr = win_ratio[worst]
+            state = ("breach" if (wr > self.ratio
+                                  and win_excess[worst] >= self.min_ms)
+                     else "clean" if wr <= self.rearm else "dead")
+            sig = self._det.observe(state)
+            if sig == "fired":
+                pred = self.predicted[worst]
+                events.append({
+                    "name": "plan_divergence", "leg": worst,
+                    "ratio": round(wr, 3),
+                    "ewma": round(self._ewma[worst], 3),
+                    "predicted_ms": round(pred, 3),
+                    "measured_ms": round(win_excess[worst] + pred, 3),
+                    "windows": self.k, "step": int(step),
+                    "world_version": self.world_version})
+            elif sig == "recovered":
+                events.append({"name": "plan_divergence_recovered",
+                               "leg": worst, "ratio": round(wr, 3),
+                               "step": int(step),
+                               "world_version": self.world_version})
+
+        total = sum(legs.values())
+        slo_vals = {"step_ms": float(step_ms)}
+        for leg in LEGS:
+            m = float(legs.get(leg, 0.0))
+            slo_vals[f"{leg}_ms"] = m
+            slo_vals[f"{leg}_frac"] = (m / total) if total > 0 else 0.0
+        for rule, latch in zip(self.slo, self._slo_latch):
+            val = slo_vals.get(rule["metric"])
+            breach = val is not None and _violates(val, rule)
+            sig = latch.observe("breach" if breach else "clean")
+            if sig == "fired":
+                events.append({"name": "slo_breach", "slo": rule["spec"],
+                               "value": round(val, 3), "step": int(step),
+                               "world_version": self.world_version})
+            elif sig == "recovered":
+                events.append({"name": "slo_recovered", "slo": rule["spec"],
+                               "value": round(val, 3), "step": int(step),
+                               "world_version": self.world_version})
+
+        row = {"step": int(step), "step_ms": round(float(step_ms), 4),
+               "legs": {k: round(v, 4) for k, v in legs.items()},
+               "world_version": self.world_version}
+        if resid:
+            row["residual"] = {k: round(v, 4) for k, v in resid.items()}
+            row["ewma"] = {k: round(v, 4) for k, v in self._ewma.items()}
+            row["divergence"] = round(divergence, 4)
+            row["worst_leg"] = worst
+        if self.predicted_step_ms:
+            row["step_residual"] = round(
+                float(step_ms) / self.predicted_step_ms, 4)
+        if self.families and self._ewma:
+            row["families"] = {
+                fam: round(self._ewma[leg], 4)
+                for fam, leg in self.families.items() if leg in self._ewma}
+        return row, events
+
+
+# ---------------------------------------------------------------------------
+# bounded plan-delta recommendation (advisory — actuation is a later PR)
+# ---------------------------------------------------------------------------
+
+def recommend(plan: dict, leg: str, ratio: float) -> dict:
+    """The bounded delta hetuplan would now choose for a diverging leg —
+    comm-mode flip, comm_quant toggle, or PS server count; never a full
+    re-plan. Returned in the hetulint finding shape (suppressible id
+    ``watch-divergence``, warn severity) so every renderer treats it like
+    any other finding."""
+    params = plan.get("params") or []
+    ps_params = [p for p in params if p.get("mode") == "PS"]
+    dense_ps = [p for p in ps_params if not p.get("sparse")]
+    if leg in ("ps_pull", "ps_push"):
+        if ps_params and (plan.get("comm_quant") or "off") == "off":
+            msg = (f"PS {leg} leg at {ratio:.2f}x its prediction — bounded "
+                   "delta: arm comm_quant=int8 (HETU_COMM_QUANT=int8); the "
+                   "planner's wire algebra cuts PS bytes ~4x before any "
+                   "re-layout")
+        elif dense_ps:
+            names = ", ".join(p.get("param", "?") for p in dense_ps[:3])
+            msg = (f"PS {leg} leg at {ratio:.2f}x its prediction with "
+                   f"dense PS param(s) ({names}) — bounded delta: flip the "
+                   "dense decisions PS->AllReduce (in-program collective "
+                   "beats a slow boundary RPC)")
+        else:
+            msg = (f"PS {leg} leg at {ratio:.2f}x its prediction — bounded "
+                   "delta: raise the PS server count (heturun SIGUSR2 grows "
+                   "one live; re-shards hot tables across more appliers)")
+    elif leg == "compute":
+        msg = (f"compute leg at {ratio:.2f}x its prediction — recalibrate "
+               "(hetulint --plan --calibrate TELEMETRY_DIR now reads this "
+               "watch stream) and re-evaluate the dp/tp split; if the gap "
+               "is HBM pressure, arm remat")
+    else:
+        msg = (f"host leg {leg} at {ratio:.2f}x its prediction — the plan "
+               "treats host time as layout-invariant; enable prefetch / "
+               "dataloader workers or move feed staging off the step path")
+    return {"lint": "watch-divergence", "severity": "warn", "message": msg}
+
+
+# ---------------------------------------------------------------------------
+# gauge export (executor hot path — the export_critical_path shape)
+# ---------------------------------------------------------------------------
+
+def export_watch(metrics, ewma: dict, divergence: Optional[float],
+                 cache: Optional[dict] = None) -> None:
+    """Set ``hetu_plan_residual{leg=…}`` and ``hetu_plan_divergence`` on a
+    live registry; ``cache`` avoids the labeled-gauge lookup per step."""
+    if cache is not None:
+        gauges = cache.get("watch_gauges")
+        if gauges is None:
+            gauges = cache["watch_gauges"] = {
+                leg: metrics.gauge("hetu_plan_residual", {"leg": leg})
+                for leg in LEGS}
+            cache["watch_div"] = metrics.gauge("hetu_plan_divergence")
+        div_g = cache["watch_div"]
+    else:
+        gauges = {leg: metrics.gauge("hetu_plan_residual", {"leg": leg})
+                  for leg in LEGS}
+        div_g = metrics.gauge("hetu_plan_divergence")
+    for leg, g in gauges.items():
+        if leg in ewma:
+            g.set(ewma[leg])
+    if divergence is not None:
+        div_g.set(divergence)
+
+
+# ---------------------------------------------------------------------------
+# offline: load / analyze / render a telemetry directory
+# ---------------------------------------------------------------------------
+
+def load_dir(dir_path: str) -> dict:
+    """Scan a telemetry directory's rank JSONL (including rotated ``.1``
+    backups) for the watch surface: the plan stamp, the watch rows, the
+    watch-owned events, and the declared run identity."""
+    plan = None
+    run_info = None
+    rows: list = []
+    events: list = []
+    paths = sorted(glob.glob(os.path.join(dir_path, "metrics-r*.jsonl"))
+                   + glob.glob(os.path.join(dir_path, "metrics-r*.jsonl.1")))
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                kind = rec.get("kind")
+                if kind == "plan":
+                    plan = rec
+                elif kind == "watch":
+                    rows.append(rec)
+                elif kind == "run_info":
+                    run_info = rec
+                elif kind == "event" and rec.get("name") in WATCH_EVENTS:
+                    events.append(rec)
+    rows.sort(key=lambda r: int(r.get("step", 0)))
+    events.sort(key=lambda e: int(e.get("step", 0)))
+    return {"dir": dir_path, "plan": plan, "run_info": run_info,
+            "watch": rows, "events": events}
+
+
+def analyze(dir_path: str) -> dict:
+    """Whole-run watch report: residual trajectory, divergence episodes
+    (fired → recovered pairs), SLO breaches, abstains, and the
+    recommended-vs-declared layout."""
+    loaded = load_dir(dir_path)
+    rows = [r for r in loaded["watch"] if "abstain" not in r]
+    abstains = [r for r in loaded["watch"] if "abstain" in r]
+    div_rows = [r for r in rows if r.get("divergence") is not None]
+    episodes: list = []
+    open_ep: Optional[dict] = None
+    for ev in loaded["events"]:
+        if ev["name"] == "plan_divergence":
+            open_ep = {"leg": ev.get("leg"), "fired_step": ev.get("step"),
+                       "ratio": ev.get("ratio"),
+                       "server": ev.get("server"),
+                       "param": ev.get("param"),
+                       "recommendation": ev.get("recommendation")}
+            episodes.append(open_ep)
+        elif ev["name"] == "plan_divergence_recovered" and open_ep \
+                and "recovered_step" not in open_ep:
+            open_ep["recovered_step"] = ev.get("step")
+    slo_breaches = [ev for ev in loaded["events"]
+                    if ev["name"] == "slo_breach"]
+    plan = loaded["plan"] or {}
+    run_info = loaded["run_info"] or {}
+    trajectory = [{"step": r["step"],
+                   "divergence": r.get("divergence"),
+                   "worst_leg": r.get("worst_leg"),
+                   "step_ms": r.get("step_ms")}
+                  for r in div_rows[-40:]]
+    return {
+        "dir": dir_path,
+        "plan": {k: plan.get(k) for k in
+                 ("mesh", "comm_mode", "comm_quant", "zero1", "remat",
+                  "predicted_step_ms", "predicted_legs")} if plan else None,
+        "declared_comm_mode": run_info.get("comm_mode"),
+        "rows": len(rows),
+        "abstains": len(abstains),
+        "trajectory": trajectory,
+        "divergence_final": (div_rows[-1].get("divergence")
+                             if div_rows else None),
+        "divergence_max": max((r["divergence"] for r in div_rows),
+                              default=None),
+        "episodes": episodes,
+        "slo_breaches": [{k: ev.get(k) for k in ("slo", "value", "step")}
+                         for ev in slo_breaches],
+        "events": len(loaded["events"]),
+    }
+
+
+def summary_cells(dir_path: str) -> dict:
+    """The watch stream as a hetuprof gate summary: ``{"plan_watch":
+    {metrics…}}``. ``divergence``/``residual_*`` gate lower-is-better
+    (``metric_direction`` knows the hints) so CI fails a PR that
+    regresses plan fidelity. Empty when the dir carries no watch rows."""
+    loaded = load_dir(dir_path)
+    rows = [r for r in loaded["watch"] if r.get("divergence") is not None]
+    if not rows:
+        return {}
+    tail = rows[-min(len(rows), 8):]
+    cell = {
+        "divergence": round(sum(r["divergence"] for r in tail)
+                            / len(tail), 4),
+        "worst_leg_residual": round(max(r["divergence"] for r in rows), 4),
+        "step_ms": round(sum(float(r.get("step_ms", 0.0)) for r in tail)
+                         / len(tail), 4),
+        "watch_rows": len(rows),
+        "divergence_events": sum(1 for e in loaded["events"]
+                                 if e["name"] == "plan_divergence"),
+        "slo_breach_events": sum(1 for e in loaded["events"]
+                                 if e["name"] == "slo_breach"),
+    }
+    last = tail[-1]
+    for leg, v in (last.get("ewma") or {}).items():
+        cell[f"residual_{leg}"] = round(float(v), 4)
+    return {"plan_watch": cell}
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"hetuwatch: {rep['dir']}"]
+    if rep["plan"]:
+        p = rep["plan"]
+        mesh = p.get("mesh") or {}
+        mesh_s = (f"dp{mesh.get('dp')}/tp{mesh.get('tp')}/pp{mesh.get('pp')}"
+                  if mesh else "none")
+        lines.append(
+            f"  plan: {mesh_s}, comm_mode={p.get('comm_mode') or 'none'}, "
+            f"comm_quant={p.get('comm_quant')}"
+            + (", zero1" if p.get("zero1") else "")
+            + (", remat" if p.get("remat") else "")
+            + f" — predicted step {p.get('predicted_step_ms')} ms")
+        if p.get("predicted_legs"):
+            lines.append("  predicted legs: " + "  ".join(
+                f"{k}={v:.2f}ms" for k, v in p["predicted_legs"].items()))
+        declared = rep.get("declared_comm_mode")
+        if declared and declared not in ("None", str(p.get("comm_mode"))):
+            lines.append(f"  declared comm_mode={declared} (differs from "
+                         "the plan — see hetulint plan-divergence)")
+    else:
+        lines.append("  no plan stamp (run without plan adoption, or "
+                     "telemetry off) — SLO-only watch")
+    lines.append(f"  watch rows: {rep['rows']}"
+                 + (f", abstains (elastic resets): {rep['abstains']}"
+                    if rep["abstains"] else ""))
+    if rep["divergence_final"] is not None:
+        lines.append(f"  divergence: final {rep['divergence_final']:.3f}, "
+                     f"max {rep['divergence_max']:.3f} "
+                     "(1.0 = on plan; worst-leg EWMA residual)")
+        traj = rep["trajectory"]
+        if traj:
+            lines.append("  trajectory (last %d): " % len(traj) + " ".join(
+                f"{t['step']}:{t['divergence']:.2f}" for t in traj[-10:]))
+    for ep in rep["episodes"]:
+        msg = (f"  DIVERGENCE leg {ep['leg']} @ step {ep['fired_step']}: "
+               f"{ep['ratio']}x predicted")
+        if ep.get("server") is not None:
+            msg += f" — server {ep['server']}"
+        if ep.get("param") is not None:
+            msg += f", param {ep['param']}"
+        msg += (f"; recovered @ step {ep['recovered_step']}"
+                if ep.get("recovered_step") is not None
+                else "; still diverged at end of stream")
+        lines.append(msg)
+        if ep.get("recommendation"):
+            lines.append(f"    recommended: {ep['recommendation']}")
+    for b in rep["slo_breaches"]:
+        lines.append(f"  SLO BREACH {b['slo']} @ step {b['step']}: "
+                     f"measured {b['value']}")
+    if not rep["episodes"] and not rep["slo_breaches"]:
+        lines.append("  no divergence episodes, no SLO breaches")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# --check: jax-free self-test (the CI smoke, like hetutrail --check)
+# ---------------------------------------------------------------------------
+
+def self_check(out=sys.stdout) -> int:
+    """Prove the whole pipeline synthetically: grammar, the clean twin
+    stays silent, a seeded ps_pull slowdown fires ONE latched event within
+    K windows naming the leg, flapping never oscillates, a world-version
+    flip abstains, SLO budgets latch, and the dir round-trip (stamp →
+    rows → report → gate cells) holds. Exit 0/1."""
+    try:
+        assert LEGS == _trail().LEGS, (LEGS, _trail().LEGS)
+        # grammar
+        assert resolve_watch("0") == 0 and resolve_watch("off") == 0
+        assert resolve_watch("7") == 7 and resolve_watch(True) >= 1
+        try:
+            resolve_watch("-3")
+            raise AssertionError("negative cadence accepted")
+        except ValueError:
+            pass
+        rules = parse_slo_spec("step_ms<25, ps_pull_frac<0.3")
+        assert [r["metric"] for r in rules] == ["step_ms", "ps_pull_frac"]
+        for bad in ("nope<1", "step_ms~25", "step_ms<abc"):
+            try:
+                parse_slo_spec(bad)
+                raise AssertionError(f"malformed SLO accepted: {bad}")
+            except ValueError:
+                pass
+        # prediction mapping conserves the step
+        bd = {"compute_ms": 10.0, "allreduce_ms": 2.0, "ps_ms": 6.0,
+              "host_ms": 2.0, "bubble_frac": 0.0}
+        pl = predicted_legs(bd)
+        assert abs(sum(pl.values()) - 20.0) < 1e-9, pl
+        assert pl["compute"] == 12.0 and pl["ps_pull"] == 3.0
+
+        pred = {"feed": 1.0, "ps_pull": 3.0, "compute": 12.0,
+                "ps_push": 3.0, "poststep": 1.0}
+
+        def phases(pull_ms=3.0, push_ms=3.0, dispatch_ms=12.0, jig=1.0):
+            return {"prestep_ms": (1.0 + pull_ms) * jig,
+                    "dispatch_ms": dispatch_ms * jig,
+                    "poststep_ms": (1.0 + push_ms) * jig,
+                    "ps_pull_ms": pull_ms * jig, "ps_push_ms": push_ms * jig}
+
+        # clean twin: 40 on-plan observations with +-6% deterministic
+        # jitter -> zero events
+        pw = PlanWatch(predicted=pred, predicted_step_ms=20.0, k=3)
+        fired = []
+        for s in range(40):
+            _, evs = pw.observe(s, phases(jig=1.06 if s % 2 else 0.94))
+            fired += evs
+        assert fired == [], f"clean twin fired: {fired}"
+
+        # seeded divergence: ps_pull 4x from step 40 -> ONE event within
+        # K observations naming ps_pull, then silence while it persists
+        for s in range(40, 60):
+            _, evs = pw.observe(s, phases(pull_ms=12.0))
+            fired += evs
+        names = [e["name"] for e in fired]
+        assert names.count("plan_divergence") == 1, fired
+        ev = next(e for e in fired if e["name"] == "plan_divergence")
+        assert ev["leg"] == "ps_pull" and ev["step"] <= 40 + 3 * 8, ev
+        # recovery -> one recovered event; re-breach -> fires again
+        for s in range(60, 80):
+            _, evs = pw.observe(s, phases())
+            fired += evs
+        assert [e["name"] for e in fired].count(
+            "plan_divergence_recovered") == 1, fired
+        for s in range(80, 95):
+            _, evs = pw.observe(s, phases(pull_ms=12.0))
+            fired += evs
+        assert [e["name"] for e in fired].count("plan_divergence") == 2
+
+        # flapping (alternating breach/clean) never fires: K-consecutive
+        pw2 = PlanWatch(predicted=pred, k=3, window=1)
+        flap = []
+        for s in range(60):
+            _, evs = pw2.observe(s, phases(pull_ms=12.0 if s % 2 else 3.0))
+            flap += evs
+        assert flap == [], f"flapping oscillated the detector: {flap}"
+
+        # world-version flip mid-streak resets the window: 2 breach
+        # observations, flip, then 2 more -> no event (streak restarted)
+        pw3 = PlanWatch(predicted=pred, k=3)
+        evs_all = []
+        for s in range(2):
+            _, evs = pw3.observe(s, phases(pull_ms=12.0))
+            evs_all += evs
+        row, evs = pw3.observe(2, phases(pull_ms=12.0), world_version=1)
+        assert row.get("abstain") == "world_version", row
+        assert [e["name"] for e in evs] == ["watch_abstain"], evs
+        for s in range(3, 5):
+            _, evs = pw3.observe(s, phases(pull_ms=12.0), world_version=1)
+            evs_all += evs
+        assert evs_all == [], f"stale-era streak survived the flip: "\
+            f"{evs_all}"
+        # ...and the fresh world fires after its own K windows
+        _, evs = pw3.observe(5, phases(pull_ms=12.0), world_version=1)
+        assert any(e["name"] == "plan_divergence" for e in evs), evs
+
+        # SLO latch: sustained breach fires once, flapping stays silent
+        pw4 = PlanWatch(slo="step_ms<18", k=3)
+        slo_evs = []
+        for s in range(10):
+            _, evs = pw4.observe(s, phases())   # 20 ms steps, budget 18
+            slo_evs += evs
+        assert [e["name"] for e in slo_evs] == ["slo_breach"], slo_evs
+        assert slo_evs[0]["slo"] == "step_ms<18"
+
+        # recommendation shapes
+        plan = {"comm_quant": "off",
+                "params": [{"param": "embed", "mode": "PS", "sparse": True}]}
+        rec = recommend(plan, "ps_pull", 4.0)
+        assert rec["lint"] == "watch-divergence" \
+            and "comm_quant" in rec["message"], rec
+        assert "AllReduce" in recommend(
+            {"comm_quant": "int8",
+             "params": [{"param": "w", "mode": "PS", "sparse": False}]},
+            "ps_pull", 2.0)["message"]
+
+        # dir round-trip: stamp + rows + events -> report + gate cells
+        with tempfile.TemporaryDirectory(prefix="hetuwatch_check_") as d:
+            with open(os.path.join(d, "metrics-r0.jsonl"), "w") as f:
+                f.write(json.dumps(
+                    {"kind": "plan", **stamp_fields(
+                        {"mesh": {"dp": 2, "tp": 1, "pp": 1},
+                         "comm_mode": "PS", "comm_quant": "off",
+                         "zero1": False, "remat": False,
+                         "predicted_step_ms": 20.0, "breakdown": bd,
+                         "params": plan["params"]})}) + "\n")
+                pw5 = PlanWatch(predicted=pred, predicted_step_ms=20.0)
+                for s in range(30):
+                    slow = s >= 10
+                    row, evs = pw5.observe(
+                        s, phases(pull_ms=12.0 if slow else 3.0))
+                    f.write(json.dumps({"kind": "watch", **row}) + "\n")
+                    for e in evs:
+                        f.write(json.dumps({"kind": "event", **e}) + "\n")
+            rep = analyze(d)
+            assert rep["plan"]["comm_mode"] == "PS", rep
+            assert rep["rows"] == 30 and rep["episodes"], rep
+            assert rep["episodes"][0]["leg"] == "ps_pull", rep
+            txt = format_report(rep)
+            assert "DIVERGENCE leg ps_pull" in txt, txt
+            cells = summary_cells(d)
+            cell = cells["plan_watch"]
+            assert cell["divergence_events"] == 1, cell
+            assert cell["worst_leg_residual"] > 2.0, cell
+            assert cell["residual_ps_pull"] > 1.5, cell
+        print("hetuwatch --check: stamp/residual/divergence/SLO/abstain "
+              "pipeline ok", file=out)
+        return 0
+    except AssertionError as e:
+        print(f"hetuwatch --check: FAIL: {e}", file=out)
+        return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetuwatch",
+        description="runtime plan-divergence sentinel: residual "
+                    "trajectory, divergence episodes, SLO breaches "
+                    "(docs/OBSERVABILITY.md pillar 6)")
+    ap.add_argument("dir", nargs="?",
+                    help="telemetry directory (HETU_TELEMETRY_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ap.add_argument("--gate-cells", action="store_true",
+                    help="emit the hetuprof gate summary cells for this "
+                         "watch stream (what `hetuprof --gate` reads when "
+                         "given a directory)")
+    ap.add_argument("--check", action="store_true",
+                    help="jax-free self-test of the stamp/residual/"
+                         "divergence/SLO pipeline, exit 0/1 (CI mode)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return self_check()
+    if not args.dir:
+        ap.error("a directory is required unless --check")
+    try:
+        if args.gate_cells:
+            print(json.dumps(summary_cells(args.dir), indent=1))
+            return 0
+        rep = analyze(args.dir)
+        print(json.dumps(rep, indent=1) if args.json
+              else format_report(rep))
+    except BrokenPipeError:
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

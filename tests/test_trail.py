@@ -96,7 +96,11 @@ def _executor_ps_slow_worker(client, rank, tmpdir):
     sup = Supervisor(fault_injector=FaultInjector("ps_slow@3:400"))
     ex.attach_supervisor(sup)
     rng = np.random.RandomState(0)
-    for _ in range(8):
+    # 12 steps, not the minimal 9: the one-shot delay consumes the
+    # server's NEXT apply after the boundary-3 arming, and a loaded box
+    # can slide that apply a step or two — the extra steps guarantee
+    # consumers remain
+    for _ in range(12):
         bidx = rng.randint(0, 40, (16, 4)).astype(np.float32)
         by = rng.randint(0, 2, (16, 1)).astype(np.float32)
         ex.run("train", feed_dict={idx: bidx, y_: by})
@@ -106,40 +110,59 @@ def _executor_ps_slow_worker(client, rank, tmpdir):
 
 def test_executor_ps_slow_critical_path(tmp_path, monkeypatch):
     monkeypatch.setenv("HETU_TEST_MODE", "1")
-    monkeypatch.setenv("HETU_TRAIL_DIR", str(tmp_path))
     monkeypatch.setenv("HETU_TRAIL_DRAIN_EVERY", "1")
-    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
     monkeypatch.delenv("HETU_TELEMETRY", raising=False)
-    run_cluster(_executor_ps_slow_worker, tmp_path, n_workers=1,
-                n_servers=2)
     from hetu_tpu.telemetry import trail
-    loaded = trail.load_dir(str(tmp_path))
+
+    def drive(d):
+        """One cluster run into dir ``d``; returns (loaded, entry, step)
+        with entry=None when no pull blocked >300 ms — usually step 4,
+        but the one-shot delay hits the server's NEXT apply, and a
+        loaded box can slide that apply (and the pull that queues behind
+        it) a step or two later, so scan a window instead of pinning."""
+        os.makedirs(d, exist_ok=True)
+        monkeypatch.setenv("HETU_TRAIL_DIR", str(d))
+        monkeypatch.setenv("HETU_TELEMETRY_DIR", str(d))
+        run_cluster(_executor_ps_slow_worker, d, n_workers=1,
+                    n_servers=2)
+        loaded = trail.load_dir(str(d))
+        for s in (4, 5, 6, 7, 8):
+            cand = trail.attribute_step(loaded, s)["ranks"][0]
+            if cand["legs"]["ps_pull"] > 300.0:
+                return loaded, cand, s
+        return loaded, None, None
+
+    tdir = str(tmp_path / "run1")
+    loaded, entry, blocked_step = drive(tdir)
+    if entry is None:
+        # rare under load: the injected delay was consumed somewhere no
+        # pull waited on; one retry in a fresh dir (the resnet-flake
+        # retry-once precedent)
+        tdir = str(tmp_path / "run2")
+        loaded, entry, blocked_step = drive(tdir)
+    assert entry is not None, "no step 4-8 blocked >300ms in its pull"
     joined, rate = trail.join_spans(loaded["client"], loaded["server"])
     assert rate is not None and rate >= 0.9, rate
-    # the step AFTER the armed boundary blocks in its pull wait
-    rep = trail.attribute_step(loaded, 4)
-    entry = rep["ranks"][0]
     assert entry["dominant"] == "ps_pull", entry
     assert entry["fraction"] > 0.5, entry
     # ...and the verdict names the slowed server (HETU_PS_SLOW_SERVER
     # default: 0)
     assert entry.get("server") == 0, entry
-    assert entry["legs"]["ps_pull"] > 300.0, entry
     # the CLI says the same thing, jax-free
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bin", "hetutrail"),
-         str(tmp_path), "--step", "4"],
+         tdir, "--step", str(blocked_step)],
         capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
     assert "dominant leg ps_pull" in out.stdout, out.stdout
     assert "server 0" in out.stdout, out.stdout
     # whole-run report works on the same dir
-    rep_all = trail.analyze(str(tmp_path))
+    rep_all = trail.analyze(tdir)
     assert rep_all["join_rate"] >= 0.9
     # critical-path gauges rode the metrics snapshots
     snap = {}
     recs = [json.loads(line) for line in
-            open(tmp_path / "metrics-r0.jsonl") if line.strip()]
+            open(os.path.join(tdir, "metrics-r0.jsonl")) if line.strip()]
     for r in recs:
         if isinstance(r.get("metrics"), dict):
             snap = r["metrics"]
