@@ -1,0 +1,31 @@
+"""Tier D: jax-free static analysis of the PS runtime substrate.
+
+Where Tiers A-C (docs/ANALYSIS.md) analyze the *graph*, Tier D analyzes the
+*runtime underneath it*: the C++ parameter-server headers in
+``hetu_tpu/csrc/ps``, the Python coordinators that speak their wire
+protocol, and the docs that promise knobs/gauges/fault kinds. Three check
+families, all pure-CPython text analysis (CI runs them on every commit
+without a jax import or a compiled library):
+
+- :mod:`lock_order` — parse mutex declarations and lock/unlock sites out of
+  the headers, build per-function acquisition-order graphs with call-edge
+  propagation, and report order cycles (the ABBA class of deadlock PR 16
+  shipped a fix for), locks held across blocking calls, and atomics written
+  under inconsistent guards.
+- :mod:`drift` — diff ``hetu_tpu/ps/wire_constants.py`` (the ONE Python
+  wire mirror) against the parsed C++ truth: PsfType/ArgType/ChaosKind/
+  OptType enums, MsgHeader/ArgHeader layouts and field-reuse slots, every
+  reply slot count, dispatch coverage, the ctypes C-API surface, and the
+  registered cross-language mirror pairs (quantizer, backoff schedule).
+- :mod:`surface` — diff what the code *does* against what the docs *say*:
+  HETU_*/DMLC_* knobs read vs documented, hetu_* gauges emitted vs the
+  OBSERVABILITY.md table, fault kinds in the registry vs the
+  FAULT_TOLERANCE.md catalogue.
+
+Entry point: ``bin/hetucheck [--json] [--check]`` (:mod:`cli`), reusing the
+hetulint Finding/severity/suppression machinery and exit-code contract.
+"""
+from .cpp_model import CppModel, build_model, parse_source
+from .drift import analyze_drift
+from .lock_order import analyze_locks
+from .surface import analyze_surface

@@ -1,0 +1,213 @@
+"""``bin/hetucheck`` — Tier D CLI (docs/ANALYSIS.md "Tier D: substrate").
+
+Same contract as ``bin/hetulint``: human or ``--json`` output, lint
+suppression, ``--fail-on {error,warn,never}``, exit 0 on a clean tree,
+1 when findings at or above the threshold exist, 2 on usage/load errors.
+``--check`` runs the self-test: the three analyzers against seeded-defect
+fixtures (including PR 16's pre-fix ABBA deadlock and a slot-count drift)
+plus a clean-baseline assertion over the working tree.
+
+jax-free: ``bin/hetucheck`` installs a synthetic ``hetu_tpu`` package so
+this module loads without executing ``hetu_tpu/__init__`` (which imports
+jax); CI runs it on every commit under plain CPython.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..findings import (count_by_severity, format_findings, is_suppressed,
+                        sort_findings)
+from .cpp_model import build_model
+from .drift import analyze_drift
+from .lock_order import analyze_locks
+from .surface import analyze_surface
+
+# the substrate under analysis: every header the PS runtime is built from
+HEADERS = ("hetu_tpu/csrc/ps/net.h", "hetu_tpu/csrc/ps/store.h",
+           "hetu_tpu/csrc/ps/server.h", "hetu_tpu/csrc/ps/worker.h",
+           "hetu_tpu/csrc/ps/scheduler.h", "hetu_tpu/csrc/ps/chaos.h")
+
+
+def repo_root() -> str:
+    here = os.path.abspath(__file__)
+    for _ in range(4):      # substrate -> analysis -> hetu_tpu -> repo
+        here = os.path.dirname(here)
+    return here
+
+
+def analyze(root: str) -> List:
+    """All three Tier D families over one tree."""
+    paths = [os.path.join(root, h) for h in HEADERS
+             if os.path.exists(os.path.join(root, h))]
+    findings = list(analyze_locks(build_model(paths)))
+    findings += analyze_drift(root)
+    findings += analyze_surface(root)
+    return sort_findings(findings)
+
+
+# --------------------------------------------------------------------------
+# --check fixtures. The ABBA pair reproduces PR 16's pre-fix server:
+# dispatch holds ClientSlot::mu across handle() into take_snapshot (which
+# takes PsServer::snap_take_mu_ then walks the slot table re-locking each
+# slot), while the snapshot path takes snap_take_mu_ first — the two
+# acquisition orders deadlock. The FIXED variant drops the slot lock
+# before dispatch (the shipped release-across-call), so no cycle.
+
+_ABBA_FIXTURE = """
+#pragma once
+#include <mutex>
+
+struct ClientSlot {
+  std::mutex mu;
+  int fd = -1;
+};
+
+class PsServer {
+ public:
+  void serve_conn(ClientSlot* slot) {
+    std::unique_lock<std::mutex> slot_g(slot->mu);
+    handle(slot);
+  }
+
+  void handle(ClientSlot* slot) {
+    take_snapshot();
+  }
+
+  void take_snapshot() {
+    std::lock_guard<std::mutex> g(snap_take_mu_);
+    for (size_t i = 0; i < n_; ++i) {
+      ClientSlot* s = slots_[i];
+      std::unique_lock<std::mutex> sg(s->mu);
+    }
+  }
+
+ private:
+  std::mutex snap_take_mu_;
+  ClientSlot* slots_[64];
+  size_t n_ = 0;
+};
+"""
+
+_FIXED_FIXTURE = _ABBA_FIXTURE.replace(
+    "    std::unique_lock<std::mutex> slot_g(slot->mu);\n    handle(slot);",
+    "    std::unique_lock<std::mutex> slot_g(slot->mu);\n"
+    "    slot_g.unlock();\n    handle(slot);")
+
+
+def self_check(root: str) -> int:
+    failures: List[str] = []
+
+    def expect(cond: bool, what: str):
+        print(("ok   " if cond else "FAIL ") + what)
+        if not cond:
+            failures.append(what)
+
+    # 1. seeded ABBA must be detected, naming both mutexes + both sites
+    model = build_model([("fixture/server_prefix.h", _ABBA_FIXTURE)])
+    cycles = [f for f in analyze_locks(model) if f.lint == "lock-order-cycle"]
+    expect(bool(cycles), "seeded pre-fix ABBA fixture yields a "
+                         "lock-order-cycle error")
+    msg = cycles[0].message if cycles else ""
+    expect("ClientSlot::mu" in msg and "PsServer::snap_take_mu_" in msg,
+           "cycle names both mutexes (ClientSlot::mu, "
+           "PsServer::snap_take_mu_)")
+    expect(msg.count("server_prefix.h:") >= 2,
+           "cycle reports both acquisition sites")
+
+    # 2. the shipped release-across-call shape must NOT be flagged
+    model = build_model([("fixture/server_fixed.h", _FIXED_FIXTURE)])
+    fixed = [f for f in analyze_locks(model) if f.lint == "lock-order-cycle"]
+    expect(not fixed, "release-across-call (post-fix) fixture is clean")
+
+    # 3. seeded slot-count drift must be caught
+    server = os.path.join(root, "hetu_tpu/csrc/ps/server.h")
+    with open(server, "r", encoding="utf-8") as f:
+        text = f.read()
+    overlay = {"hetu_tpu/csrc/ps/server.h":
+               text.replace("int64_t stats[11]", "int64_t stats[12]")}
+    drifted = [f for f in analyze_drift(root, overlay=overlay)
+               if f.lint == "slot-count-drift"]
+    expect(bool(drifted), "seeded kServerStats slot-count drift (11 -> 12) "
+                          "yields a slot-count-drift error")
+
+    # 4. gutting the fault catalogue doc must trip the surface lint
+    gutted = [f for f in analyze_surface(
+                  root, overlay={"docs/FAULT_TOLERANCE.md": "# empty\n"})
+              if f.lint == "fault-kind-undocumented"]
+    expect(bool(gutted), "emptied FAULT_TOLERANCE.md yields "
+                         "fault-kind-undocumented errors")
+
+    # 5. the working tree itself must be error-free
+    errors = [f for f in analyze(root) if f.severity == "error"]
+    for f in errors[:5]:
+        print(f"     baseline error: [{f.lint}] {f.message}")
+    expect(not errors, "working tree has no Tier D errors")
+
+    print(("hetucheck self-test: PASS" if not failures
+           else f"hetucheck self-test: {len(failures)} FAILURE(S)"))
+    return 0 if not failures else 1
+
+
+# --------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hetucheck",
+        description="Tier D substrate analysis: lock-order deadlock "
+                    "detection + cross-language protocol/surface drift "
+                    "lint (docs/ANALYSIS.md)")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--suppress", action="append", default=[],
+                    metavar="LINT", help="suppress a lint globally")
+    ap.add_argument("--fail-on", choices=("error", "warn", "never"),
+                    default="error",
+                    help="exit 1 at/above this severity (default: error)")
+    ap.add_argument("--check", action="store_true",
+                    help="run the seeded-fixture self-test and exit")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code == 0 else 2
+
+    root = args.root or repo_root()
+    if not os.path.isdir(os.path.join(root, "hetu_tpu")):
+        print(f"hetucheck: {root} is not a hetu-tpu checkout",
+              file=sys.stderr)
+        return 2
+
+    if args.check:
+        return self_check(root)
+
+    findings = [f for f in analyze(root)
+                if not is_suppressed(f, args.suppress)]
+    counts = count_by_severity(findings)
+
+    if args.fail_on == "never":
+        ok = True
+    elif args.fail_on == "warn":
+        ok = counts.get("error", 0) + counts.get("warn", 0) == 0
+    else:
+        ok = counts.get("error", 0) == 0
+
+    if args.as_json:
+        print(json.dumps({"findings": [f.as_dict() for f in findings],
+                          "counts": counts, "ok": ok}, indent=2))
+    else:
+        if findings:
+            print(format_findings(findings))
+        print(f"hetucheck: {counts.get('error', 0)} error(s), "
+              f"{counts.get('warn', 0)} warn(s), "
+              f"{counts.get('note', 0)} note(s) — "
+              + ("ok" if ok else f"failing on {args.fail_on}"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,431 @@
+"""A micro-parser for the csrc/ps headers: just enough C++ to do lock-order
+and protocol analysis, and not a token more.
+
+This is NOT a C++ frontend. It is a purpose-built recognizer for the idioms
+the PS runtime actually uses (docs/ANALYSIS.md "Tier D"): single-header
+classes, ``std::lock_guard``/``unique_lock``/``shared_lock`` RAII guards
+(including the deferred ``std::unique_lock<std::mutex> g;`` + later
+``g = std::unique_lock<std::mutex>(m)`` re-bind pattern), manual
+``mu.lock()/unlock()``, and plain-name intra-file calls. Anything fancier
+(templates with dependent lock types, lock adoption, ``std::lock``) would
+need new cases here — the seeded-defect tests in tests/test_substrate.py
+pin the idioms that must keep parsing.
+
+Straight-line release convention: a conditional unlock
+(``if (cond) g.unlock();``) is modeled as an unconditional release at that
+point, and the matching conditional re-lock as an unconditional re-acquire.
+That is exactly the release-across-call shape the PR 16 deadlock fix
+introduced (server.h serve_conn drops the client slot around ``handle()``),
+so the shipped tree analyzes clean while the pre-fix fixture — which has no
+release at all — still produces the ABBA cycle.
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# statement keywords that can never open a function definition
+_STMT_KEYWORDS = frozenset((
+    "if", "for", "while", "switch", "return", "else", "case", "catch",
+    "do", "throw", "new", "delete", "sizeof", "static_assert", "using",
+    "typedef", "goto", "break", "continue", "default",
+))
+
+# member-call names never linked as intra-file call edges: std containers,
+# atomics, and condition variables share these names with nothing we model
+_CALL_NOISE = frozenset((
+    "wait", "wait_for", "wait_until", "notify_all", "notify_one",
+    "lock", "unlock", "try_lock", "owns_lock",
+    "load", "store", "exchange", "fetch_add", "fetch_sub",
+    "size", "empty", "clear", "resize", "reserve", "assign",
+    "push_back", "emplace_back", "pop_front", "push", "pop",
+    "front", "back", "begin", "end", "at", "count", "find", "insert",
+    "erase", "emplace", "get", "reset", "data", "c_str", "str", "substr",
+    "append", "join", "detach", "open", "close", "swap", "min", "max",
+    "move", "to_string", "make_shared", "make_pair", "string",
+))
+
+
+@dataclass
+class LockEvent:
+    """One lock-relevant statement, in source order inside a function."""
+
+    kind: str       # "acquire" | "release" | "call" | "atomic_write"
+    name: str       # resolved mutex label / callee name / atomic label
+    line: int       # 1-based line in the source file
+    depth: int      # brace depth at the statement (for scope-exit release)
+    scoped: bool = False   # acquire only: released automatically at scope exit
+
+
+@dataclass
+class CppFunction:
+    name: str
+    cls: Optional[str]          # enclosing class, None for free functions
+    file: str                   # basename, e.g. "server.h"
+    start: int
+    end: int
+    events: List[LockEvent] = field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}::{self.name}" if self.cls else self.name
+
+
+@dataclass
+class CppClass:
+    name: str
+    file: str
+    mutexes: set = field(default_factory=set)
+    atomics: set = field(default_factory=set)
+    cvs: set = field(default_factory=set)
+
+
+@dataclass
+class CppSource:
+    """One parsed header: classes, functions, and a var-name -> class map."""
+
+    path: str
+    name: str                   # basename
+    text: str                   # comment/string-stripped, line-preserving
+    classes: Dict[str, CppClass] = field(default_factory=dict)
+    functions: List[CppFunction] = field(default_factory=list)
+    var_types: Dict[str, str] = field(default_factory=dict)
+
+
+def strip_noise(text: str) -> str:
+    """Blank out comments, string and char literals — preserving every
+    newline so line numbers survive — then return the cleaned text."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            j = text.find("\n", i)
+            if j < 0:
+                break
+            out.append("\n")
+            i = j + 1
+        elif c == "/" and nxt == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("\n" * text.count("\n", i, j))
+            i = j
+        elif c in "\"'":
+            q = c
+            j = i + 1
+            while j < n and text[j] != q:
+                j += 2 if text[j] == "\\" else 1
+            out.append(q + q)
+            i = j + 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+_RE_CLASS = re.compile(r"^\s*(?:class|struct)\s+([A-Za-z_]\w*)\b(?!.*;\s*$)")
+_RE_MUTEX_MEMBER = re.compile(
+    r"^\s*(?:mutable\s+)?(?:std::)?(?:shared_)?mutex\s+"
+    r"([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*(?:\[[^;]*\])?\s*;")
+_RE_CV_MEMBER = re.compile(
+    r"^\s*(?:std::)?condition_variable(?:_any)?\s+"
+    r"([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)\s*;")
+_RE_ATOMIC_MEMBER = re.compile(
+    r"^\s*(?:std::)?atomic<[^>]*>\s+([A-Za-z_]\w*)\s*[;{]")
+_RE_FUNC_NAME = re.compile(r"([A-Za-z_]\w*)\s*\($")
+_RE_GUARD_DECL = re.compile(
+    r"(?:std::)?(lock_guard|unique_lock|shared_lock|scoped_lock)"
+    r"\s*<[^>]*>\s+([A-Za-z_]\w*)\s*[({]([^;]*?)[)}]\s*;")
+_RE_GUARD_DEFER = re.compile(
+    r"(?:std::)?(unique_lock|shared_lock)\s*<[^>]*>\s+([A-Za-z_]\w*)\s*;")
+_RE_GUARD_ASSIGN = re.compile(
+    r"\b([A-Za-z_]\w*)\s*=\s*(?:std::)?(?:unique_lock|shared_lock)"
+    r"\s*<[^>]*>\s*\(([^;]*?)\)\s*;")
+_RE_LOCK_OP = re.compile(r"\b([A-Za-z_]\w*)\s*(?:\.|->)\s*(lock|unlock)\s*\(\s*\)")
+_RE_CALL = re.compile(r"(?<![\w.])([A-Za-z_]\w*)\s*\(")
+_RE_MEMBER_CALL = re.compile(r"[\w)\]]\s*(?:\.|->)\s*([A-Za-z_]\w*)\s*\(")
+_RE_VAR_PTR = re.compile(r"\b([A-Z]\w*)\s*\*\s*(?:const\s+)?([a-z_]\w*)\b")
+_RE_VAR_REF = re.compile(r"\b([A-Z]\w*)\s*&\s*([a-z_]\w*)\b")
+_RE_MUTEX_EXPR = re.compile(
+    r"^\s*\*?\s*(?:([A-Za-z_]\w*)\s*(?:\.|->)\s*)?([A-Za-z_]\w*)")
+
+
+def _join_header(lines: List[str], start: int, max_span: int = 10):
+    """Join a candidate function-definition header until its parens balance
+    and a ``{`` or ``;`` decides it. Returns (joined, end_index, opener)."""
+    buf = ""
+    for j in range(start, min(start + max_span, len(lines))):
+        buf += " " + lines[j]
+        bal = buf.count("(") - buf.count(")")
+        if bal <= 0:
+            body = buf
+            # past the closing paren of the arg list: ctor init lists and
+            # const/noexcept qualifiers may precede the brace
+            brace = body.find("{", body.rfind(")"))
+            semi = body.find(";", body.rfind(")"))
+            if brace >= 0 and (semi < 0 or brace < semi):
+                return buf, j, "{"
+            if semi >= 0:
+                return buf, j, ";"
+            if j + 1 < len(lines) and "{" not in buf and ";" not in buf:
+                continue  # init list on following lines
+    return buf, start, None
+
+
+class CppModel:
+    """All parsed sources plus the cross-file class map, so ``slot->mu``
+    in server.h resolves against ``Param``/``ClientSlot`` wherever they
+    were declared."""
+
+    def __init__(self, sources: List[CppSource]):
+        self.sources = sources
+        self.classes: Dict[str, CppClass] = {}
+        for src in sources:
+            self.classes.update(src.classes)
+        self.functions: Dict[Tuple[str, str], CppFunction] = {}
+        for src in sources:
+            for fn in src.functions:
+                self.functions.setdefault((src.name, fn.name), fn)
+
+    def resolve_mutex(self, expr: str, src: CppSource,
+                      cls: Optional[str]) -> Optional[str]:
+        """Mutex expression -> stable label. ``snap_mu_`` inside PsServer
+        -> ``PsServer::snap_mu_``; ``slot->mu`` with ``ClientSlot* slot``
+        in scope -> ``ClientSlot::mu``; an indexed ``server_mu_[i][j]``
+        resolves by its base name. Unresolvable exprs get a per-variable
+        label (conservative: never merges two locks that might differ)."""
+        expr = expr.split(",")[0].strip()
+        expr = re.sub(r"\[[^\]]*\]", "", expr)       # strip indexing
+        m = _RE_MUTEX_EXPR.match(expr)
+        if not m:
+            return None
+        recv, member = m.group(1), m.group(2)
+        if recv is None:
+            # bare name: enclosing-class member, else treat as local/global
+            if cls and member in self.classes.get(cls, CppClass("", "")).mutexes:
+                return f"{cls}::{member}"
+            return member
+        vcls = src.var_types.get(recv)
+        if vcls and member in self.classes.get(vcls, CppClass("", "")).mutexes:
+            return f"{vcls}::{member}"
+        return f"{member}@{recv}"
+
+
+def parse_source(path: str, text: Optional[str] = None) -> CppSource:
+    """Parse one header. ``text`` overrides the file contents (fixtures)."""
+    if text is None:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    stripped = strip_noise(text)
+    lines = stripped.split("\n")
+    src = CppSource(path=path, name=os.path.basename(path), text=stripped)
+
+    # ---- pass 1: class extents + members, function extents --------------
+    depth = 0
+    # stack of (kind, name, body_depth); kind in {"class", "func", "other"}
+    stack: List[Tuple[str, Optional[str], int]] = []
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        cur_class = next((n for k, n, _ in reversed(stack) if k == "class"),
+                         None)
+        in_func = any(k == "func" for k, _, _ in stack)
+
+        handled_span = i
+        if not in_func:
+            mc = _RE_CLASS.match(line)
+            opens_here = "{" in line
+            if mc and (opens_here or (i + 1 < len(lines)
+                                      and "{" in lines[i + 1])):
+                name = mc.group(1)
+                src.classes.setdefault(name, CppClass(name, src.name))
+                stack.append(("class", name, depth + 1))
+            elif cur_class is not None:
+                mm = _RE_MUTEX_MEMBER.match(line)
+                if mm:
+                    for nm in re.split(r"\s*,\s*", mm.group(1)):
+                        src.classes[cur_class].mutexes.add(nm)
+                mv = _RE_CV_MEMBER.match(line)
+                if mv:
+                    for nm in re.split(r"\s*,\s*", mv.group(1)):
+                        src.classes[cur_class].cvs.add(nm)
+                ma = _RE_ATOMIC_MEMBER.match(line)
+                if ma:
+                    src.classes[cur_class].atomics.add(ma.group(1))
+            if (not mc and "(" in line):
+                first = re.match(r"\s*([A-Za-z_]\w*)", line)
+                if first and first.group(1) not in _STMT_KEYWORDS \
+                        and not line.lstrip().startswith("#"):
+                    header, j, opener = _join_header(lines, i)
+                    if opener == "{":
+                        paren = header.find("(")
+                        mname = re.search(r"([A-Za-z_~]\w*)\s*$",
+                                          header[:paren])
+                        if mname and mname.group(1) not in _STMT_KEYWORDS:
+                            fn = CppFunction(name=mname.group(1),
+                                             cls=cur_class, file=src.name,
+                                             start=i + 1, end=i + 1)
+                            src.functions.append(fn)
+                            stack.append(("func", fn.name,
+                                          depth + 1))
+                            handled_span = j
+
+        # advance depth over the full span we consumed
+        for j in range(i, handled_span + 1):
+            depth += lines[j].count("{") - lines[j].count("}")
+        # close scopes whose body depth is now above current depth
+        while stack and depth < stack[-1][2]:
+            kind, name, _ = stack.pop()
+            if kind == "func":
+                for fn in reversed(src.functions):
+                    if fn.name == name and fn.end == fn.start:
+                        fn.end = handled_span + 1
+                        break
+        i = handled_span + 1
+
+    # ---- pass 2: file-wide var-name -> class map -------------------------
+    for regex in (_RE_VAR_PTR, _RE_VAR_REF):
+        for m in regex.finditer(stripped):
+            src.var_types.setdefault(m.group(2), m.group(1))
+    return src
+
+
+def extract_events(src: CppSource, model: CppModel) -> None:
+    """Pass 3: per-function lock/call/atomic event streams, in source
+    order, with straight-line release semantics (module docstring)."""
+    lines = src.text.split("\n")
+    for fn in src.functions:
+        guards: Dict[str, Optional[str]] = {}     # guard var -> mutex label
+        guard_depth: Dict[str, int] = {}
+        scoped_at: List[Tuple[int, str]] = []     # (depth, label) lock_guard
+        depth = 0
+        events = fn.events
+        atomics_here = set()
+        for c in model.classes.values():
+            atomics_here |= {(a, c.name) for a in c.atomics}
+        atomic_names = {a: c for a, c in atomics_here}
+
+        for ln in range(fn.start - 1, min(fn.end, len(lines))):
+            line = lines[ln]
+            lineno = ln + 1
+            consumed_spans: List[Tuple[int, int]] = []
+
+            for m in _RE_GUARD_DECL.finditer(line):
+                style, gvar, args = m.group(1), m.group(2), m.group(3)
+                consumed_spans.append(m.span())
+                mutex_args = ([a for a in args.split(",")]
+                              if style == "scoped_lock" else [args])
+                for a in mutex_args:
+                    label = model.resolve_mutex(a, src, fn.cls)
+                    if not label:
+                        continue
+                    events.append(LockEvent("acquire", label, lineno, depth,
+                                            scoped=True))
+                    if style in ("unique_lock", "shared_lock"):
+                        guards[gvar] = label
+                        guard_depth[gvar] = depth
+                    else:
+                        scoped_at.append((depth, label))
+            for m in _RE_GUARD_DEFER.finditer(line):
+                consumed_spans.append(m.span())
+                guards[m.group(2)] = None
+                guard_depth[m.group(2)] = depth
+            for m in _RE_GUARD_ASSIGN.finditer(line):
+                gvar, arg = m.group(1), m.group(2)
+                if gvar not in guards:
+                    continue
+                consumed_spans.append(m.span())
+                if guards[gvar]:
+                    events.append(LockEvent("release", guards[gvar],
+                                            lineno, depth))
+                label = model.resolve_mutex(arg, src, fn.cls)
+                if label:
+                    events.append(LockEvent("acquire", label, lineno, depth,
+                                            scoped=True))
+                    guards[gvar] = label
+            for m in _RE_LOCK_OP.finditer(line):
+                recv, op = m.group(1), m.group(2)
+                consumed_spans.append(m.span())
+                if recv in guards:
+                    label = guards[recv]
+                    if label is None:
+                        continue
+                    events.append(LockEvent(
+                        "release" if op == "unlock" else "acquire",
+                        label, lineno, depth, scoped=(op == "lock")))
+                else:
+                    label = model.resolve_mutex(recv, src, fn.cls)
+                    if label and _is_known_mutex(label, model):
+                        events.append(LockEvent(
+                            "release" if op == "unlock" else "acquire",
+                            label, lineno, depth, scoped=False))
+
+            # atomic writes (only class-member atomics we parsed)
+            for an, acls in atomic_names.items():
+                if re.search(rf"\b{an}\s*(?:\.\s*(?:store|fetch_add|"
+                             rf"fetch_sub|exchange)\s*\(|=(?!=)|\+\+)", line):
+                    events.append(LockEvent("atomic_write",
+                                            f"{acls}::{an}", lineno, depth))
+
+            # calls (plain or member), minus std/cv noise. All are
+            # recorded; lock_order propagates through same-file callees
+            # and warns on the blocking set wherever it is defined.
+            seen_calls = set()
+            for m in list(_RE_CALL.finditer(line)) \
+                    + list(_RE_MEMBER_CALL.finditer(line)):
+                name = m.group(1)
+                if name in _CALL_NOISE or name == fn.name \
+                        or name in _STMT_KEYWORDS:
+                    continue
+                if any(a <= m.start(1) < b for a, b in consumed_spans):
+                    continue
+                if (name, m.start(1)) in seen_calls:
+                    continue
+                seen_calls.add((name, m.start(1)))
+                events.append(LockEvent("call", name, lineno, depth))
+
+            depth += line.count("{") - line.count("}")
+            # scope exits release lock_guards and in-scope unique_locks: a
+            # guard declared at statement depth d dies when depth sinks
+            # BELOW d (its enclosing block's closing brace)
+            still = []
+            for d, label in scoped_at:
+                if depth < d:
+                    events.append(LockEvent("release", label, lineno, depth))
+                else:
+                    still.append((d, label))
+            scoped_at = still
+            for gvar in list(guards):
+                if depth < guard_depth[gvar]:
+                    if guards[gvar]:
+                        events.append(LockEvent("release", guards[gvar],
+                                                lineno, depth))
+                    del guards[gvar], guard_depth[gvar]
+
+
+def _is_known_mutex(label: str, model: CppModel) -> bool:
+    if "::" in label:
+        cls, member = label.split("::", 1)
+        return member in model.classes.get(cls, CppClass("", "")).mutexes
+    return label.endswith("mu_") or label.endswith("mu") \
+        or "mutex" in label.lower()
+
+
+def build_model(paths_or_texts) -> CppModel:
+    """Parse a set of headers into one model. Items are either paths or
+    ``(virtual_path, text)`` tuples (fixtures). Event extraction runs after
+    all files parse so cross-file class lookups (Param in store.h, used in
+    server.h) resolve."""
+    sources = []
+    for item in paths_or_texts:
+        if isinstance(item, tuple):
+            sources.append(parse_source(item[0], text=item[1]))
+        else:
+            sources.append(parse_source(item))
+    model = CppModel(sources)
+    for src in sources:
+        extract_events(src, model)
+    return model

@@ -1,0 +1,471 @@
+"""Cross-language protocol-drift lint (docs/ANALYSIS.md "Tier D").
+
+The PS runtime speaks one wire protocol from two languages: C++ defines it
+(csrc/ps/net.h and friends) and Python mirrors it
+(:mod:`hetu_tpu.ps.wire_constants`, the ONE mirror every coordinator
+imports). Nothing at runtime checks the two agree — a C++ slot added
+without the Python mirror silently mis-unpacks every later slot. This pass
+re-parses the C++ truth on every run and diffs it against the mirror:
+
+- ``enum-drift`` (error) — PsfType / ArgType / ChaosKind / OptType entries
+  missing on either side or bound to different values.
+- ``wire-header-drift`` (error) — MsgHeader/ArgHeader member count, byte
+  size, or names out of step with ``MSG_HDR``/``ARG_HDR`` (field-reuse
+  slots: C++ ``pad`` may be Python ``crc_or_pad``/``world_ver``).
+- ``wire-const-drift`` (error) — kFlagQuantRsp/kFlagCrc/kQuantWireBlock/
+  kShardMagicV2/kTrailCols/kEventCols value drift.
+- ``slot-count-drift`` (error) — every fixed reply layout (kServerStats,
+  kSnapshotNow, kResizeState, world replies, client_stats, kListParams
+  stride, shard meta, optimizer aux-slot counts) vs the mirror's field
+  tuples.
+- ``psf-dispatch-drift`` (error) — a PsfType no handler dispatches (and is
+  not a known reply-only type), or a worker-sent PSF nothing handles.
+- ``capi-unbound`` (error) / ``capi-dead`` (note) — ctypes calls into the
+  ``extern "C"`` surface that don't exist, and exports nothing calls.
+- ``wire-import-drift`` (error) / ``magic-number`` (warn) — a raw-socket
+  unpacker that stopped importing the mirror, or a consumer that grew a
+  bare slot-count literal back.
+- ``mirror-pair-drift`` (error) / ``mirror-pair-untested`` (warn) — the
+  registered bit-equality mirrors (quantizer, backoff schedule) missing a
+  side, or missing the test that pins them together.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ...ps import wire_constants as wire
+from ..findings import ERROR, NOTE, WARN, Finding
+
+PASS = "drift"
+CSRC = os.path.join("hetu_tpu", "csrc", "ps")
+
+_CTYPE_SIZE = {"int8_t": 1, "uint8_t": 1, "int16_t": 2, "uint16_t": 2,
+               "int32_t": 4, "uint32_t": 4, "int": 4, "unsigned": 4,
+               "float": 4, "int64_t": 8, "uint64_t": 8, "double": 8,
+               "size_t": 8}
+
+# C++ member name -> acceptable Python mirror names (documented slot reuse)
+_FIELD_ALIASES = {"pad": ("crc_or_pad", "world_ver")}
+
+# PsfTypes that only ever appear as response types — no dispatch case owed
+_REPLY_ONLY = ("kAck", "kAddressBook")
+
+# Python files that unpack raw i64 reply slots and therefore must import
+# the mirror, plus the dict-consumer files checked for magic re-growth
+_RAW_UNPACKERS = ("hetu_tpu/elastic.py", "hetu_tpu/ps/client.py",
+                  "hetu_tpu/ps/supervisor.py", "hetu_tpu/chaos.py")
+_ALL_CONSUMERS = _RAW_UNPACKERS + ("hetu_tpu/recovery.py",
+                                   "hetu_tpu/runner.py",
+                                   "hetu_tpu/resilience.py")
+
+# (python symbol, python file, c++ symbol, c++ file, pinning test file,
+#  acceptable test anchors — any one present pins the pair)
+_MIRROR_PAIRS = (
+    ("np_quantize_blocks", "hetu_tpu/comm_quant.py",
+     "make_qi8_arg", "hetu_tpu/csrc/ps/net.h", "tests/test_comm_quant.py",
+     ("np_quantize_blocks", "np_roundtrip")),
+    ("backoff_ms", "hetu_tpu/chaos.py",
+     "backoff_ms", "hetu_tpu/csrc/ps/chaos.h", "tests/test_chaos.py",
+     ("backoff_ms",)),
+    ("splitmix64", "hetu_tpu/chaos.py",
+     "splitmix64", "hetu_tpu/csrc/ps/chaos.h", "tests/test_chaos.py",
+     ("splitmix64", "backoff_ms")),
+)
+
+
+def _read(root: str, rel: str, overlay: Optional[dict] = None) -> str:
+    if overlay and rel in overlay:
+        return overlay[rel]
+    with open(os.path.join(root, rel), "r", encoding="utf-8",
+              errors="replace") as f:
+        return f.read()
+
+
+def _strip(text: str) -> str:
+    from .cpp_model import strip_noise
+    return strip_noise(text)
+
+
+def parse_enum(text: str, name: str) -> Dict[str, int]:
+    """``enum [class] Name [: type] { kA = 0, kB, ... };`` -> dict."""
+    m = re.search(rf"enum\s+(?:class\s+)?{name}\b[^{{]*\{{", text)
+    if not m:
+        return {}
+    body = text[m.end():text.index("}", m.end())]
+    out: Dict[str, int] = {}
+    nxt = 0
+    for entry in body.split(","):
+        em = re.match(r"\s*([A-Za-z_]\w*)\s*(?:=\s*(-?\d+))?\s*$", entry)
+        if not em:
+            continue
+        val = int(em.group(2)) if em.group(2) is not None else nxt
+        out[em.group(1)] = val
+        nxt = val + 1
+    return out
+
+
+def parse_struct_members(text: str, name: str) -> List[Tuple[str, str]]:
+    """Plain-old-data struct members as (ctype, name), declaration order."""
+    m = re.search(rf"struct\s+{name}\s*\{{", text)
+    if not m:
+        return []
+    body = text[m.end():text.index("}", m.end())]
+    out = []
+    for line in body.split(";"):
+        mm = re.match(r"\s*([A-Za-z_]\w*)\s+([A-Za-z_]\w*)\s*(?:=.*)?$",
+                      line.strip())
+        if mm and mm.group(1) in _CTYPE_SIZE:
+            out.append((mm.group(1), mm.group(2)))
+    return out
+
+
+def parse_const(text: str, name: str) -> Optional[int]:
+    m = re.search(rf"\b{name}\s*=\s*(-?\d+)", text)
+    return int(m.group(1)) if m else None
+
+
+def case_block(text: str, psf: str) -> str:
+    """The statement span of one ``case PsfType::kX:`` (to the next case/
+    default or an unindented close)."""
+    m = re.search(rf"case\s+PsfType::{psf}\s*:", text)
+    if not m:
+        return ""
+    rest = text[m.end():]
+    stop = re.search(r"\n\s*(?:case\s+PsfType::|default\s*:)", rest)
+    return rest[:stop.start()] if stop else rest[:4000]
+
+
+def func_block(text: str, name: str) -> str:
+    """Body of the first function definition named ``name`` (brace-matched)."""
+    m = re.search(rf"\b{name}\s*\([^;{{]*\)[^;{{]*\{{", text)
+    if not m:
+        return ""
+    depth, i = 1, m.end()
+    while i < len(text) and depth:
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+        i += 1
+    return text[m.end():i]
+
+
+def _err(findings, lint, where, msg, severity=ERROR):
+    findings.append(Finding(lint=lint, severity=severity, message=msg,
+                            op_name=where, pass_name=PASS))
+
+
+def _diff_enum(findings, where, cpp: Dict[str, int], py: Dict[str, int],
+               enum_name: str):
+    for k in sorted(set(cpp) | set(py)):
+        if k not in py:
+            _err(findings, "enum-drift", where,
+                 f"{enum_name}::{k} = {cpp[k]} has no entry in "
+                 "hetu_tpu/ps/wire_constants.py — Python cannot name it")
+        elif k not in cpp:
+            _err(findings, "enum-drift", where,
+                 f"wire_constants mirrors {enum_name}::{k} = {py[k]} but "
+                 "the C++ enum has no such entry — stale mirror")
+        elif cpp[k] != py[k]:
+            _err(findings, "enum-drift", where,
+                 f"{enum_name}::{k} is {cpp[k]} in C++ but {py[k]} in "
+                 "wire_constants — value drift corrupts every message "
+                 "carrying it")
+
+
+def _check_header_struct(findings, where, text, struct: str,
+                         fields: tuple, pystruct) -> None:
+    members = parse_struct_members(text, struct)
+    if not members:
+        _err(findings, "wire-header-drift", where,
+             f"could not parse struct {struct} out of net.h — the parser "
+             "or the header moved; fix whichever drifted")
+        return
+    if len(members) != len(fields):
+        _err(findings, "wire-header-drift", where,
+             f"{struct} has {len(members)} members but wire_constants "
+             f"names {len(fields)} fields {fields} — slot-layout drift")
+        return
+    size = sum(_CTYPE_SIZE[t] for t, _ in members)
+    if size != pystruct.size:
+        _err(findings, "wire-header-drift", where,
+             f"{struct} is {size} bytes in C++ but wire_constants packs "
+             f"{pystruct.size} ({pystruct.format!r})")
+    for (ctype, cname), pyname in zip(members, fields):
+        ok = (cname == pyname
+              or pyname in _FIELD_ALIASES.get(cname, ())
+              or cname in _FIELD_ALIASES and pyname in _FIELD_ALIASES[cname])
+        if not ok and cname != pyname:
+            _err(findings, "wire-header-drift", where,
+                 f"{struct}.{cname} is mirrored as {pyname!r} — if the "
+                 "slot was renamed/reused, add it to the documented "
+                 "field-reuse aliases; otherwise the layouts disagree")
+
+
+def _check_slot_counts(findings, root, overlay):
+    server = _strip(_read(root, f"{CSRC}/server.h", overlay))
+    sched = _strip(_read(root, f"{CSRC}/scheduler.h", overlay))
+    workr = _strip(_read(root, f"{CSRC}/worker.h", overlay))
+    chaos = _strip(_read(root, f"{CSRC}/chaos.h", overlay))
+    store = _strip(_read(root, f"{CSRC}/store.h", overlay))
+
+    def arr_size(block: str, arr: str) -> Optional[int]:
+        m = re.search(rf"\b{arr}\s*\[\s*(\d+)\s*\]", block)
+        return int(m.group(1)) if m else None
+
+    def expect(where, what, got, want):
+        if got is None:
+            _err(findings, "slot-count-drift", where,
+                 f"could not locate the {what} slot-count anchor — the "
+                 "handler moved; update the Tier D extractor")
+        elif got != want:
+            _err(findings, "slot-count-drift", where,
+                 f"{what} is {got} slots in C++ but wire_constants "
+                 f"declares {want} — every unpacker reading the mirror "
+                 "now mis-slices the reply")
+
+    expect("server.h:kServerStats", "kServerStats reply",
+           arr_size(case_block(server, "kServerStats"), "stats"),
+           wire.SERVER_STATS_SLOTS)
+    expect("server.h:kSnapshotNow", "kSnapshotNow reply",
+           arr_size(case_block(server, "kSnapshotNow"), "out"),
+           wire.SNAPSHOT_NOW_SLOTS)
+    expect("scheduler.h:kResizeState", "kResizeState reply",
+           arr_size(case_block(sched, "kResizeState"), "vals"),
+           wire.RESIZE_STATE_SLOTS)
+    expect("scheduler.h:world_reply_locked", "world reply",
+           arr_size(func_block(sched, "world_reply_locked"), "vals"),
+           wire.WORLD_REPLY_SLOTS)
+    expect("server.h:save_param_file", "v2 shard meta header",
+           arr_size(func_block(server, "save_param_file"), "meta"),
+           wire.SHARD_META_LEN)
+
+    cs = func_block(workr, "client_stats")
+    n = len(re.findall(r"static_cast<int64_t>", cs)) if cs else None
+    expect("worker.h:client_stats", "client_stats vector", n,
+           wire.CLIENT_STATS_SLOTS)
+
+    lp = case_block(server, "kListParams")
+    n = len(re.findall(r"\bflat\s*\.\s*push_back", lp)) if lp else None
+    expect("server.h:kListParams", "kListParams row stride", n,
+           wire.LIST_PARAMS_STRIDE)
+
+    for cname, cfile, ctext, want in (
+            ("kTrailCols", "worker.h", workr, wire.TRAIL_COLS),
+            ("kEventCols", "chaos.h", chaos, wire.CHAOS_EVENT_COLS),
+            ("kShardMagicV2", "server.h", server, wire.SHARD_MAGIC_V2),
+            ("kQuantWireBlock", "net.h",
+             _strip(_read(root, f"{CSRC}/net.h", overlay)),
+             wire.QUANT_WIRE_BLOCK),):
+        got = parse_const(ctext, cname)
+        if got is None:
+            _err(findings, "wire-const-drift", cfile,
+                 f"constant {cname} not found in {cfile}")
+        elif got != want:
+            _err(findings, "wire-const-drift", cfile,
+                 f"{cname} is {got} in {cfile} but wire_constants says "
+                 f"{want}")
+
+    # optimizer aux-slot counts: store.h alloc_slots switch vs the mirror
+    ab = func_block(store, "alloc_slots")
+    opt = parse_enum(store, "OptType")
+    if ab and opt:
+        counts: Dict[int, int] = {}
+        pending: List[str] = []
+        for line in ab.split("\n"):
+            cm = re.search(r"case\s+OptType::(\w+)\s*:", line)
+            if cm:
+                pending.append(cm.group(1))
+            if ".assign(" in line:
+                for p in pending:
+                    counts[opt[p]] = counts.get(opt[p], 0) + 1
+            if "break" in line:
+                for p in pending:
+                    counts.setdefault(opt[p], 0)
+                pending = []
+        if counts != wire.OPT_SLOT_COUNTS:
+            _err(findings, "slot-count-drift", "store.h:alloc_slots",
+                 f"optimizer aux-slot counts are {counts} in C++ but "
+                 f"wire_constants.OPT_SLOT_COUNTS says "
+                 f"{wire.OPT_SLOT_COUNTS} — v2 shard re-splits will "
+                 "mis-shape optimizer state")
+
+
+def _check_dispatch(findings, root, overlay):
+    server = _strip(_read(root, f"{CSRC}/server.h", overlay))
+    sched = _strip(_read(root, f"{CSRC}/scheduler.h", overlay))
+    workr = _strip(_read(root, f"{CSRC}/worker.h", overlay))
+    handled = set(re.findall(r"case\s+PsfType::(\w+)\s*:", server)) \
+        | set(re.findall(r"case\s+PsfType::(\w+)\s*:", sched))
+    for k in sorted(wire.PSF):
+        if k not in handled and k not in _REPLY_ONLY:
+            _err(findings, "psf-dispatch-drift", "server.h/scheduler.h",
+                 f"PsfType::{k} has no dispatch case in server.h or "
+                 "scheduler.h and is not a known reply-only type — "
+                 "requests of this type hang or error at every peer")
+    sent = set(re.findall(r"PsfType::(\w+)", workr))
+    for k in sorted(sent - handled - set(_REPLY_ONLY)):
+        _err(findings, "psf-dispatch-drift", "worker.h",
+             f"worker.h builds PsfType::{k} requests but no server/"
+             "scheduler case handles them")
+
+
+_CAPI_FILES = (f"{CSRC}/capi.cc", "hetu_tpu/csrc/cache/cache_capi.cc")
+# extern "C" definitions sit at column 0; a type prefix then the name
+_RE_CAPI_DEF = re.compile(
+    r"^(?:(?:static|inline|extern|const|unsigned|struct)\s+)*"
+    r"(?:[A-Za-z_][\w:<>]*[*&\s]+)+([A-Za-z_]\w*)\s*\(", re.M)
+
+
+def _extern_c_spans(text: str) -> List[str]:
+    """The brace-matched bodies of every ``extern "C" { ... }`` block
+    (string literals are blanked by the strip pass, hence ``""``)."""
+    spans = []
+    for m in re.finditer(r'extern\s+""\s*\{', text):
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        spans.append(text[m.end():i])
+    return spans
+
+
+def _check_capi(findings, root, overlay):
+    exports = set()
+    for rel in _CAPI_FILES:
+        try:
+            text = _strip(_read(root, rel, overlay))
+        except OSError:
+            continue
+        for span in _extern_c_spans(text) or [text]:
+            for fm in _RE_CAPI_DEF.finditer(span):
+                exports.add(fm.group(1))
+    exports -= {"if", "for", "while", "switch", "return", "sizeof",
+                "throw", "delete", "new"}
+
+    refs: Dict[str, str] = {}
+    for dirpath, _, files in os.walk(os.path.join(root, "hetu_tpu")):
+        if "csrc" in dirpath:
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, f), root)
+            text = _read(root, rel, overlay)
+            for rm in re.finditer(r"\b_?lib\.([A-Za-z_]\w*)", text):
+                refs.setdefault(rm.group(1), rel)
+    for name in sorted(set(refs) - exports - {"restype", "argtypes"}):
+        _err(findings, "capi-unbound", refs[name],
+             f"{refs[name]} calls lib.{name} but no C-API file exports "
+             "such a symbol — AttributeError (or worse) at first use")
+    for name in sorted(exports - set(refs)):
+        _err(findings, "capi-dead", "capi.cc",
+             f"the C API exports {name} but no Python code references "
+             "it — dead surface or a binding went missing",
+             severity=NOTE)
+
+
+def _check_unpackers(findings, root, overlay):
+    for rel in _RAW_UNPACKERS:
+        text = _read(root, rel, overlay)
+        if "wire_constants" not in text:
+            _err(findings, "wire-import-drift", rel,
+                 f"{rel} unpacks raw wire replies but no longer imports "
+                 "hetu_tpu/ps/wire_constants.py — its slot layout can "
+                 "drift silently")
+    magic = sorted({wire.SERVER_STATS_SLOTS, wire.CLIENT_STATS_SLOTS,
+                    wire.RESIZE_STATE_SLOTS, wire.WORLD_REPLY_SLOTS,
+                    wire.TRAIL_COLS, wire.CHAOS_EVENT_COLS,
+                    wire.SNAPSHOT_NOW_SLOTS})
+    pat = re.compile(
+        r"np\.zeros\(\s*(\d+)\s*,|np\.zeros\(\(\s*\w+\s*,\s*(\d+)\s*\)")
+    for rel in _ALL_CONSUMERS:
+        for i, line in enumerate(_read(root, rel, overlay).split("\n"), 1):
+            for m in pat.finditer(line):
+                n = int(m.group(1) or m.group(2))
+                if n in magic:
+                    _err(findings, "magic-number", f"{rel}:{i}",
+                         f"bare wire slot count {n} — size buffers from "
+                         "wire_constants field tuples so hetucheck can "
+                         "see drift", severity=WARN)
+
+
+def _check_mirror_pairs(findings, root, overlay):
+    for pysym, pyfile, cppsym, cppfile, testfile, anchors in _MIRROR_PAIRS:
+        pair = f"{pyfile}:{pysym} <-> {cppfile}:{cppsym}"
+        try:
+            pysrc = _read(root, pyfile, overlay)
+        except OSError:
+            pysrc = ""
+        try:
+            cppsrc = _read(root, cppfile, overlay)
+        except OSError:
+            cppsrc = ""
+        if not re.search(rf"def\s+{pysym}\s*\(", pysrc):
+            _err(findings, "mirror-pair-drift", pyfile,
+                 f"registered mirror pair {pair}: Python side "
+                 f"{pysym}() is gone — the C++ wire format has no "
+                 "bit-equality twin")
+            continue
+        if cppsym not in cppsrc:
+            _err(findings, "mirror-pair-drift", cppfile,
+                 f"registered mirror pair {pair}: C++ side {cppsym} is "
+                 "gone — the Python twin mirrors nothing")
+            continue
+        try:
+            tsrc = _read(root, testfile, overlay)
+        except OSError:
+            tsrc = ""
+        if not any(a in tsrc for a in anchors):
+            _err(findings, "mirror-pair-untested", testfile,
+                 f"mirror pair {pair} has no pinning reference (any of "
+                 f"{anchors}) in {testfile} — bit-equality can rot "
+                 "unseen", severity=WARN)
+
+
+def analyze_drift(root: str = ".", overlay: Optional[dict] = None
+                  ) -> List[Finding]:
+    """Run every drift check. ``overlay`` maps repo-relative paths to
+    replacement text (seeded-defect fixtures and tests)."""
+    findings: List[Finding] = []
+    net = _strip(_read(root, f"{CSRC}/net.h", overlay))
+    chaos = _strip(_read(root, f"{CSRC}/chaos.h", overlay))
+    store = _strip(_read(root, f"{CSRC}/store.h", overlay))
+
+    _diff_enum(findings, "net.h", parse_enum(net, "PsfType"), wire.PSF,
+               "PsfType")
+    at_names = ("kF32", "kI64", "kF64", "kBytes", "kI32", "kU64", "kQI8")
+    at_py = dict(zip(at_names, (wire.AT_F32, wire.AT_I64, wire.AT_F64,
+                                wire.AT_BYTES, wire.AT_I32, wire.AT_U64,
+                                wire.AT_QI8)))
+    _diff_enum(findings, "net.h", parse_enum(net, "ArgType"), at_py,
+               "ArgType")
+    _diff_enum(findings, "chaos.h", parse_enum(chaos, "ChaosKind"),
+               wire.CHAOS_KINDS, "ChaosKind")
+    _diff_enum(findings, "store.h", parse_enum(store, "OptType"),
+               wire.OPT_TYPES, "OptType")
+
+    _check_header_struct(findings, "net.h", net, "MsgHeader",
+                         wire.MSG_HDR_FIELDS, wire.MSG_HDR)
+    _check_header_struct(findings, "net.h", net, "ArgHeader",
+                         wire.ARG_HDR_FIELDS, wire.ARG_HDR)
+
+    for cname, want in (("kFlagQuantRsp", wire.FLAG_QUANT_RSP),
+                        ("kFlagCrc", wire.FLAG_CRC)):
+        got = parse_const(net, cname)
+        if got != want:
+            _err(findings, "wire-const-drift", "net.h",
+                 f"{cname} is {got} in net.h but wire_constants says "
+                 f"{want}")
+
+    _check_slot_counts(findings, root, overlay)
+    _check_dispatch(findings, root, overlay)
+    _check_capi(findings, root, overlay)
+    _check_unpackers(findings, root, overlay)
+    _check_mirror_pairs(findings, root, overlay)
+    return findings

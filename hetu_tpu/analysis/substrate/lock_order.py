@@ -1,0 +1,305 @@
+"""Lock-order analysis over the parsed header model (docs/ANALYSIS.md
+"Tier D: substrate").
+
+Per function we simulate the straight-line event stream from
+:mod:`cpp_model`: every acquisition taken while another lock is held adds a
+directed edge ``held -> new`` to the file's acquisition-order graph, and
+every intra-file call is expanded through the callee's (memoized,
+transitive) acquisition summary so the release-across-call pattern is
+modeled exactly — a lock dropped before ``handle()`` contributes no edge, a
+lock still held does. Graphs are per source file: server, worker, and
+scheduler are separate processes, so a server-side mutex can never deadlock
+against a worker-side one.
+
+Findings:
+
+- ``lock-order-cycle`` (error) — a cycle among distinct mutexes, reported
+  with a witness acquisition stack (function + file:line for each leg).
+  This is the ABBA that PR 16's pre-fix server shipped: dispatch held
+  ``ClientSlot::mu`` across ``handle()`` into ``take_snapshot`` (which
+  takes ``snap_take_mu_`` then walks slots) while the periodic
+  ``snapshot_loop`` took ``snap_take_mu_`` first.
+- ``lock-same-class-pair`` (note) — two locks with the same class label
+  held at once (``p->mu`` + ``lp->mu``). Not provably a deadlock (distinct
+  instances may be consistently ordered), so a note, not an error.
+- ``lock-across-blocking`` (warn) — a lock held across a known blocking
+  call (request dispatch, socket send/recv, snapshot IO).
+- ``atomic-mixed-guard`` (note) — an atomic member written both under a
+  lock and lock-free (or under different locks): either the lock is
+  superfluous or the lock-free site is a race with the guarded invariant.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import ERROR, NOTE, WARN, Finding
+from .cpp_model import CppFunction, CppModel
+
+PASS = "lock_order"
+
+# calls that block: request dispatch, socket IO, snapshot/trail file IO
+BLOCKING_CALLS = frozenset((
+    "handle", "send_msg", "recv_msg", "read_exact", "recv_exact",
+    "read_all", "write_all", "rpc", "rpc_once", "connect_fd",
+    "take_snapshot", "save_param_file", "trail_flush",
+))
+
+
+@dataclass(frozen=True)
+class Acq:
+    """One acquisition a function performs (transitively through calls)."""
+
+    label: str
+    site: str           # "file:line"
+    func: str           # qualified function name
+    chain: Tuple[str, ...] = ()   # call path, outermost first
+
+
+@dataclass
+class Edge:
+    held: Acq
+    taken: Acq
+
+    def stack(self) -> str:
+        via = "".join(f" -> {c}" for c in self.taken.chain)
+        return (f"{self.held.func} acquires {self.held.label} at "
+                f"{self.held.site}, then{via or ''} acquires "
+                f"{self.taken.label} at {self.taken.site}")
+
+
+def _summaries(model: CppModel, file: str) -> Dict[str, List[Acq]]:
+    """func name -> every acquisition it performs, transitively through
+    intra-file calls (recursion-guarded, memoized)."""
+    memo: Dict[str, List[Acq]] = {}
+    in_progress: Set[str] = set()
+
+    def summary(fn: CppFunction) -> List[Acq]:
+        if fn.name in memo:
+            return memo[fn.name]
+        if fn.name in in_progress:      # recursion: no new info on this path
+            return []
+        in_progress.add(fn.name)
+        acqs: List[Acq] = []
+        for ev in fn.events:
+            if ev.kind == "acquire":
+                acqs.append(Acq(ev.name, f"{file}:{ev.line}", fn.qualname))
+            elif ev.kind == "call":
+                callee = model.functions.get((file, ev.name))
+                if callee is None:
+                    continue
+                frame = f"{callee.qualname}() [called at {file}:{ev.line}]"
+                for a in summary(callee):
+                    acqs.append(Acq(a.label, a.site, a.func,
+                                    (frame,) + a.chain))
+        in_progress.discard(fn.name)
+        memo[fn.name] = acqs
+        return acqs
+
+    for fn in model.functions.values():
+        if fn.file == file:
+            summary(fn)
+    return memo
+
+
+def _simulate(model: CppModel, file: str,
+              summaries: Dict[str, List[Acq]]):
+    """Walk every function with an empty entry lock set; produce order
+    edges, blocking-call warns, and atomic write-site guard sets."""
+    edges: Dict[Tuple[str, str], List[Edge]] = {}
+    blocking: List[Tuple[Acq, str, str]] = []      # (held, callee, site)
+    atomic_writes: Dict[str, Set[frozenset]] = {}
+
+    for fn in model.functions.values():
+        if fn.file != file:
+            continue
+        held: List[Acq] = []
+        for ev in fn.events:
+            if ev.kind == "acquire":
+                new = Acq(ev.name, f"{file}:{ev.line}", fn.qualname)
+                for h in held:
+                    # same-label edges kept: they feed lock-same-class-pair
+                    edges.setdefault((h.label, new.label), []).append(
+                        Edge(h, new))
+                held.append(new)
+            elif ev.kind == "release":
+                for idx in range(len(held) - 1, -1, -1):
+                    if held[idx].label == ev.name:
+                        held.pop(idx)
+                        break
+            elif ev.kind == "call":
+                if held and ev.name in BLOCKING_CALLS:
+                    for h in held:
+                        blocking.append((h, ev.name, f"{file}:{ev.line}"))
+                if (file, ev.name) not in model.functions:
+                    continue
+                for a in summaries.get(ev.name, []):
+                    callee = model.functions.get((file, ev.name))
+                    frame = (f"{callee.qualname}() [called at "
+                             f"{file}:{ev.line}]") if callee else ev.name
+                    taken = Acq(a.label, a.site, a.func,
+                                (frame,) + a.chain)
+                    for h in held:
+                        edges.setdefault((h.label, a.label), []).append(
+                            Edge(h, taken))
+            elif ev.kind == "atomic_write":
+                key = frozenset(h.label for h in held)
+                atomic_writes.setdefault(ev.name, set()).add(key)
+    return edges, blocking, atomic_writes
+
+
+def _find_cycles(labels: Set[str],
+                 edges: Dict[Tuple[str, str], List[Edge]]):
+    """Tarjan SCCs over distinct-label edges; one representative cycle per
+    non-trivial SCC (DFS inside the component)."""
+    adj: Dict[str, Set[str]] = {l: set() for l in labels}
+    for (a, b) in edges:
+        if a != b:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on_stack: Set[str] = set()
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(adj[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp_set = set(comp)
+        start = sorted(comp)[0]
+        # DFS for one simple cycle through `start` within the SCC
+        path = [start]
+        seen = {start}
+
+        def dfs(v: str) -> Optional[List[str]]:
+            for w in sorted(adj[v]):
+                if w == start and len(path) >= 2:
+                    return list(path)
+                if w in comp_set and w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    got = dfs(w)
+                    if got:
+                        return got
+                    path.pop()
+                    seen.discard(w)
+            return None
+
+        cyc = dfs(start)
+        if cyc:
+            cycles.append(cyc)
+        else:   # 2-cycle fallback
+            for w in sorted(adj[start]):
+                if w in comp_set and start in adj[w]:
+                    cycles.append([start, w])
+                    break
+    return cycles
+
+
+def analyze_locks(model: CppModel) -> List[Finding]:
+    findings: List[Finding] = []
+    files = sorted({fn.file for fn in model.functions.values()})
+    for file in files:
+        summaries = _summaries(model, file)
+        edges, blocking, atomic_writes = _simulate(model, file, summaries)
+        labels = {l for pair in edges for l in pair}
+
+        # distinct-mutex order cycles -> error, with both witness stacks
+        for cyc in _find_cycles(labels, edges):
+            legs = []
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                wit = edges.get((a, b))
+                if wit:
+                    legs.append(wit[0].stack())
+            order = " -> ".join(cyc + [cyc[0]])
+            findings.append(Finding(
+                lint="lock-order-cycle", severity=ERROR,
+                message=(f"lock acquisition-order cycle {order}; "
+                         + "; meanwhile ".join(legs)
+                         + " — two threads interleaving these paths "
+                           "deadlock (ABBA)"),
+                op_name=file, pass_name=PASS))
+
+        # same-class pairs (p->mu with lp->mu) -> note
+        seen_pairs = set()
+        for (a, b), wits in sorted(edges.items()):
+            if a == b and (file, a) not in seen_pairs:
+                seen_pairs.add((file, a))
+                findings.append(Finding(
+                    lint="lock-same-class-pair", severity=NOTE,
+                    message=(f"two {a} instances held at once "
+                             f"({wits[0].stack()}) — safe only if every "
+                             "such site orders the instances consistently"),
+                    op_name=wits[0].taken.site, pass_name=PASS))
+
+        seen_block = set()
+        for h, callee, site in blocking:
+            key = (h.label, callee, h.func)
+            if key in seen_block:
+                continue
+            seen_block.add(key)
+            findings.append(Finding(
+                lint="lock-across-blocking", severity=WARN,
+                message=(f"{h.func} holds {h.label} (acquired "
+                         f"{h.site}) across blocking call {callee}() at "
+                         f"{site} — a stalled peer extends the critical "
+                         "section indefinitely"),
+                op_name=site, pass_name=PASS))
+
+        for label, guard_sets in sorted(atomic_writes.items()):
+            if len(guard_sets) > 1 and frozenset() in guard_sets:
+                locked = sorted(", ".join(sorted(s))
+                                for s in guard_sets if s)
+                findings.append(Finding(
+                    lint="atomic-mixed-guard", severity=NOTE,
+                    message=(f"atomic {label} written both lock-free and "
+                             f"under {{{locked[0]}}} — if the guarded site "
+                             "maintains an invariant with other state, the "
+                             "lock-free write races it"),
+                    op_name=file, pass_name=PASS))
+    return findings

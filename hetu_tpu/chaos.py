@@ -34,15 +34,19 @@ from typing import Optional
 
 import numpy as np
 
+from . import faults
+from .ps import wire_constants as wire
+
 # ---------------------------------------------------------------------------
 # Kind ids: the drain contract with csrc/ps/chaos.h ChaosKind
+# (wire_constants.CHAOS_KINDS is the enum mirror hetucheck verifies)
 # ---------------------------------------------------------------------------
 
-KIND_NAMES = {1: "drop", 2: "delay", 3: "dup", 4: "reorder", 5: "corrupt",
-              6: "partition", 7: "droprsp"}
+KIND_NAMES = {v: k[1:].lower() for k, v in wire.CHAOS_KINDS.items()
+              if v != 0}  # {1: "drop", ..., 7: "droprsp"}
 KIND_IDS = {v: k for k, v in KIND_NAMES.items()}
 # columns of one drained chaos event row (PSClient.DrainChaosEvents)
-EVENT_COLS = ("kind", "server", "psf", "tensor", "seq", "arg")
+EVENT_COLS = wire.CHAOS_EVENT_FIELDS
 
 _MASK64 = (1 << 64) - 1
 
@@ -100,7 +104,8 @@ class ChaosSpec:
     partitions: list = field(default_factory=list)  # [(server, from, count)]
 
 
-_PROB_KEYS = ("drop", "droprsp", "dup", "corrupt")
+# grammar vocabulary owned by the shared fault registry (hetu_tpu.faults)
+_PROB_KEYS = faults.CHAOS_PROB_KEYS
 
 
 def parse_spec(spec: str) -> ChaosSpec:
@@ -134,9 +139,7 @@ def parse_spec(spec: str) -> ChaosSpec:
         else:
             raise ValueError(
                 f"chaos spec entry {ent!r}: unknown kind {key!r} — known: "
-                "seed, drop, droprsp, dup, corrupt, delay[:ms], "
-                "reorder[:ms], partition=SERVER:FROM:COUNT "
-                "(docs/FAULT_TOLERANCE.md)")
+                + faults.chaos_catalogue())
     return cs
 
 

@@ -55,30 +55,34 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-# ONE wire mirror of csrc/ps/net.h for the whole Python control plane: the
-# supervisor owns the header structs + recv loop (it predates this module
-# and stays stdlib-only); this module reuses them rather than growing a
-# second copy that could drift. MsgHeader is 32 bytes; the last i32 is the
-# hetu-elastic world-version stamp (0 = unversioned).
+# ONE wire mirror of csrc/ps/net.h for the whole Python control plane:
+# ps.wire_constants owns the header structs, PsfType/ArgType values and
+# every reply slot layout (bin/hetucheck asserts it against the C++
+# headers); the supervisor owns the recv loop. This module reuses both
+# rather than growing copies that could drift. MsgHeader is 32 bytes; the
+# last i32 is the hetu-elastic world-version stamp (0 = unversioned).
+from .ps import wire_constants as wire
 from .ps.supervisor import (SchedulerUnreachable, _ARG_HDR, _MSG_HDR,
                             _recv_exact as _recv_exact_sock)
 
-# PsfType values (net.h)
-K_QUERY_SERVERS = 6
-K_SERVER_STATS = 7
-K_PARAM_SAVE = 32
-K_PARAM_LOAD = 33
-K_PROPOSE_RESIZE = 60
-K_RESIZE_STATE = 61
-K_COMMIT_RESIZE = 62
-K_FINISH_RESIZE = 63
-K_RESIZE_LOG = 64
-K_LIST_PARAMS = 65
-K_SET_WORLD_VERSION = 66
-K_SNAPSHOT_NOW = 67
+# PsfType values (net.h via wire_constants)
+K_QUERY_SERVERS = wire.K_QUERY_SERVERS
+K_SERVER_STATS = wire.K_SERVER_STATS
+K_PARAM_SAVE = wire.K_PARAM_SAVE
+K_PARAM_LOAD = wire.K_PARAM_LOAD
+K_PROPOSE_RESIZE = wire.K_PROPOSE_RESIZE
+K_RESIZE_STATE = wire.K_RESIZE_STATE
+K_COMMIT_RESIZE = wire.K_COMMIT_RESIZE
+K_FINISH_RESIZE = wire.K_FINISH_RESIZE
+K_RESIZE_LOG = wire.K_RESIZE_LOG
+K_LIST_PARAMS = wire.K_LIST_PARAMS
+K_SET_WORLD_VERSION = wire.K_SET_WORLD_VERSION
+K_SNAPSHOT_NOW = wire.K_SNAPSHOT_NOW
 
-# ArgType values (net.h)
-_AT_F32, _AT_I64, _AT_F64, _AT_BYTES, _AT_I32, _AT_U64 = 0, 1, 2, 3, 4, 5
+# ArgType values (net.h via wire_constants)
+_AT_F32, _AT_I64, _AT_F64, _AT_BYTES, _AT_I32, _AT_U64 = (
+    wire.AT_F32, wire.AT_I64, wire.AT_F64, wire.AT_BYTES, wire.AT_I32,
+    wire.AT_U64)
 
 
 def _arg_bytes(dtype: int, payload: bytes) -> bytes:
@@ -183,16 +187,23 @@ def resize_state(host, port, timeout: float = 5.0) -> dict:
     _, out = _rpc(host, port, K_RESIZE_STATE, timeout=timeout)
     v = _i64s(out[0])
     members = _i32s(out[1]).tolist() if len(out) > 1 else []
-    state = {"world_version": int(v[0]), "pending_version": int(v[1]),
-             "n_workers": int(v[2]), "n_servers": int(v[3]),
-             "pending_n_workers": int(v[4]), "pending_n_servers": int(v[5]),
-             "drain_count": int(v[6]), "drain_needed": int(v[7]),
-             "new_servers_ready": bool(v[8]), "members": members}
-    if len(v) > 10:
+    # slot 10 (snapshot_epochs) is a suffix extension — accept the 10-slot
+    # prefix a pre-hetusave scheduler replies with
+    raw = wire.unpack_fields(wire.RESIZE_STATE_FIELDS[:-1], v)
+    state = {"world_version": raw["world_version"],
+             "pending_version": raw["pending_version"],
+             "n_workers": raw["num_workers"], "n_servers": raw["num_servers"],
+             "pending_n_workers": raw["pending_nw"],
+             "pending_n_servers": raw["pending_ns"],
+             "drain_count": raw["drained"], "drain_needed": raw["survivors"],
+             "new_servers_ready": bool(raw["new_servers_ready"]),
+             "members": members}
+    if len(v) >= wire.RESIZE_STATE_SLOTS:
         # hetusave suffix extension: completed coordinated-snapshot epochs
         # this scheduler incarnation (snapshot-tagged finish_resize aborts
         # only — the coordinator tags after its job manifest committed)
-        state["snapshot_epochs"] = int(v[10])
+        state["snapshot_epochs"] = int(
+            v[wire.RESIZE_STATE_FIELDS.index("snapshot_epochs")])
     return state
 
 
@@ -205,10 +216,10 @@ def commit_resize(host, port, rank: int, step: int,
     _, out = _rpc(host, port, K_COMMIT_RESIZE,
                   [_arg_i32([1, int(rank)]), _arg_i64([int(step)])],
                   timeout=timeout)
-    v = _i64s(out[0])
-    return {"world_version": int(v[0]), "n_workers": int(v[1]),
-            "n_servers": int(v[2]), "dp_rank": int(v[3]),
-            "start_step": int(v[4]),
+    w = wire.unpack_fields(wire.WORLD_REPLY_FIELDS, _i64s(out[0]))
+    return {"world_version": w["world_version"],
+            "n_workers": w["num_workers"], "n_servers": w["num_servers"],
+            "dp_rank": w["dp_rank"], "start_step": w["start_step"],
             "members": _i32s(out[1]).tolist() if len(out) > 1 else [],
             "book": out[2].decode() if len(out) > 2 else ""}
 
@@ -264,9 +275,10 @@ def server_list_params(addr: str) -> list[dict]:
     host, port = _split_addr(addr)
     _, out = _rpc(host, port, K_LIST_PARAMS, who=f"ps server {addr}")
     v = _i64s(out[0])
+    stride = wire.LIST_PARAMS_STRIDE
     return [{"key": int(v[i]), "kind": int(v[i + 1]), "rows": int(v[i + 2]),
              "width": int(v[i + 3]), "otype": int(v[i + 4])}
-            for i in range(0, len(v), 5)]
+            for i in range(0, len(v), stride)]
 
 
 def server_param_save(addr: str, key: int, directory: str) -> None:
@@ -295,11 +307,10 @@ def server_set_world(addr: str, version: int) -> None:
 
 
 def server_stats_raw(addr: str, timeout: float = 3.0) -> list[int]:
-    """kServerStats over a raw socket (no native lib): the 11 HA/health
-    slots — [updates, snapshot_updates, restored_updates, snapshot_version,
-    n_params, requests, apply_ns, apply_count, snapshot_age_ms,
-    dedup_clients, crc_rejects]. The jax-free twin of
-    ``PSClient.ServerStats`` for supervisor-side scale policies."""
+    """kServerStats over a raw socket (no native lib): the HA/health
+    slots in ``wire_constants.SERVER_STATS_FIELDS`` order. The jax-free
+    twin of ``PSClient.ServerStats`` for supervisor-side scale
+    policies."""
     host, port = _split_addr(addr)
     _, out = _rpc(host, port, K_SERVER_STATS, timeout=timeout,
                   who=f"ps server {addr}")
@@ -317,9 +328,7 @@ def server_snapshot_now(addr: str, epoch: int = -1,
     host, port = _split_addr(addr)
     _, out = _rpc(host, port, K_SNAPSHOT_NOW, [_arg_i64([int(epoch)])],
                   timeout=timeout, who=f"ps server {addr}")
-    v = _i64s(out[0])
-    return {"version": int(v[0]), "counter": int(v[1]),
-            "updates": int(v[2]), "epoch": int(v[3])}
+    return wire.unpack_fields(wire.SNAPSHOT_NOW_FIELDS, _i64s(out[0]))
 
 
 def _rpc_with_tensor(addr: str, msg_type: int, tensor_id: int,
@@ -335,10 +344,10 @@ def _rpc_with_tensor(addr: str, msg_type: int, tensor_id: int,
 # v2 shard format IO (csrc/ps/server.h save_param_file / load_param_file)
 # ---------------------------------------------------------------------------
 
-_SHARD_MAGIC_V2 = -2
+_SHARD_MAGIC_V2 = wire.SHARD_MAGIC_V2
 # accum/accum2 sizing per OptType (store.h alloc_slots): sgd none,
 # momentum/nesterov/adagrad one slot, adam two
-_SLOT_COUNTS = {0: 0, 1: 1, 2: 1, 3: 1, 4: 2}
+_SLOT_COUNTS = wire.OPT_SLOT_COUNTS
 
 
 def read_v2_shard(path: str) -> dict:
@@ -346,8 +355,8 @@ def read_v2_shard(path: str) -> dict:
     {MAGIC(-2), kind, rows|len, width, otype, step, n_lrs, n_versions},
     f32 lrs[], f32 data[], f32 accum[], f32 accum2[], i64 versions[]."""
     with open(path, "rb") as f:
-        meta = np.fromfile(f, np.int64, 8)
-        if meta.size != 8 or meta[0] != _SHARD_MAGIC_V2:
+        meta = np.fromfile(f, np.int64, wire.SHARD_META_LEN)
+        if meta.size != wire.SHARD_META_LEN or meta[0] != _SHARD_MAGIC_V2:
             raise ValueError(f"{path}: not a v2 shard file")
         kind, n0, width, otype, step, n_lrs, n_ver = (
             int(meta[1]), int(meta[2]), int(meta[3]), int(meta[4]),

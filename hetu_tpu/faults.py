@@ -1,0 +1,94 @@
+"""The ONE fault-kind registry behind every fault grammar in the stack.
+
+Three parsers used to carry their own copies of the catalogue — the
+step-boundary injector (``resilience.FaultInjector``, driving the elastic
+``worker_lost``/``ps_join`` transitions too), the message-level chaos
+grammar (``chaos.parse_spec``, mirrored bit-for-bit by the C++ parser in
+csrc/ps/chaos.h), and the coordinated-snapshot phase grammar
+(``recovery.PHASES``). A kind added to one copy but not the others was a
+silent no-op in the places that mattered. Now each parser imports its
+vocabulary from here and rejects unknown entries with the shared
+catalogue message; ``bin/hetucheck`` (docs/ANALYSIS.md, Tier D) asserts
+this registry, the three parsers, the C++ chaos grammar and the
+docs/FAULT_TOLERANCE.md fault-kind catalogue all agree.
+
+jax-free on purpose: hetucheck imports this under plain CPython in CI.
+"""
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Step-boundary kinds: HETU_FAULT_SPEC="kind@step[:arg],..." — the
+# resilience.FaultInjector grammar. ``arg`` names how the optional
+# suffix parses: a number, an op name (nan_op), or a snapshot phase
+# (job_kill). Each kind's one-line role mirrors its row in the
+# docs/FAULT_TOLERANCE.md "Fault-kind catalogue" table.
+STEP_FAULT_KINDS = {
+    "nan_grads":     {"arg": "float", "exercises": "anomaly guard"},
+    "nan_op":        {"arg": "opname", "exercises": "hetuscope provenance"},
+    "stall":         {"arg": "float", "exercises": "hang watchdog"},
+    "sigterm":       {"arg": "float", "exercises": "preemption (exit 75)"},
+    "sigint":        {"arg": "float", "exercises": "preemption (exit 75)"},
+    "crash":         {"arg": "float", "exercises": "supervise() restarts"},
+    "ps_kill":       {"arg": "float",
+                      "exercises": "PS snapshot/respawn/failover"},
+    "quant_corrupt": {"arg": "float",
+                      "exercises": "server payload validation"},
+    "worker_lost":   {"arg": "float", "exercises": "elastic scale-down"},
+    "ps_join":       {"arg": "float", "exercises": "live key-range migration"},
+    "ps_slow":       {"arg": "float", "exercises": "hetutrail attribution"},
+    "ps_partition":  {"arg": "float", "exercises": "retry-with-backoff"},
+    "job_kill":      {"arg": "phase", "exercises": "hetusave epochs"},
+}
+STEP_FAULT_NAMES = tuple(STEP_FAULT_KINDS)
+
+# Coordinated-snapshot crash phases (recovery.take_job_snapshot): the
+# job_kill arg vocabulary, in snapshot-protocol order.
+JOB_KILL_PHASES = ("pre_barrier", "server_write", "pre_commit",
+                   "post_commit")
+
+# ---------------------------------------------------------------------------
+# Message-level chaos grammar: HETU_CHAOS_SPEC="key=value,..." — the
+# chaos.parse_spec grammar, mirrored by hetups::ChaosEngine::parse in
+# csrc/ps/chaos.h (the round-trip test pins the two parsers together).
+CHAOS_PROB_KEYS = ("drop", "droprsp", "dup", "corrupt")
+CHAOS_SPEC_KEYS = {
+    "seed": "U64", "drop": "P", "droprsp": "P", "dup": "P", "corrupt": "P",
+    "delay": "P[:MAX_MS]", "reorder": "P[:MAX_MS]",
+    "partition": "SERVER:FROM:COUNT",
+}
+
+CATALOGUE_DOC = "docs/FAULT_TOLERANCE.md"
+
+
+def chaos_catalogue() -> str:
+    """The known-kinds line chaos.parse_spec rejects with."""
+    return ("seed, drop, droprsp, dup, corrupt, delay[:ms], reorder[:ms], "
+            f"partition=SERVER:FROM:COUNT ({CATALOGUE_DOC})")
+
+
+def parse_step_entry(part: str) -> dict:
+    """Parse one ``kind@step[:arg]`` entry against the registry, rejecting
+    unknown kinds (and invalid job_kill phases) with the catalogue. Returns
+    ``{"kind", "step", "arg"}``."""
+    kind, sep, rest = part.partition("@")
+    kind = kind.strip()
+    if not sep or kind not in STEP_FAULT_KINDS:
+        raise ValueError(
+            f"bad fault entry {part!r}: expected kind@step[:arg] with "
+            f"kind in {STEP_FAULT_NAMES} — see the fault-kind catalogue in "
+            f"{CATALOGUE_DOC}")
+    step_s, _, arg_s = rest.partition(":")
+    arg = None
+    if arg_s:
+        arg_form = STEP_FAULT_KINDS[kind]["arg"]
+        if arg_form == "phase":
+            if arg_s not in JOB_KILL_PHASES:
+                raise ValueError(
+                    f"bad fault entry {part!r}: job_kill phase {arg_s!r} "
+                    f"not in {JOB_KILL_PHASES}")
+            arg = arg_s
+        elif arg_form == "opname":
+            arg = arg_s
+        else:
+            arg = float(arg_s)
+    return {"kind": kind, "step": int(step_s), "arg": arg}

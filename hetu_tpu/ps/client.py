@@ -16,6 +16,7 @@ import sys
 
 import numpy as np
 
+from . import wire_constants as wire
 from ..csrc.build import build
 
 _f32p = ctypes.POINTER(ctypes.c_float)
@@ -144,21 +145,17 @@ class PSClient:
         testing & transport hardening"). After a recovery,
         ``acked-before-death updates - restored_updates`` is exactly how
         many updates that shard lost."""
-        out = np.zeros(11, np.int64)
+        out = np.zeros(wire.SERVER_STATS_SLOTS, np.int64)
         self._lib.QueryServerStats(ctypes.c_int(int(server)),
                                    out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(11))
+                                   ctypes.c_int(wire.SERVER_STATS_SLOTS))
         self._check()
-        apply_cnt = int(out[7])
-        return {"updates": int(out[0]), "snapshot_updates": int(out[1]),
-                "restored_updates": int(out[2]),
-                "snapshot_version": int(out[3]), "n_params": int(out[4]),
-                "requests": int(out[5]),
-                "apply_ms_avg": (round(int(out[6]) / apply_cnt / 1e6, 6)
-                                 if apply_cnt else None),
-                "snapshot_age_ms": int(out[8]),
-                "dedup_clients": int(out[9]),
-                "crc_rejects": int(out[10])}
+        raw = wire.unpack_fields(wire.SERVER_STATS_FIELDS, out)
+        # surface the apply latency as a derived mean, not raw ns slots
+        apply_ns, apply_cnt = raw.pop("apply_ns"), raw.pop("apply_count")
+        raw["apply_ms_avg"] = (round(apply_ns / apply_cnt / 1e6, 6)
+                               if apply_cnt else None)
+        return raw
 
     def ClientStats(self) -> dict:
         """This worker's RPC counters: round trips issued, fast-retry
@@ -175,17 +172,11 @@ class PSClient:
         many retries it took, so with a fresh single-worker cluster it
         equals the servers' summed update counters EXACTLY (the
         no-double-apply accounting invariant ``hetu_tpu.chaos`` checks)."""
-        out = np.zeros(10, np.int64)
+        out = np.zeros(wire.CLIENT_STATS_SLOTS, np.int64)
         self._lib.QueryClientStats(out.ctypes.data_as(_i64p),
-                                   ctypes.c_int(10))
+                                   ctypes.c_int(wire.CLIENT_STATS_SLOTS))
         self._check()
-        return {"rpcs": int(out[0]), "retries": int(out[1]),
-                "failovers": int(out[2]),
-                "quant_raw_bytes": int(out[3]),
-                "quant_wire_bytes": int(out[4]),
-                "timeouts": int(out[5]), "backoff_ms": int(out[6]),
-                "crc_rejects": int(out[7]), "chaos_faults": int(out[8]),
-                "pushes_ok": int(out[9])}
+        return wire.unpack_fields(wire.CLIENT_STATS_FIELDS, out)
 
     def SetWorldVersion(self, version):
         """hetu-elastic: stamp this worker's committed membership epoch
@@ -252,7 +243,7 @@ class PSClient:
         ``chaos.EVENT_COLS``: kind, server, psf, tensor, seq, arg. The
         array is a fresh copy (unlike the reused trail buffer) — chaos is
         a test-mode surface, not a hot path."""
-        buf = np.zeros((int(max_rows), 6), np.int64)
+        buf = np.zeros((int(max_rows), wire.CHAOS_EVENT_COLS), np.int64)
         n = self._lib.DrainChaosEvents(buf.ctypes.data_as(_i64p),
                                        ctypes.c_int(int(max_rows)))
         self._check()
@@ -283,7 +274,8 @@ class PSClient:
         before the next drain."""
         buf = self._trail_buf
         if buf is None or buf.shape[0] < int(max_rows):
-            buf = self._trail_buf = np.zeros((int(max_rows), 10), np.int64)
+            buf = self._trail_buf = np.zeros(
+                (int(max_rows), wire.TRAIL_COLS), np.int64)
         n = self._lib.DrainTrailSpans(buf.ctypes.data_as(_i64p),
                                       ctypes.c_int(int(max_rows)))
         self._check()
@@ -307,14 +299,13 @@ class PSClient:
         echoed ``epoch``. A production checkpoint primitive — not
         test-gated (docs/FAULT_TOLERANCE.md "Coordinated job
         snapshots")."""
-        out = np.zeros(4, np.int64)
+        out = np.zeros(wire.SNAPSHOT_NOW_SLOTS, np.int64)
         self._lib.ServerSnapshotNow(ctypes.c_int(int(server)),
                                     ctypes.c_longlong(int(epoch)),
                                     out.ctypes.data_as(_i64p),
-                                    ctypes.c_int(4))
+                                    ctypes.c_int(wire.SNAPSHOT_NOW_SLOTS))
         self._check()
-        return {"version": int(out[0]), "counter": int(out[1]),
-                "updates": int(out[2]), "epoch": int(out[3])}
+        return wire.unpack_fields(wire.SNAPSHOT_NOW_FIELDS, out)
 
     def TestSlowApply(self, server=0, ms=100):
         """Test hook (requires HETU_TEST_MODE): delay PS server ``server``'s

@@ -27,14 +27,18 @@ import sys
 import threading
 import time
 
+from . import wire_constants as wire
+
 # Wire format mirror of csrc/ps/net.h (host byte order, same-arch cluster —
 # the same assumption the native van makes). MsgHeader is 32 bytes with no
-# implicit padding; ArgHeader is 16.
-_MSG_HDR = struct.Struct("<iiQiiii")  # type, tensor_id, req_id, n_args,
+# implicit padding; ArgHeader is 16. The structs live in wire_constants
+# (the ONE Python mirror, hetucheck-verified); the historical _MSG_HDR /
+# _ARG_HDR names stay because elastic.py and tests import them from here.
+_MSG_HDR = wire.MSG_HDR               # type, tensor_id, req_id, n_args,
 #                                       flags, client_id, world_ver (0 =
 #                                       unversioned; hetu-elastic stamp)
-_ARG_HDR = struct.Struct("<iiQ")      # dtype, pad, nbytes
-_K_QUERY_SERVERS = 6
+_ARG_HDR = wire.ARG_HDR               # dtype, pad/crc, nbytes
+_K_QUERY_SERVERS = wire.K_QUERY_SERVERS
 
 
 class SchedulerUnreachable(ConnectionError):

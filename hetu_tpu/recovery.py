@@ -47,15 +47,18 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from . import faults
+
 #: the crash windows of one coordinated snapshot, in protocol order —
-#: the ``job_kill@step:phase`` fault kind targets exactly these
-#: (resilience.FaultInjector validates against this tuple):
+#: the ``job_kill@step:phase`` fault kind targets exactly these, and the
+#: shared fault registry (hetu_tpu.faults) owns the tuple so the injector
+#: grammar and this module can never disagree:
 #:   pre_barrier   before the quiesce barrier is even proposed
 #:   server_write  after the FIRST server snapshot landed (torn epoch:
 #:                 some servers newer than others, no manifest)
 #:   pre_commit    all state written, job manifest NOT yet committed
 #:   post_commit   manifest committed (the epoch must be restorable)
-PHASES = ("pre_barrier", "server_write", "pre_commit", "post_commit")
+PHASES = faults.JOB_KILL_PHASES
 
 MANIFEST_FORMAT = 1
 _MANIFEST_PREFIX = "job_epoch_"

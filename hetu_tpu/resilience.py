@@ -47,6 +47,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from . import faults
+
 # Distinct exit codes so a process supervisor (heturun, k8s, the operator)
 # can tell the exits apart without parsing logs:
 #   EXIT_PREEMPTED — clean preemption: emergency checkpoint written, do NOT
@@ -186,9 +188,9 @@ class FaultInjector:
     explicit opt-in for tests.
     """
 
-    KINDS = ("nan_grads", "nan_op", "stall", "sigterm", "sigint", "crash",
-             "ps_kill", "quant_corrupt", "worker_lost", "ps_join",
-             "ps_slow", "ps_partition", "job_kill")
+    # the shared registry (hetu_tpu.faults) owns the catalogue; kept as a
+    # class attribute for the tests and docs that enumerate kinds here
+    KINDS = faults.STEP_FAULT_NAMES
 
     def __init__(self, spec: str):
         self.entries: list[dict] = []
@@ -196,31 +198,12 @@ class FaultInjector:
             part = part.strip()
             if not part:
                 continue
-            kind, sep, rest = part.partition("@")
-            kind = kind.strip()
-            if not sep or kind not in self.KINDS:
-                raise ValueError(
-                    f"bad fault entry {part!r}: expected kind@step[:arg] with "
-                    f"kind in {self.KINDS} — see the fault-kind catalogue in "
-                    f"docs/FAULT_TOLERANCE.md")
-            step_s, _, arg_s = rest.partition(":")
             # nan_op's arg is an OP NAME, job_kill's a snapshot PHASE,
-            # every other kind's a number
-            arg = None
-            if arg_s:
-                if kind == "job_kill":
-                    from .recovery import PHASES
-                    if arg_s not in PHASES:
-                        raise ValueError(
-                            f"bad fault entry {part!r}: job_kill phase "
-                            f"{arg_s!r} not in {PHASES}")
-                    arg = arg_s
-                else:
-                    arg = arg_s if kind == "nan_op" else float(arg_s)
-            self.entries.append({
-                "kind": kind, "step": int(step_s),
-                "arg": arg, "fired": False,
-            })
+            # every other kind's a number — faults.parse_step_entry
+            # rejects unknown kinds/phases with the shared catalogue
+            entry = faults.parse_step_entry(part)
+            entry["fired"] = False
+            self.entries.append(entry)
 
     @classmethod
     def from_env(cls) -> Optional["FaultInjector"]:

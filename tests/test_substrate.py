@@ -1,0 +1,294 @@
+"""hetucheck (Tier D substrate analysis): seeded-defect tests — one per
+check family, each asserting the lint fires on a counterfactual tree and
+stays silent on the shipped one — plus the `bin/hetucheck` CLI smoke that
+doubles as the tier-1 guard that the working tree is drift-free.
+
+The flagship fixtures reproduce real history: the pre-fix PR 16 ABBA
+deadlock (dispatch held ClientSlot::mu across handle() into take_snapshot,
+which takes PsServer::snap_take_mu_ then re-locks slots) must be detected
+with both mutexes and both acquisition sites named, and a kServerStats
+slot-count change must be caught before any Python unpacker mis-slices."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hetu_tpu import faults
+from hetu_tpu.analysis.substrate import (analyze_drift, analyze_locks,
+                                         analyze_surface, build_model)
+from hetu_tpu.analysis.substrate import cli as subcli
+from hetu_tpu.ps import wire_constants as wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVER_H = "hetu_tpu/csrc/ps/server.h"
+
+
+def lints_of(findings, lint):
+    return [f for f in findings if f.lint == lint]
+
+
+def read(rel):
+    with open(os.path.join(REPO, rel), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+# --------------------------------------------------------------------------
+# lock-order family
+
+
+def test_abba_fixture_detected_with_both_mutexes_and_sites():
+    """PR 16's pre-fix deadlock: the cycle error must name BOTH mutexes
+    and BOTH acquisition sites so the report is actionable."""
+    model = build_model([("fixture/server_prefix.h", subcli._ABBA_FIXTURE)])
+    cycles = lints_of(analyze_locks(model), "lock-order-cycle")
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert cycles[0].severity == "error"
+    assert "ClientSlot::mu" in msg
+    assert "PsServer::snap_take_mu_" in msg
+    assert msg.count("server_prefix.h:") >= 2          # both sites
+    assert "take_snapshot" in msg and "serve_conn" in msg
+
+
+def test_release_across_call_fixture_is_clean():
+    """The shipped fix (drop the slot lock before handle()) must NOT be
+    flagged — the analyzer models the release-across-call pattern."""
+    model = build_model([("fixture/server_fixed.h", subcli._FIXED_FIXTURE)])
+    assert not lints_of(analyze_locks(model), "lock-order-cycle")
+
+
+_BLOCKING_FIXTURE = """
+#include <mutex>
+class Conn {
+ public:
+  void send(int fd) {
+    std::lock_guard<std::mutex> g(send_mu_);
+    send_msg(fd);
+  }
+ private:
+  std::mutex send_mu_;
+};
+"""
+
+
+def test_lock_across_blocking_fixture():
+    model = build_model([("fixture/conn.h", _BLOCKING_FIXTURE)])
+    warns = lints_of(analyze_locks(model), "lock-across-blocking")
+    assert len(warns) == 1
+    assert "Conn::send_mu_" in warns[0].message
+    assert "send_msg" in warns[0].message
+
+
+_ATOMIC_FIXTURE = """
+#include <atomic>
+#include <mutex>
+class Store {
+ public:
+  void bump_unlocked() {
+    version_ = 1;
+  }
+  void bump_locked() {
+    std::lock_guard<std::mutex> g(mu_);
+    version_ = 2;
+  }
+ private:
+  std::mutex mu_;
+  std::atomic<long> version_{0};
+};
+"""
+
+
+def test_atomic_mixed_guard_fixture():
+    model = build_model([("fixture/store.h", _ATOMIC_FIXTURE)])
+    notes = lints_of(analyze_locks(model), "atomic-mixed-guard")
+    assert len(notes) == 1
+    assert "Store::version_" in notes[0].message
+
+
+def test_shipped_headers_have_no_lock_order_cycle():
+    """The post-PR16 tree must be deadlock-free under the analyzer."""
+    paths = [os.path.join(REPO, h) for h in subcli.HEADERS]
+    model = build_model(paths)
+    assert not lints_of(analyze_locks(model), "lock-order-cycle")
+
+
+# --------------------------------------------------------------------------
+# cross-language drift family (all via overlay — disk is never touched)
+
+
+def test_slot_count_drift_fixture():
+    """Growing kServerStats by one slot in C++ must fail the mirror."""
+    text = read(SERVER_H)
+    assert "int64_t stats[11]" in text
+    overlay = {SERVER_H: text.replace("int64_t stats[11]",
+                                      "int64_t stats[12]")}
+    errs = lints_of(analyze_drift(REPO, overlay=overlay),
+                    "slot-count-drift")
+    assert any("kServerStats" in f.message and "12" in f.message
+               for f in errs)
+
+
+def test_enum_drift_fixture():
+    net = read("hetu_tpu/csrc/ps/net.h")
+    assert "kTestSlowApply = 70" in net
+    overlay = {"hetu_tpu/csrc/ps/net.h":
+               net.replace("kTestSlowApply = 70", "kTestSlowApply = 71")}
+    errs = lints_of(analyze_drift(REPO, overlay=overlay), "enum-drift")
+    assert any("kTestSlowApply" in f.message for f in errs)
+
+
+def test_dispatch_drift_fixture():
+    server = read(SERVER_H)
+    assert "case PsfType::kSnapshotNow:" in server
+    overlay = {SERVER_H: server.replace("case PsfType::kSnapshotNow:", "")}
+    errs = lints_of(analyze_drift(REPO, overlay=overlay),
+                    "psf-dispatch-drift")
+    assert any("kSnapshotNow" in f.message for f in errs)
+
+
+def test_capi_unbound_fixture():
+    rel = "hetu_tpu/ps/client.py"
+    overlay = {rel: read(rel) + "\n_lib.DefinitelyMissingSymbol(0)\n"}
+    errs = lints_of(analyze_drift(REPO, overlay=overlay), "capi-unbound")
+    assert any("DefinitelyMissingSymbol" in f.message for f in errs)
+
+
+def test_wire_import_drift_fixture():
+    rel = "hetu_tpu/elastic.py"
+    gutted = read(rel).replace("wire_constants", "wire_consts_gone")
+    errs = lints_of(analyze_drift(REPO, overlay={rel: gutted}),
+                    "wire-import-drift")
+    assert any(f.message.startswith(rel) or rel in f.message for f in errs)
+
+
+def test_mirror_pair_drift_fixture():
+    rel = "hetu_tpu/comm_quant.py"
+    gutted = read(rel).replace("def np_quantize_blocks(",
+                               "def np_qb_renamed(")
+    errs = lints_of(analyze_drift(REPO, overlay={rel: gutted}),
+                    "mirror-pair-drift")
+    assert any("np_quantize_blocks" in f.message for f in errs)
+
+
+# --------------------------------------------------------------------------
+# surface family
+
+
+def test_fault_kind_undocumented_fixture():
+    errs = lints_of(
+        analyze_surface(REPO,
+                        overlay={"docs/FAULT_TOLERANCE.md": "# empty\n"}),
+        "fault-kind-undocumented")
+    names = {f.op_name for f in errs}
+    assert set(faults.STEP_FAULT_NAMES) <= names
+
+
+def test_fault_parser_drift_fixture():
+    rel = "hetu_tpu/chaos.py"
+    gutted = read(rel).replace("CHAOS_PROB_KEYS", "PRIVATE_KEYS") \
+                      .replace("chaos_catalogue", "private_catalogue") \
+                      .replace("CHAOS_SPEC_KEYS", "PRIVATE_SPEC")
+    errs = lints_of(analyze_surface(REPO, overlay={rel: gutted}),
+                    "fault-parser-drift")
+    assert any(f.op_name == rel for f in errs)
+
+
+def test_chaos_grammar_drift_fixture():
+    rel = "hetu_tpu/csrc/ps/chaos.h"
+    gutted = read(rel).replace('"droprsp"', '"dropRSP"')
+    errs = lints_of(analyze_surface(REPO, overlay={rel: gutted}),
+                    "chaos-grammar-drift")
+    assert any(f.op_name == "droprsp" for f in errs)
+
+
+def test_knob_undocumented_fixture():
+    rel = "hetu_tpu/runner.py"
+    seeded = read(rel) + '\n_X = os.environ.get("HETU_NOT_IN_ANY_DOC")\n'
+    warns = lints_of(analyze_surface(REPO, overlay={rel: seeded}),
+                     "knob-undocumented")
+    assert any(f.op_name == "HETU_NOT_IN_ANY_DOC" for f in warns)
+
+
+def test_gauge_undocumented_fixture():
+    rel = "hetu_tpu/recovery.py"
+    seeded = read(rel) + (
+        '\ndef _seed(reg):\n'
+        '    reg.gauge("hetu_gauge_nobody_documented").set(1.0)\n')
+    warns = lints_of(analyze_surface(REPO, overlay={rel: seeded}),
+                     "gauge-undocumented")
+    assert any(f.op_name == "hetu_gauge_nobody_documented" for f in warns)
+
+
+# --------------------------------------------------------------------------
+# shipped tree + registries + CLI contract
+
+
+def test_shipped_tree_is_drift_free():
+    """Satellite acceptance: every true drift was fixed in this PR, so
+    the full Tier D run has zero errors on the working tree."""
+    errors = [f for f in subcli.analyze(REPO) if f.severity == "error"]
+    assert not errors, [f.message for f in errors]
+
+
+def test_unpack_fields_rejects_short_reply():
+    with pytest.raises(ValueError, match="slot-layout drift"):
+        wire.unpack_fields(wire.SERVER_STATS_FIELDS,
+                           range(wire.SERVER_STATS_SLOTS - 1))
+    d = wire.unpack_fields(wire.WORLD_REPLY_FIELDS, [7, 2, 3, 0, 40])
+    assert d["world_version"] == 7 and d["start_step"] == 40
+
+
+def test_fault_registry_rejects_unknown_kind_with_catalogue():
+    with pytest.raises(ValueError, match="fault-kind catalogue"):
+        faults.parse_step_entry("totally_new_kind@5")
+    got = faults.parse_step_entry("job_kill@3:pre_commit")
+    assert got["kind"] == "job_kill" and got["arg"] == "pre_commit"
+
+
+def test_hetucheck_cli_json_smoke():
+    """Tier-1 smoke: hetucheck exits 0 on the shipped tree and the JSON
+    shape is the hetulint contract."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetucheck"), "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True
+    assert payload["counts"].get("error", 0) == 0
+    assert isinstance(payload["findings"], list)
+
+
+def test_hetucheck_self_check():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetucheck"), "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+
+
+def test_hetucheck_fails_on_seeded_tree(tmp_path):
+    """End-to-end exit-code check: a checkout carrying the slot drift
+    makes `bin/hetucheck <root>` exit 1."""
+    # clone just what the analyzers read, with the defect seeded
+    import shutil
+    for rel in ("hetu_tpu/csrc/ps", "hetu_tpu/csrc/cache", "hetu_tpu/ps",
+                "hetu_tpu/analysis", "docs", "bin"):
+        src = os.path.join(REPO, rel)
+        if os.path.isdir(src):
+            shutil.copytree(src, tmp_path / rel)
+    for rel in ("hetu_tpu/faults.py", "hetu_tpu/resilience.py",
+                "hetu_tpu/chaos.py", "hetu_tpu/recovery.py",
+                "hetu_tpu/elastic.py", "hetu_tpu/runner.py",
+                "hetu_tpu/comm_quant.py", "README.md"):
+        if os.path.exists(os.path.join(REPO, rel)):
+            shutil.copy(os.path.join(REPO, rel), tmp_path / rel)
+    seeded = (tmp_path / SERVER_H)
+    seeded.write_text(seeded.read_text().replace("int64_t stats[11]",
+                                                 "int64_t stats[12]"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetucheck"),
+         str(tmp_path)], capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "slot-count-drift" in out.stdout
