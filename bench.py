@@ -398,6 +398,111 @@ def bench_watch_overhead(width=256, batch=256, iters=40, warmup=None,
         shutil.rmtree(tdir, ignore_errors=True)
 
 
+def bench_pilot_overhead(width=64, batch=128, iters=60, warmup=10,
+                         windows=4):
+    """hetupilot armed-idle cost (docs/FAULT_TOLERANCE.md "Self-tuning
+    with guardrails" acceptance: < 1%/step while idle): two identical
+    PS-mode dense trainers against ONE live cluster, hetuwatch armed in
+    BOTH arms (an SLO the job can never trip, so no recommendation ever
+    reaches the controller) — the controller disarmed vs armed — so the
+    delta isolates the pilot's steady-state tax: the residual-row feed
+    and the per-step boundary walk (governor/pending/verdict checks that
+    all fall through). Actuation-era cost is NOT this cell's subject;
+    the eras are deliberate, rare, operator-audited events measured by
+    tests/test_pilot.py. Interleaved best-of-N windows plus a direct
+    stopwatch on Pilot.step_boundary (the watch cell's discipline: the
+    cost sits below container noise, so headline the direct reading and
+    keep the A/B as the noise-floor cross-check)."""
+    import shutil
+    import tempfile
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry as tel_mod
+    from hetu_tpu import pilot as pilot_mod
+    tdir = tempfile.mkdtemp(prefix="hetu_pilot_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("HETU_TELEMETRY_DIR", "HETU_PILOT",
+                       "HETU_PILOT_DIR")}
+    os.environ["HETU_TELEMETRY_DIR"] = tdir
+    os.environ["HETU_PILOT_DIR"] = os.path.join(tdir, "pilot")
+    try:
+        from hetu_tpu.ps.local_cluster import local_cluster
+        with local_cluster(n_servers=1, n_workers=1):
+            def build(tag, pilot_on):
+                if pilot_on:
+                    os.environ["HETU_PILOT"] = "1"
+                else:
+                    os.environ.pop("HETU_PILOT", None)
+                os.environ["HETU_PS_ID_BASE"] = str(tag * 1000)
+                x = ht.Variable(name="x", trainable=False)
+                y_ = ht.Variable(name="y_", trainable=False)
+                w = ht.init.random_normal((width, 8), stddev=0.05,
+                                          name=f"w{tag}")
+                loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+                    ht.matmul_op(x, w), y_), [0])
+                train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+                ex = ht.Executor({"train": [loss, train_op]},
+                                 ctx=ht.cpu(0), comm_mode="PS", bsp=True,
+                                 prefetch=False, seed=0,
+                                 telemetry="metrics", watch=1,
+                                 slo="step_ms<100000")
+                rng = np.random.RandomState(0)
+                bx = rng.randn(batch, width).astype(np.float32)
+                by = np.eye(8, dtype=np.float32)[rng.randint(0, 8, batch)]
+                return ex, {x: bx, y_: by}
+
+            ex_off, feeds_off = build(1, False)
+            ex_on, feeds_on = build(2, True)
+            assert ex_off.pilot is None and ex_on.pilot is not None
+
+            def window(ex, feeds):
+                for _ in range(warmup):
+                    ex.run("train", feed_dict=feeds)
+                t0 = time.time()
+                for _ in range(iters):
+                    ex.run("train", feed_dict=feeds)
+                return (time.time() - t0) / iters * 1000
+
+            boundary_ms = []
+            orig_boundary = pilot_mod.Pilot.step_boundary
+
+            def timed_boundary(self, *a, **k):
+                t0 = time.time()
+                r = orig_boundary(self, *a, **k)
+                boundary_ms.append((time.time() - t0) * 1000)
+                return r
+
+            pilot_mod.Pilot.step_boundary = timed_boundary
+            try:
+                off_w, on_w = [], []
+                for _ in range(windows):   # interleaved: drift hits both
+                    off_w.append(window(ex_off, feeds_off))
+                    on_w.append(window(ex_on, feeds_on))
+            finally:
+                pilot_mod.Pilot.step_boundary = orig_boundary
+            ms_off, ms_on = min(off_w), min(on_w)
+            bd_ms = (sorted(boundary_ms)[len(boundary_ms) // 2]
+                     if boundary_ms else 0.0)
+            s = pilot_mod.summarize_dir(os.environ["HETU_PILOT_DIR"])
+            ex_off.close()
+            ex_on.close()
+            return {"step_ms_off": round(ms_off, 4),
+                    "step_ms_on": round(ms_on, 4),
+                    "pilot_overhead_pct": round(
+                        (ms_on - ms_off) / ms_off * 100, 2),
+                    "pilot_boundary_ms": round(bd_ms, 4),
+                    "pilot_amortized_pct": round(bd_ms / ms_off * 100, 2),
+                    "eras": (s or {}).get("eras", 0),   # must stay 0
+                    "windows": windows}
+    finally:
+        tel_mod.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 def bench_chaos_hardening(batch_size=128, iters=60, rows=5000, width=16,
                           warmup=10, windows=8):
     """hetuchaos transport-hardening cost (docs/FAULT_TOLERANCE.md
@@ -1424,6 +1529,13 @@ def _run_section(name):
         kw = (dict(width=32, batch=16, iters=12, warmup=4, windows=2)
               if smoke else {})
         out = bench_watch_overhead(**kw)
+    elif name == "pilot":
+        # hetupilot armed-idle cell (docs/FAULT_TOLERANCE.md): the
+        # <1%-idle claim is MEASURED here, not asserted
+        kw = (dict(width=32, batch=16, iters=10, warmup=3, windows=2)
+              if smoke else {})
+        out = bench_pilot_overhead(**kw)
+        out["servers"] = 1
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -1514,6 +1626,9 @@ SECTION_ENV = {
     # hetuwatch overhead A/B: same reasoning — the sentinel's per-step
     # cost is host-side dict arithmetic, far below tunnel jitter
     "watch": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetupilot armed-idle A/B: the boundary walk being measured is
+    # host-side dict arithmetic, far below tunnel jitter
+    "pilot": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
     # hetuchaos CRC-hardening A/B: same reasoning as trail — the checksum
     # cost being measured is host-side and far below tunnel jitter
     "chaos": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
@@ -1686,6 +1801,8 @@ class _Ledger:
                       "introspect_overhead_pct", "trail_overhead_pct",
                       "watch_overhead_pct", "watch_observe_ms",
                       "watch_amortized_pct", "observations",
+                      "pilot_overhead_pct", "pilot_boundary_ms",
+                      "pilot_amortized_pct",
                       "client_spans", "step_ms_off",
                       "step_ms_on", "bytes_wire_ratio", "auc_off",
                       "auc_int8", "auc_delta", "final_loss_off",
@@ -1863,6 +1980,7 @@ def main():
                      ("introspect_overhead", "introspect", 420),
                      ("trail_overhead", "trail", 600),
                      ("watch_overhead", "watch", 420),
+                     ("pilot_overhead", "pilot", 420),
                      ("chaos_overhead", "chaos", 600),
                      ("snapshot_overhead", "snapshot", 600),
                      ("kernels_tier", "kernels", 600),

@@ -134,6 +134,42 @@ def self_check(root: str) -> int:
     expect(bool(drifted), "seeded kServerStats slot-count drift (11 -> 12) "
                           "yields a slot-count-drift error")
 
+    # 3b. seeded kResizeState era-counter drift (the hetupilot actuation
+    # tags widened the reply 11 -> 13; a further native-side widening
+    # without the wire_constants.py counterpart must be caught)
+    sched = os.path.join(root, "hetu_tpu/csrc/ps/scheduler.h")
+    with open(sched, "r", encoding="utf-8") as f:
+        stext = f.read()
+    overlay = {"hetu_tpu/csrc/ps/scheduler.h":
+               stext.replace("int64_t vals[13]", "int64_t vals[14]")}
+    drifted = [f for f in analyze_drift(root, overlay=overlay)
+               if f.lint == "slot-count-drift"]
+    expect(bool(drifted), "seeded kResizeState slot-count drift (13 -> 14) "
+                          "yields a slot-count-drift error")
+
+    # 3c. seeded PlanDelta registry/consumer drift: a pilot that grew its
+    # own kind list (no DELTA_KINDS reference) must be caught, and a new
+    # registry kind without a docs catalogue row must be caught
+    pilot_rel = "hetu_tpu/pilot.py"
+    drifted = [f for f in analyze_surface(
+                   root, overlay={pilot_rel: "# pilot with a private "
+                                  "catalogue\nKINDS = ['comm_quant']\n"})
+               if f.lint == "delta-parser-drift"]
+    expect(bool(drifted), "pilot without a DELTA_KINDS reference yields a "
+                          "delta-parser-drift error")
+    watch_rel = "hetu_tpu/telemetry/watch.py"
+    with open(os.path.join(root, watch_rel), "r", encoding="utf-8") as f:
+        wtext = f.read()
+    overlay = {watch_rel: wtext.replace(
+        '    "comm_quant":     {"arg": "mode",',
+        '    "zero_stage":     {"arg": "stage", "reversible": True,'
+        ' "scope": "program"},\n'
+        '    "comm_quant":     {"arg": "mode",')}
+    drifted = [f for f in analyze_surface(root, overlay=overlay)
+               if f.lint == "delta-kind-undocumented"]
+    expect(bool(drifted), "seeded undocumented plan-delta kind yields a "
+                          "delta-kind-undocumented error")
+
     # 4. gutting the fault catalogue doc must trip the surface lint
     gutted = [f for f in analyze_surface(
                   root, overlay={"docs/FAULT_TOLERANCE.md": "# empty\n"})

@@ -19,6 +19,13 @@ Three promise surfaces, each diffed in both directions:
   ``fault-parser-drift`` / ``chaos-grammar-drift``, all errors: a fault
   kind that exists in one layer only is a silent no-op in the layer that
   was supposed to exercise it).
+- **Plan-delta kinds** — the ``watch.DELTA_KINDS`` registry (the bounded
+  deltas hetuwatch recommends and hetupilot actuates) must be catalogued
+  in docs/FAULT_TOLERANCE.md (``delta-kind-undocumented``, error) and the
+  pilot must consume the registry symbol rather than a private kind list
+  (``delta-parser-drift``, error) — the same discipline as fault kinds: a
+  kind the recommender emits but the actuator or docs never heard of is a
+  recommendation that silently goes nowhere.
 
 Pure text analysis over the working tree; ``overlay`` maps repo-relative
 paths to replacement text so the seeded-defect tests and ``--check`` can
@@ -79,6 +86,13 @@ _FAULT_PARSERS = (
 )
 
 _CHAOS_HDR = "hetu_tpu/csrc/ps/chaos.h"
+
+# the PlanDelta registry (producer) and its actuating consumer. Parsed as
+# TEXT, not imported: watch.py is stdlib-only but this tier must analyze
+# counterfactual overlay trees, and a registry literal is a surface too.
+_DELTA_REGISTRY = "hetu_tpu/telemetry/watch.py"
+_DELTA_CONSUMER = "hetu_tpu/pilot.py"
+_RE_DELTA_KIND = re.compile(r"^\s*\"([a-z_]+)\":\s*\{\"arg\":", re.M)
 
 
 def _read(root: str, rel: str, overlay: Optional[Dict[str, str]]) -> str:
@@ -333,6 +347,61 @@ def _check_faults(root: str, overlay) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# plan-delta kinds
+
+def _delta_kinds(text: str) -> List[str]:
+    """Registry keys from the ``DELTA_KINDS = {...}`` literal (text parse:
+    overlay trees must be analyzable without importing them)."""
+    m = re.search(r"^DELTA_KINDS\s*=\s*\{", text, re.M)
+    if not m:
+        return []
+    block = text[m.end():]
+    end = block.find("\n}")
+    if end >= 0:
+        block = block[:end]
+    return _RE_DELTA_KIND.findall(block)
+
+
+def _check_deltas(root: str, overlay) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_text = _read(root, _DELTA_REGISTRY, overlay)
+    if not reg_text:
+        return findings
+    kinds = _delta_kinds(reg_text)
+    if not kinds:
+        findings.append(Finding(
+            lint="delta-parser-drift", severity=ERROR,
+            message=(f"{_DELTA_REGISTRY} has no parseable DELTA_KINDS "
+                     "registry literal — the plan-delta surface lint lost "
+                     "its source of truth"),
+            op_name=_DELTA_REGISTRY, pass_name=PASS))
+        return findings
+
+    doc = _read(root, "docs/FAULT_TOLERANCE.md", overlay)
+    doc_kinds = set(re.findall(r"`([a-z_]+)`", doc))
+    for kind in kinds:
+        if kind not in doc_kinds:
+            findings.append(Finding(
+                lint="delta-kind-undocumented", severity=ERROR,
+                message=(f"plan-delta kind {kind} is in watch.DELTA_KINDS "
+                         "but the docs/FAULT_TOLERANCE.md delta catalogue "
+                         f"has no `{kind}` row — an operator cannot know "
+                         "what the pilot is allowed to change"),
+                op_name=kind, pass_name=PASS))
+
+    pilot = _read(root, _DELTA_CONSUMER, overlay)
+    if pilot and "DELTA_KINDS" not in pilot:
+        findings.append(Finding(
+            lint="delta-parser-drift", severity=ERROR,
+            message=(f"{_DELTA_CONSUMER} no longer references "
+                     "watch.DELTA_KINDS — an actuator with a private kind "
+                     "catalogue is exactly the recommender/actuator drift "
+                     "the registry was built to end"),
+            op_name=_DELTA_CONSUMER, pass_name=PASS))
+    return findings
+
+
+# --------------------------------------------------------------------------
 
 def analyze_surface(root: str = ".",
                     overlay: Optional[Dict[str, str]] = None
@@ -343,4 +412,5 @@ def analyze_surface(root: str = ".",
     findings += _check_knobs(root, files, doc, overlay)
     findings += _check_gauges(root, files, overlay)
     findings += _check_faults(root, overlay)
+    findings += _check_deltas(root, overlay)
     return findings

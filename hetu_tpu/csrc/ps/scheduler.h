@@ -362,7 +362,7 @@ class Scheduler {
           std::unique_lock<std::mutex> g(mu_);
           ensure_members_locked();
           const auto survivors = survivors_locked();
-          int64_t vals[11] = {
+          int64_t vals[13] = {
               static_cast<int64_t>(world_version_),
               static_cast<int64_t>(pending_version_),
               num_workers_,
@@ -376,11 +376,16 @@ class Scheduler {
               // slot 10 (hetusave): completed coordinated-snapshot epochs
               // this scheduler incarnation — a pure suffix extension, so
               // pre-hetusave clients reading 10 slots stay valid
-              static_cast<int64_t>(snapshot_epochs_)};
+              static_cast<int64_t>(snapshot_epochs_),
+              // slots 11-12 (hetupilot): actuation eras sealed with a
+              // commit / rollback verdict tag — the same suffix-extension
+              // discipline, so hetusave-era clients reading 11 stay valid
+              static_cast<int64_t>(pilot_commit_epochs_),
+              static_cast<int64_t>(pilot_rollback_epochs_)};
           Message rsp;
           rsp.head.type = static_cast<int32_t>(PsfType::kAck);
           rsp.head.req_id = req.head.req_id;
-          rsp.args.push_back(Arg::i64(vals, 11));
+          rsp.args.push_back(Arg::i64(vals, 13));
           rsp.args.push_back(Arg::i32(members_.data(), members_.size()));
           g.unlock();
           try {
@@ -437,27 +442,34 @@ class Scheduler {
           const bool abort =
               !req.args.empty() && req.args[0].size() >= 4 &&
               req.args[0].as_i32()[0] != 0;
-          // optional second i32 (suffix extension, hetusave only): the
-          // coordinator tags the abort that releases a COMMITTED snapshot
-          // epoch. Only tagged aborts advance snapshot_epochs_ — shape
-          // inference (identical world, nobody removed) would miscount a
-          // genuine same-size resize aborted after a drain timeout, or a
-          // failed snapshot's best-effort release, as a completed epoch.
-          const bool snapshot_done =
-              abort && req.args[0].size() >= 8 &&
-              req.args[0].as_i32()[1] != 0;
+          // optional second i32 (suffix extension): the actuation tag —
+          // WHY the coordinator ran this identity-resize barrier era.
+          // 0/absent: plain resize or untagged abort (counted nowhere);
+          // 1: hetusave committed a snapshot epoch; 2/3: hetupilot sealed
+          // an actuation era with a commit/rollback verdict. Only tagged
+          // aborts advance an era counter — shape inference (identical
+          // world, nobody removed) would miscount a genuine same-size
+          // resize aborted after a drain timeout, or a failed snapshot's
+          // best-effort release, as a completed epoch.
+          const int32_t actuation_tag =
+              (abort && req.args[0].size() >= 8)
+                  ? req.args[0].as_i32()[1]
+                  : 0;
           std::unique_lock<std::mutex> g(mu_);
           ensure_members_locked();
           Message rsp;
           if (pending_version_ == 0) {
             rsp = error_reply(req.head.req_id, "no resize is pending");
           } else if (abort) {
-            // hetusave rides propose-identical-world -> drain-park ->
-            // abort as its quiesce barrier; when the coordinator tagged
-            // this abort as the release AFTER its job manifest committed,
-            // stamp the completed snapshot epoch so kResizeState exposes
-            // a monotonic epoch counter to coordinators and telemetry.
-            if (snapshot_done) ++snapshot_epochs_;
+            // hetusave and hetupilot both ride propose-identical-world ->
+            // drain-park -> abort as their quiesce barrier; when the
+            // coordinator tagged this abort as the release AFTER its
+            // outcome durably committed (job manifest / actuation
+            // verdict), stamp the matching era counter so kResizeState
+            // exposes monotonic, cause-attributed counters.
+            if (actuation_tag == 1) ++snapshot_epochs_;
+            else if (actuation_tag == 2) ++pilot_commit_epochs_;
+            else if (actuation_tag == 3) ++pilot_rollback_epochs_;
             std::fprintf(stderr,
                          "[hetups scheduler] resize v%llu ABORTED; world "
                          "v%llu continues\n",
@@ -669,6 +681,9 @@ class Scheduler {
   uint64_t snapshot_epochs_ = 0;        // hetusave: completed coordinated
                                         // snapshot epochs (snapshot-tagged
                                         // kFinishResize aborts only)
+  uint64_t pilot_commit_epochs_ = 0;    // hetupilot: actuation eras sealed
+  uint64_t pilot_rollback_epochs_ = 0;  // with a commit/rollback verdict
+                                        // (tag 2/3 kFinishResize aborts)
 
   // members_/world_log_ materialize lazily — the launch world is fixed by
   // config, so this is valid whether it runs before or after assembly

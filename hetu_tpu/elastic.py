@@ -187,9 +187,9 @@ def resize_state(host, port, timeout: float = 5.0) -> dict:
     _, out = _rpc(host, port, K_RESIZE_STATE, timeout=timeout)
     v = _i64s(out[0])
     members = _i32s(out[1]).tolist() if len(out) > 1 else []
-    # slot 10 (snapshot_epochs) is a suffix extension — accept the 10-slot
-    # prefix a pre-hetusave scheduler replies with
-    raw = wire.unpack_fields(wire.RESIZE_STATE_FIELDS[:-1], v)
+    # slots 10+ (snapshot_epochs, pilot_*_epochs) are suffix extensions —
+    # accept the 10-slot prefix a pre-hetusave scheduler replies with
+    raw = wire.unpack_fields(wire.RESIZE_STATE_FIELDS[:10], v)
     state = {"world_version": raw["world_version"],
              "pending_version": raw["pending_version"],
              "n_workers": raw["num_workers"], "n_servers": raw["num_servers"],
@@ -198,12 +198,13 @@ def resize_state(host, port, timeout: float = 5.0) -> dict:
              "drain_count": raw["drained"], "drain_needed": raw["survivors"],
              "new_servers_ready": bool(raw["new_servers_ready"]),
              "members": members}
-    if len(v) >= wire.RESIZE_STATE_SLOTS:
-        # hetusave suffix extension: completed coordinated-snapshot epochs
-        # this scheduler incarnation (snapshot-tagged finish_resize aborts
-        # only — the coordinator tags after its job manifest committed)
-        state["snapshot_epochs"] = int(
-            v[wire.RESIZE_STATE_FIELDS.index("snapshot_epochs")])
+    # era-counter suffix: completed coordinated-snapshot epochs (hetusave)
+    # and commit/rollback-sealed actuation eras (hetupilot) this scheduler
+    # incarnation — each advanced only by a matching tagged finish_resize
+    # abort, so every counter attributes its eras to their cause
+    for i, field in enumerate(wire.RESIZE_STATE_FIELDS[10:], start=10):
+        if len(v) > i:
+            state[field] = int(v[i])
     return state
 
 
@@ -225,17 +226,24 @@ def commit_resize(host, port, rank: int, step: int,
 
 
 def finish_resize(host, port, abort: bool = False,
-                  snapshot: bool = False) -> int:
+                  snapshot: bool = False, tag: Optional[str] = None) -> int:
     """Phase 2: atomically flip the world (or abort the pending proposal)
     and release every parked worker. Requires the drain barrier to be
-    complete unless aborting. ``snapshot=True`` (hetusave's success path
-    only, with ``abort=True``) tags the abort as the release of a
-    COMMITTED coordinated-snapshot epoch so the scheduler's monotonic
-    ``snapshot_epochs`` counter advances; untagged aborts — drain
-    timeouts, failed migrations, a snapshot that died before its manifest
+    complete unless aborting. ``tag`` (an ``ACTUATION_TAGS`` name, with
+    ``abort=True`` only) attributes the barrier era to its cause:
+    ``"snapshot"`` — hetusave's success path, releasing a COMMITTED
+    coordinated-snapshot epoch (``snapshot=True`` is the back-compat
+    spelling) — advances the scheduler's monotonic ``snapshot_epochs``;
+    ``"pilot_commit"`` / ``"pilot_rollback"`` — a hetupilot actuation era
+    sealed with its verdict — advance ``pilot_commit_epochs`` /
+    ``pilot_rollback_epochs``. Untagged aborts — drain timeouts, failed
+    migrations, a snapshot or actuation that died before its outcome
     committed — never count. Returns the now-current world version."""
+    if tag is None:
+        tag = "snapshot" if snapshot else "none"
+    tag_val = wire.ACTUATION_TAGS[tag]   # KeyError names a bad tag early
     _, out = _rpc(host, port, K_FINISH_RESIZE,
-                  [_arg_i32([1 if abort else 0, 1 if snapshot else 0])])
+                  [_arg_i32([1 if abort else 0, tag_val])])
     return int(_i64s(out[0])[0])
 
 

@@ -36,6 +36,8 @@ STEP_FAULT_KINDS = {
     "worker_lost":   {"arg": "float", "exercises": "elastic scale-down"},
     "ps_join":       {"arg": "float", "exercises": "live key-range migration"},
     "ps_slow":       {"arg": "float", "exercises": "hetutrail attribution"},
+    "plan_flap":     {"arg": "float",
+                      "exercises": "hetupilot anti-oscillation governor"},
     "ps_partition":  {"arg": "float", "exercises": "retry-with-backoff"},
     "job_kill":      {"arg": "phase", "exercises": "hetusave epochs"},
 }

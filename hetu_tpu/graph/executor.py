@@ -1082,6 +1082,11 @@ class SubExecutor:
                                     row.get("divergence"),
                                     cache=self._tel_watch_cache)
             tel.record("watch", **row)
+            # hetupilot rides the same residual stream the row exports —
+            # the controller's measurement windows ARE the watch windows
+            pilot = getattr(ex, "pilot", None)
+            if pilot is not None:
+                pilot.feed_row(row)
             for e in events:
                 name = e.pop("name")
                 if name == "plan_divergence":
@@ -1110,9 +1115,15 @@ class SubExecutor:
                                                e.get("ratio", 0.0))
                     e["recommendation"] = rec["message"]
                     # the bounded plan delta as the suppressible finding
-                    # shape hetulint emits (advisory — never actuated here)
+                    # shape hetulint emits (advisory — never actuated here;
+                    # the pilot actuates at the NEXT step boundary, inside
+                    # the elastic two-phase barrier)
                     tel.record("finding", **rec)
+                    if pilot is not None and rec.get("delta") is not None:
+                        pilot.feed_recommendation(rec["delta"], dict(e))
                 _tel_event(name, sub=self.name, **e)
+                if pilot is not None:
+                    pilot.feed_event(name, e)
                 if name == "slo_breach":
                     # the flight ring holds the steps AROUND the breach —
                     # flush it while they are still in the window
@@ -1310,6 +1321,20 @@ class SubExecutor:
         ela = getattr(ex, "elastic", None) if self.training else None
         if ela is not None:
             ela.step_boundary(self, step)
+        # hetupilot actuation/verdict point, AFTER the elastic agent's own
+        # commit (a pilot barrier must never race a real pending resize).
+        # An actuation rebuilds ex.subexecutors: this (stale) instance
+        # delegates the step to its replacement, which re-enters this hook
+        # idempotently at the same step.
+        pil = getattr(ex, "pilot", None) if self.training else None
+        if pil is not None:
+            pil.step_boundary(self, step)
+            fresh = ex.subexecutors.get(self.name)
+            if fresh is not None and fresh is not self:
+                return fresh.run(
+                    feed_dict=feed_dict,
+                    convert_to_numpy_ret_vals=convert_to_numpy_ret_vals,
+                    eval_node_list=eval_node_list)
         feed_dict = feed_dict or {}
         feed_vals = []
         for node in self.feed_nodes:
@@ -1912,6 +1937,10 @@ class Executor:
         # membership"): armed below for PS/Hybrid runs under HETU_ELASTIC;
         # None otherwise — SubExecutor.run pays one None check per step
         self.elastic = None
+        # hetupilot self-tuning controller (docs/FAULT_TOLERANCE.md
+        # "Self-tuning with guardrails"): armed below for PS/Hybrid runs
+        # under HETU_PILOT when the plan-divergence sentinel is watching
+        self.pilot = None
 
         self.subexecutors = {}
         for name, nodes in self.eval_node_dict.items():
@@ -1940,6 +1969,20 @@ class Executor:
             if restore_dir:
                 from ..recovery import restore_executor_from_env
                 restore_executor_from_env(self, restore_dir)
+            # hetupilot (heturun --pilot / HETU_PILOT=1): acts on the
+            # sentinel's recommendations, so it needs the sentinel — armed
+            # AFTER any restore so interrupted-era sealing sees the state
+            # the run will actually continue from
+            if env_truthy("HETU_PILOT"):
+                if self.plan_watch is not None:
+                    from ..pilot import Pilot
+                    self.pilot = Pilot.from_env(self)
+                else:
+                    import sys as _sys
+                    print("# hetupilot: HETU_PILOT set but the plan watch "
+                          "is not armed (need HETU_WATCH plus an adopted "
+                          "plan or SLO) — controller disabled",
+                          file=_sys.stderr, flush=True)
 
     # ------------------------------------------------------------------
     def _lint(self, lint):

@@ -1,0 +1,1252 @@
+"""hetupilot: the bounded self-tuning controller (ROADMAP item 5, leg 2).
+
+hetuwatch (telemetry/watch.py) judges the live run against the adopted
+plan and emits machine-readable ``PlanDelta`` recommendations
+(``watch.DELTA_KINDS`` — the ONE registry of bounded deltas). This
+module is what finally *acts* on them, under guardrails strict enough to
+trust against a production job:
+
+- **Eras.** Every actuation is one era: propose (ledger record +
+  pre-actuation baseline) -> actuate inside a parked identity-resize
+  barrier of the elastic two-phase protocol (the hetusave shape:
+  propose/drain/quiesce-proof/work/tagged-abort — the abort path is the
+  safety valve, so any failure releases the old world untouched) ->
+  measure K post-actuation watch windows -> verdict. A commit seals the
+  era with a ``pilot_commit``-tagged barrier; a regression (after/before
+  step-time above ``regress_ratio``) REVERTS the delta through the same
+  protocol under a ``pilot_rollback`` tag, restoring host params,
+  optimizer slots, qresid AND every PS shard bit-for-bit from the era's
+  pre-actuation capture, then blacklists the delta for a cool-down. The
+  scheduler's ``kResizeState`` era counters attribute every sealed era
+  to its cause (``wire_constants.ACTUATION_TAGS``).
+
+- **Hysteretic governor.** Minimum inter-actuation spacing, per-delta
+  blacklist with cool-down, a global actuation budget, and abstention
+  while a resize is pending, while another worker exists (the hetusave
+  single-rank refusal), or while the client's chaos/retry/timeout/CRC
+  counters are climbing — a flaky network must make the controller sit
+  on its hands, not oscillate (``plan_flap`` in faults.py is the
+  adversarial test driver).
+
+- **Persistent ledger.** ``pilot.jsonl`` records every phase of every
+  era (propose/actuate/verdict/abstain). A crash mid-actuation leaves an
+  open era; the next incarnation (state rebuilt from config + hetusave
+  restore, i.e. the pre-actuation plan) marks it ``interrupted``, counts
+  it against the budget and blacklists the delta — restores always land
+  in a known era. ``heturun`` folds the ledger into run_summary.json.
+
+jax-free at module level on purpose: ``bin/hetupilot`` loads this file
+standalone (the bin/hetuwatch pattern) for the ledger report and the
+``--check`` self-test; everything that needs jax / the PS runtime is
+imported lazily inside the actuator methods.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# -- knob defaults (docs/FAULT_TOLERANCE.md "Self-tuning with guardrails") --
+DEFAULT_K = 5               # post-actuation watch windows per verdict
+DEFAULT_WARMUP = 2          # windows discarded after actuation (re-warm)
+DEFAULT_BASELINE = 5        # pre-actuation windows in the baseline median
+DEFAULT_REGRESS_RATIO = 1.10   # after/before above this rolls back
+DEFAULT_SPACING = 50        # min steps between actuations
+DEFAULT_COOLDOWN = 200      # blacklist steps after a rollback/failure
+DEFAULT_BUDGET = 3          # actuation eras per run, total
+DEFAULT_ALLOW = "comm_quant,comm_mode_flip"   # ps_server_grow/remesh opt-in
+BARRIER_TIMEOUT_S = 120.0
+
+
+class PilotError(RuntimeError):
+    """Refused or failed actuation; the step that raised it continues."""
+
+
+def _watch_mod():
+    """The PlanDelta registry's home (telemetry/watch.py), importable from
+    BOTH contexts: inside the hetu_tpu package, or standalone when
+    bin/hetupilot loaded this file by path (watch.py is stdlib-only at
+    module level, so the fallback never drags jax in)."""
+    try:
+        from .telemetry import watch
+        return watch
+    except ImportError:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "telemetry", "watch.py")
+        mod = sys.modules.get("_hetuwatch")
+        if mod is not None:
+            return mod
+        spec = importlib.util.spec_from_file_location("_hetuwatch", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hetuwatch"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+def delta_signature(delta: dict) -> str:
+    """Blacklist identity of one PlanDelta: kind + target + arg — two
+    recommendations proposing the same change share one cool-down."""
+    return (f"{delta.get('kind')}:{delta.get('target') or ''}"
+            f":{delta.get('arg') or ''}")
+
+
+def median(vals):
+    s = sorted(float(v) for v in vals)
+    n = len(s)
+    if not n:
+        raise ValueError("median of an empty window")
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+# ---------------------------------------------------------------------------
+# Governor: the hysteretic actuation gate (pure, jax-free)
+# ---------------------------------------------------------------------------
+
+class Governor:
+    """Decides whether one proposed delta may actuate NOW. Stateful but
+    pure (no I/O): the caller supplies every runtime fact as a keyword.
+    ``consider`` returns ``"ok"`` or a stable refusal reason — the
+    ledger/abstain records and the tests key on these exact strings."""
+
+    REFUSALS = ("budget-exhausted", "spacing", "blacklisted",
+                "multi-worker", "resize-pending", "chaos-climbing")
+
+    def __init__(self, spacing: int = DEFAULT_SPACING,
+                 cooldown: int = DEFAULT_COOLDOWN,
+                 budget: int = DEFAULT_BUDGET):
+        self.spacing = max(0, int(spacing))
+        self.cooldown = max(0, int(cooldown))
+        self.budget = max(0, int(budget))
+        self.spent = 0
+        self.last_actuation_step: Optional[int] = None
+        self._ban: dict = {}       # signature -> step the ban expires at
+
+    def consider(self, delta: dict, step: int, *, n_workers: int = 1,
+                 resize_pending: bool = False,
+                 chaos_climbing: bool = False) -> str:
+        step = int(step)
+        if self.spent >= self.budget:
+            return "budget-exhausted"
+        if self.last_actuation_step is not None \
+                and step - self.last_actuation_step < self.spacing:
+            return "spacing"
+        until = self._ban.get(delta_signature(delta))
+        if until is not None and step < until:
+            return "blacklisted"
+        if n_workers != 1:
+            # the hetusave precedent: this controller captures and
+            # restores only its OWN rank's state — a rollback in a bigger
+            # world would leave the other ranks on the new plan
+            return "multi-worker"
+        if resize_pending:
+            return "resize-pending"
+        if chaos_climbing:
+            return "chaos-climbing"
+        return "ok"
+
+    def note_actuation(self, step: int) -> None:
+        self.spent += 1
+        self.last_actuation_step = int(step)
+
+    def ban(self, signature: str, step: int) -> None:
+        self._ban[signature] = int(step) + self.cooldown
+
+    def banned_until(self, signature: str) -> Optional[int]:
+        return self._ban.get(signature)
+
+
+# ---------------------------------------------------------------------------
+# Ledger: pilot.jsonl (append-only, crash-ordered)
+# ---------------------------------------------------------------------------
+
+class ActuationLedger:
+    """One JSONL line per phase of every era. The file is the pilot's
+    ONLY persistent state: interrupted-era detection, the run summary and
+    ``bin/hetupilot``'s report all read it back."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def append(self, **rec) -> None:
+        rec.setdefault("ts", round(time.time(), 3))
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def records(self) -> list:
+        out = []
+        try:
+            f = open(self.path, "r", encoding="utf-8")
+        except OSError:
+            return out
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # torn tail line from a crash mid-write
+                if isinstance(rec, dict):
+                    out.append(rec)
+        return out
+
+    def last_era(self) -> int:
+        return max((int(r["era"]) for r in self.records()
+                    if r.get("era") is not None), default=0)
+
+    @staticmethod
+    def open_eras(records: list) -> list:
+        """Eras that actuated but never reached a verdict — exactly the
+        crash-mid-actuation survivors the next incarnation must seal."""
+        actuated, decided = set(), set()
+        for r in records:
+            era = r.get("era")
+            if era is None:
+                continue
+            if r.get("phase") in ("propose", "actuate"):
+                actuated.add(int(era))
+            elif r.get("phase") == "verdict":
+                decided.add(int(era))
+        return sorted(actuated - decided)
+
+    @staticmethod
+    def summarize(records: list) -> dict:
+        """The run_summary.json / ``bin/hetupilot`` rollup: era history
+        (cause, delta, before/after, verdict) + counts."""
+        eras: dict = {}
+        abstains = 0
+        for r in records:
+            if r.get("phase") == "abstain":
+                abstains += 1
+                continue
+            era = r.get("era")
+            if era is None:
+                continue
+            e = eras.setdefault(int(era), {"era": int(era)})
+            if r.get("phase") == "propose":
+                e["delta"] = r.get("delta")
+                e["cause"] = r.get("cause")
+                e["step"] = r.get("step")
+                e["baseline_ms"] = r.get("baseline_ms")
+            elif r.get("phase") == "verdict":
+                e["verdict"] = r.get("verdict")
+                for k in ("after_ms", "ratio", "error"):
+                    if r.get(k) is not None:
+                        e[k] = r.get(k)
+        history = [eras[k] for k in sorted(eras)]
+        verdicts = [e.get("verdict") for e in history]
+        return {"eras": len(history),
+                "commits": verdicts.count("commit"),
+                "rollbacks": verdicts.count("rollback"),
+                "regressed_kept": verdicts.count("regressed"),
+                "failed": verdicts.count("failed"),
+                "interrupted": verdicts.count("interrupted"),
+                "open": sum(1 for v in verdicts if v is None),
+                "abstains": abstains,
+                "history": history}
+
+
+def summarize_dir(directory: str) -> Optional[dict]:
+    """Summary of a pilot directory's ledger (None when there is none) —
+    what heturun folds into run_summary.json under ``"pilot"``."""
+    path = os.path.join(directory, "pilot.jsonl")
+    if not os.path.exists(path):
+        return None
+    return ActuationLedger.summarize(ActuationLedger(path).records())
+
+
+# ---------------------------------------------------------------------------
+# The controller
+# ---------------------------------------------------------------------------
+
+class Pilot:
+    """Feedback controller attached to one Executor (PS/Hybrid jobs only:
+    the actuation barrier and the era counters live in the PS scheduler).
+    ``step_boundary`` is the ONLY hot entry point — it runs at the same
+    safe point as the elastic agent, after that agent's own commit, and
+    pays a couple of attribute checks when nothing is pending."""
+
+    def __init__(self, ex, *, k: int = DEFAULT_K,
+                 warmup: int = DEFAULT_WARMUP,
+                 baseline_n: int = DEFAULT_BASELINE,
+                 regress_ratio: float = DEFAULT_REGRESS_RATIO,
+                 spacing: int = DEFAULT_SPACING,
+                 cooldown: int = DEFAULT_COOLDOWN,
+                 budget: int = DEFAULT_BUDGET,
+                 directory: str = "hetu_pilot",
+                 allow=None, force: Optional[str] = None,
+                 timeout: float = BARRIER_TIMEOUT_S):
+        self.ex = ex
+        self.k = max(1, int(k))
+        self.warmup = max(0, int(warmup))
+        self.baseline_n = max(2, int(baseline_n))
+        self.regress_ratio = float(regress_ratio)
+        self.timeout = float(timeout)
+        self.allow = tuple(s.strip() for s in
+                           (allow if allow is not None
+                            else DEFAULT_ALLOW).split(",")
+                           if s.strip()) if isinstance(allow, str) or \
+            allow is None else tuple(allow)
+        self.dir = directory
+        self.ledger = ActuationLedger(os.path.join(directory, "pilot.jsonl"))
+        self.governor = Governor(spacing=spacing, cooldown=cooldown,
+                                 budget=budget)
+        self.state = "idle"               # "idle" | "measuring"
+        self._rows: deque = deque(maxlen=max(64, self.baseline_n
+                                             + self.warmup + self.k + 8))
+        self._pending = None              # (delta, cause) awaiting governor
+        self._era = None                  # live era dict while measuring
+        self._boundary_step = None        # idempotence across delegation
+        self._last_decision = None        # (sig, reason) abstain throttle
+        self._chaos_sample = None         # last ClientStats chaos counters
+        self._force = self._parse_force(force)
+        self._lock = threading.Lock()     # ledger/era state vs feed threads
+        tel = getattr(ex, "telemetry", None)
+        self._g_state = self._c_act = self._c_rb = None
+        if tel is not None:
+            self._g_state = tel.metrics.gauge("hetu_pilot_state")
+            self._c_act = tel.metrics.counter("hetu_pilot_actuations_total")
+            self._c_rb = tel.metrics.counter("hetu_pilot_rollbacks_total")
+            self._g_state.set(0.0)
+        self._seal_interrupted_eras()
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_env(cls, ex):
+        env = os.environ
+        directory = env.get("HETU_PILOT_DIR", "")
+        if not directory:
+            tel_dir = env.get("HETU_TELEMETRY_DIR", "")
+            directory = (os.path.join(tel_dir, "pilot") if tel_dir
+                         else "hetu_pilot")
+        return cls(
+            ex,
+            k=int(env.get("HETU_PILOT_K", str(DEFAULT_K))),
+            warmup=int(env.get("HETU_PILOT_WARMUP", str(DEFAULT_WARMUP))),
+            baseline_n=int(env.get("HETU_PILOT_BASELINE",
+                                   str(DEFAULT_BASELINE))),
+            regress_ratio=float(env.get("HETU_PILOT_REGRESS_RATIO",
+                                        str(DEFAULT_REGRESS_RATIO))),
+            spacing=int(env.get("HETU_PILOT_SPACING", str(DEFAULT_SPACING))),
+            cooldown=int(env.get("HETU_PILOT_COOLDOWN",
+                                 str(DEFAULT_COOLDOWN))),
+            budget=int(env.get("HETU_PILOT_BUDGET", str(DEFAULT_BUDGET))),
+            directory=directory,
+            allow=env.get("HETU_PILOT_ALLOW", None),
+            force=env.get("HETU_PILOT_FORCE", None))
+
+    @staticmethod
+    def _parse_force(spec: Optional[str]):
+        """``HETU_PILOT_FORCE=kind[:target[:arg]]@step`` — inject one
+        delta at a step regardless of divergence (the governor still
+        applies). HETU_TEST_MODE-gated like the fault kinds: forcing an
+        actuation is a test/chaos instrument, not an operator surface."""
+        if not spec:
+            return None
+        from_env = os.environ.get("HETU_TEST_MODE", "")
+        if from_env in ("", "0"):
+            raise PilotError(
+                "HETU_PILOT_FORCE requires HETU_TEST_MODE=1 (it is a test "
+                "instrument, not an operator control)")
+        body, _, at = spec.partition("@")
+        if not at:
+            raise PilotError(
+                f"HETU_PILOT_FORCE={spec!r}: expected kind[:target[:arg]]"
+                "@step")
+        parts = body.split(":")
+        kind = parts[0]
+        target = parts[1] if len(parts) > 1 and parts[1] else None
+        arg = parts[2] if len(parts) > 2 and parts[2] else None
+        delta = _watch_mod().make_delta(kind, target=target, arg=arg,
+                                        expected_gain=0.0, confidence=1.0)
+        return (delta, int(at))
+
+    def _seal_interrupted_eras(self) -> None:
+        """Crash-mid-actuation recovery: this incarnation's plan came from
+        config (+ hetusave restore), i.e. the PRE-actuation era, so an
+        open era needs no revert — it needs sealing: verdict
+        ``interrupted``, budget consumed, delta blacklisted."""
+        records = self.ledger.records()
+        open_eras = ActuationLedger.open_eras(records)
+        if not open_eras:
+            return
+        by_era = {}
+        for r in records:
+            if r.get("phase") == "propose" and r.get("era") is not None:
+                by_era[int(r["era"])] = r
+        for era in open_eras:
+            prop = by_era.get(era, {})
+            delta = prop.get("delta") or {}
+            sig = delta_signature(delta) if delta else "?"
+            step = int(prop.get("step", 0))
+            self.ledger.append(era=era, phase="verdict",
+                               verdict="interrupted", step=step, delta=delta)
+            if delta:
+                self.governor.ban(sig, step)
+            self.governor.spent += 1
+
+    # -- feeds (called from SubExecutor._watch_observe) ---------------------
+    def feed_row(self, row: dict) -> None:
+        """One watch observation (the residual stream). Abstain markers
+        and row shapes without a step time contribute nothing."""
+        if "abstain" in row or "step_ms" not in row:
+            return
+        self._rows.append((int(row["step"]), float(row["step_ms"])))
+
+    def feed_recommendation(self, delta: dict, cause: dict) -> None:
+        """A machine-readable PlanDelta latched by the watch (the
+        plan_divergence path). Kept pending until the governor admits or
+        durably refuses it at a step boundary."""
+        if self.state != "idle" or self._pending is not None:
+            return
+        if delta.get("kind") not in self.allow:
+            self._abstain(delta_signature(delta), "kind-not-allowed",
+                          int(cause.get("step", 0)))
+            return
+        self._pending = (dict(delta), dict(cause))
+
+    def feed_event(self, name: str, event: dict) -> None:
+        """SLO breaches carry no delta of their own: re-ask the
+        recommender with the watch's current worst leg."""
+        if name != "slo_breach" or self.state != "idle" \
+                or self._pending is not None:
+            return
+        pw = getattr(self.ex, "plan_watch", None)
+        if pw is None or not pw._ewma:
+            return
+        leg = max(pw._ewma, key=pw._ewma.get)
+        rec = _watch_mod().recommend(pw.plan or {}, leg,
+                                     float(pw._ewma[leg]))
+        if rec.get("delta") is not None:
+            cause = dict(event)
+            cause["via"] = "slo_breach"
+            self.feed_recommendation(rec["delta"], cause)
+
+    # -- the step-boundary hook ---------------------------------------------
+    def step_boundary(self, sub, step: int) -> None:
+        """Actuate / verdict at the training-loop safe point. Never
+        raises: a refused or failed actuation logs and training
+        continues. Idempotent per step — an actuation rebuilds the
+        subexecutors and the stale one delegates its run(), which calls
+        back into this hook at the same step."""
+        step = int(step)
+        if self._boundary_step == step:
+            return
+        self._boundary_step = step
+        try:
+            if self._force is not None and self.state == "idle" \
+                    and self._pending is None and step >= self._force[1]:
+                delta, at = self._force
+                self._force = None
+                self._pending = (delta, {"forced": True, "step": at})
+            if self.state == "measuring":
+                self._maybe_verdict(step)
+            elif self._pending is not None:
+                self._maybe_actuate(step)
+        except Exception as e:  # noqa: BLE001 — controller must never
+            # take the training step down with it
+            print(f"# hetupilot: step {step}: {e!r}", file=sys.stderr,
+                  flush=True)
+
+    # -- actuation ----------------------------------------------------------
+    def _abstain(self, sig: str, reason: str, step: int) -> None:
+        if self._last_decision == (sig, reason):
+            return   # one ledger line per distinct decision, not per step
+        self._last_decision = (sig, reason)
+        self.ledger.append(phase="abstain", signature=sig, reason=reason,
+                           step=int(step))
+
+    def _chaos_climbing(self) -> bool:
+        """True while the client's failure counters (retries, timeouts,
+        CRC rejects, chaos faults) moved since the LAST check — the
+        network is misbehaving, so measurements are untrustworthy and the
+        governor sits out."""
+        rt = getattr(self.ex, "ps_runtime", None)
+        if rt is None:
+            return False
+        try:
+            cs = rt.comm.ClientStats()
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return False
+        sample = tuple(int(cs.get(k, 0)) for k in
+                       ("retries", "timeouts", "crc_rejects",
+                        "chaos_faults"))
+        prev, self._chaos_sample = self._chaos_sample, sample
+        if prev is None:
+            return False
+        return any(b > a for a, b in zip(prev, sample))
+
+    def _maybe_actuate(self, step: int) -> None:
+        delta, cause = self._pending
+        sig = delta_signature(delta)
+        # cheap, pure gates first (no RPC)
+        reason = self.governor.consider(delta, step)
+        if reason == "ok" and self._chaos_climbing():
+            reason = "chaos-climbing"
+        st = None
+        if reason == "ok":
+            st = self._scheduler_state()
+            reason = self.governor.consider(
+                delta, step, n_workers=st["n_workers"],
+                resize_pending=bool(st["pending_version"]))
+        if reason != "ok":
+            self._abstain(sig, reason, step)
+            if reason in ("budget-exhausted", "blacklisted", "multi-worker"):
+                self._pending = None   # durable refusal: drop the delta
+            return
+        if len(self._rows) < 2:
+            self._abstain(sig, "no-baseline", step)
+            return
+        self._last_decision = None
+        baseline = median([ms for _, ms in
+                           list(self._rows)[-self.baseline_n:]])
+        era = self.ledger.last_era() + 1
+        era_dir = os.path.join(self.dir, f"era_{era:04d}")
+        self.ledger.append(era=era, phase="propose", step=step, delta=delta,
+                           cause=_jsonable(cause),
+                           baseline_ms=round(baseline, 4))
+        self._maybe_kill("propose")
+        try:
+            if delta["kind"] == "ps_server_grow":
+                snapshot = undo = None
+                self._actuate_grow()
+            else:
+                def work(st, addrs):
+                    snap = self._capture(era_dir, addrs)
+                    self._maybe_kill("actuate")
+                    return snap, self._apply(delta)
+                snapshot, undo = self._barrier(work, tag="none")
+        except Exception as e:  # noqa: BLE001 — a failed actuation is a
+            # sealed era, never a dead job: the barrier's abort released
+            # the old world untouched
+            self.ledger.append(era=era, phase="verdict", verdict="failed",
+                               step=step, delta=delta, error=repr(e))
+            self.governor.ban(sig, step)
+            self.governor.note_actuation(step)
+            self._pending = None
+            print(f"# hetupilot: era {era} actuation failed: {e!r}",
+                  file=sys.stderr, flush=True)
+            return
+        self._pending = None
+        self.governor.note_actuation(step)
+        self.ledger.append(era=era, phase="actuate", step=step, delta=delta)
+        self._era = {"era": era, "delta": delta, "sig": sig, "dir": era_dir,
+                     "baseline": baseline, "snapshot": snapshot,
+                     "undo": undo, "actuated_step": step}
+        self.state = "measuring"
+        if self._c_act is not None:
+            self._c_act.inc()
+            self._g_state.set(1.0)
+        self._tel_event("pilot_actuate", era=era, step=step,
+                        kind=delta["kind"], target=delta.get("target"),
+                        arg=_jsonable(delta.get("arg")),
+                        baseline_ms=round(baseline, 4))
+
+    def _maybe_verdict(self, step: int) -> None:
+        era = self._era
+        after_rows = [ms for s, ms in self._rows
+                      if s > era["actuated_step"]]
+        usable = after_rows[self.warmup:]
+        if len(usable) < self.k:
+            return
+        after = median(usable[-self.k:])
+        ratio = after / max(era["baseline"], 1e-9)
+        delta, sig = era["delta"], era["sig"]
+        self._maybe_kill("pre_verdict")
+        reversible = _watch_mod().DELTA_KINDS.get(
+            delta["kind"], {}).get("reversible", False)
+        if ratio <= self.regress_ratio:
+            verdict = "commit"
+            self._barrier(lambda st, addrs: None, tag="pilot_commit")
+        elif not reversible or era["undo"] is None:
+            verdict = "regressed"   # irreversible: keep, blacklist, record
+            self.governor.ban(sig, step)
+        else:
+            verdict = "rollback"
+
+            def work(st, addrs):
+                era["undo"]()
+                self._restore(era["snapshot"], era["dir"])
+            self._barrier(work, tag="pilot_rollback")
+            self.governor.ban(sig, step)
+            if self._c_rb is not None:
+                self._c_rb.inc()
+        self.ledger.append(era=era["era"], phase="verdict", verdict=verdict,
+                           step=step, delta=delta,
+                           before_ms=round(era["baseline"], 4),
+                           after_ms=round(after, 4),
+                           ratio=round(ratio, 4))
+        self._tel_event(f"pilot_{verdict}", era=era["era"], step=step,
+                        kind=delta["kind"], before_ms=round(era["baseline"], 4),
+                        after_ms=round(after, 4), ratio=round(ratio, 4))
+        self._era = None
+        self.state = "idle"
+        self._last_decision = None
+        if self._g_state is not None:
+            self._g_state.set(0.0)
+
+    # -- the two-phase barrier (the hetusave park/quiesce/release shape) ----
+    def _scheduler_state(self) -> dict:
+        from .elastic import resize_state, sched_addr_from_env
+        host, port = sched_addr_from_env()
+        return resize_state(host, port)
+
+    def _barrier(self, work, tag: str):
+        """Run ``work(state, server_addrs)`` inside a parked identity
+        resize: propose -> this worker's own commit thread parks as the
+        one drained survivor -> quiesce proof (pushes_ok == applied
+        updates, the exactly-once ledger algebra) -> work -> tagged abort
+        releases the old world. Any failure aborts untagged, so the era
+        counters only ever count completed work."""
+        from . import ps as ps_pkg
+        from .elastic import (_query_book, commit_resize, finish_resize,
+                              propose_resize, resize_state,
+                              sched_addr_from_env)
+        ex = self.ex
+        rt = ex.ps_runtime
+        comm = ps_pkg.get_worker_communicate()
+        host, port = sched_addr_from_env()
+        rank = int(os.environ.get("WORKER_ID", "0"))
+        step = int(ex.state.get("step", 0))
+        rt.drain()
+        st = resize_state(host, port)
+        nw, ns = int(st["n_workers"]), int(st["n_servers"])
+        if nw != 1:
+            raise PilotError(f"actuation with {nw} workers is not "
+                             "supported (single-rank capture/restore)")
+        if st["pending_version"]:
+            raise PilotError("a resize is already pending")
+        propose_resize(host, port, nw, ns)
+        parked: dict = {}
+
+        def _park():
+            try:
+                parked["world"] = commit_resize(host, port, rank, step,
+                                                timeout=self.timeout)
+            except Exception as e:  # noqa: BLE001 — surfaced by the poll
+                parked["error"] = e
+
+        th = threading.Thread(target=_park, name="hetupilot-park",
+                              daemon=True)
+        released = False
+        try:
+            th.start()
+            deadline = time.monotonic() + self.timeout
+            while True:
+                st = resize_state(host, port)
+                if st["pending_version"] and \
+                        st["drain_count"] >= st["drain_needed"]:
+                    break
+                if "error" in parked:
+                    raise PilotError(
+                        f"drain barrier failed: {parked['error']!r}")
+                if time.monotonic() > deadline:
+                    raise PilotError(
+                        f"drain barrier timeout after {self.timeout}s")
+                time.sleep(0.002)
+            # quiesce proof: every push this (only) worker ever made has
+            # been applied — nothing in flight can land mid-actuation
+            cs = comm.ClientStats()
+            applied = 0
+            for s in range(ns):
+                ss = comm.ServerStats(s)
+                applied += int(ss["updates"]) - max(
+                    int(ss["restored_updates"]), 0)
+            if int(cs["pushes_ok"]) != applied:
+                raise PilotError(
+                    f"quiesce proof failed: pushes_ok {cs['pushes_ok']} != "
+                    f"applied updates {applied}")
+            addrs, _alive = _query_book(host, port)
+            result = work(st, addrs)
+            finish_resize(host, port, abort=True, tag=tag)
+            released = True
+            th.join(timeout=self.timeout)
+            if "error" in parked:
+                raise PilotError(
+                    f"parked worker failed to release: {parked['error']!r}")
+            return result
+        except BaseException:
+            if not released:
+                try:   # best-effort untagged release — never count the era
+                    finish_resize(host, port, abort=True)
+                except Exception:  # noqa: BLE001 — scheduler may be gone
+                    pass
+                th.join(timeout=5.0)
+            raise
+
+    # -- capture / restore --------------------------------------------------
+    def _capture(self, era_dir: str, addrs) -> dict:
+        """Pre-actuation state, complete enough for a bit-identical
+        rollback: host params/slots/op-state/cursors via the checkpoint
+        capture, qresid alongside (the hetusave pattern), and EVERY PS
+        shard (data + server optimizer slots + versions) into the era
+        directory via per-key kParamSave."""
+        import numpy as np
+
+        from .elastic import server_list_params, server_param_save
+        from .resilience import capture_executor_state
+        ex = self.ex
+        snap = capture_executor_state(ex)
+        snap["qresid"] = {
+            str(i): np.asarray(ex.state["qresid"][id(n)])
+            for i, n in enumerate(ex._qresid_ordered())}
+        os.makedirs(era_dir, exist_ok=True)
+        keys_by_addr: dict = {}
+        for addr in addrs:
+            for row in server_list_params(addr):
+                server_param_save(addr, row["key"], era_dir)
+                keys_by_addr.setdefault(addr, []).append(row["key"])
+        snap["_ps_keys"] = keys_by_addr
+        return snap
+
+    def _restore(self, snap: dict, era_dir: str) -> None:
+        """Rollback restore (inside the barrier, AFTER the delta's undo
+        rewired the graph back): PS shards from the era dir, then host
+        state — params, slots, op state, qresid, dataloader cursors."""
+        import jax
+        import jax.numpy as jnp
+
+        from .elastic import server_param_load
+        from .resilience import load_executor_state
+        ex = self.ex
+        for addr, keys in snap.get("_ps_keys", {}).items():
+            for key in keys:
+                server_param_load(addr, key, era_dir)
+        rt = ex.ps_runtime
+        rt._prefetched.clear()   # prefetched rows predate the restore
+        for p in rt.params.values():
+            if not p.sparse:
+                p.host_value = rt.pull_dense_value(p)
+        load_executor_state(ex, snap)
+        for i, n in enumerate(ex._qresid_ordered()):
+            key = str(i)
+            if key in snap.get("qresid", {}):
+                v = jnp.asarray(snap["qresid"][key], jnp.float32)
+                if ex.config.mesh is not None:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+                    v = jax.device_put(
+                        v, NamedSharding(ex.config.mesh, P()))
+                ex.state["qresid"][id(n)] = v
+
+    # -- actuators ----------------------------------------------------------
+    def _apply(self, delta: dict):
+        """Apply one delta to the live executor; returns the undo
+        callable a rollback runs BEFORE restoring values."""
+        kind = delta["kind"]
+        if kind == "comm_quant":
+            return self._apply_comm_quant(delta)
+        if kind == "comm_mode_flip":
+            return self._apply_comm_mode_flip(delta)
+        if kind == "remesh":
+            return self._apply_remesh(delta)
+        raise PilotError(f"no actuator for delta kind {kind!r}")
+
+    def _apply_comm_quant(self, delta: dict):
+        """Arm/disarm the PS int8 wire (the EQuARX trade): pure wire-level
+        — the traced program never changes, so no rebuild."""
+        ex = self.ex
+        rt = ex.ps_runtime
+        new = delta.get("arg") or "int8"
+        old = rt.comm_quant
+        if new == old:
+            raise PilotError(f"comm_quant already {new!r}")
+        if not hasattr(rt.comm, "SetCommQuant"):
+            raise PilotError("worker communicator has no SetCommQuant")
+        rt.comm.SetCommQuant(new != "off")
+        rt.comm_quant = new
+        pw = getattr(ex, "plan_watch", None)
+        if pw is not None and pw.plan:
+            pw.plan["comm_quant"] = new
+
+        def undo():
+            rt.comm.SetCommQuant(old != "off")
+            rt.comm_quant = old
+            if pw is not None and pw.plan:
+                pw.plan["comm_quant"] = old
+        return undo
+
+    def _find_opt(self, var):
+        for opt in self.ex._opt_nodes():
+            for i, v in enumerate(opt.vars):
+                if v is var:
+                    return opt, i
+        raise PilotError(f"param {var.name!r} has no optimizer slot")
+
+    def _apply_comm_mode_flip(self, delta: dict):
+        ex = self.ex
+        target, mode = delta.get("target"), delta.get("arg")
+        if mode not in ("AllReduce", "PS"):
+            raise PilotError(f"comm_mode_flip arg must be AllReduce or PS, "
+                             f"got {mode!r}")
+        if mode == "AllReduce":
+            p = next((p for p in ex.ps_runtime.params.values()
+                      if p.node.name == target), None)
+            if p is None:
+                raise PilotError(f"no PS-resident param {target!r} to flip")
+            if p.sparse:
+                raise PilotError(
+                    f"{target!r} is a sparse embedding: lookups need the "
+                    "PS row pulls, only dense decisions flip")
+            old_ps_id = p.ps_id
+            self._flip_ps_to_allreduce(p)
+
+            def undo():
+                var = next(n for n in ex.param_nodes if n.name == target)
+                self._flip_allreduce_to_ps(var, ps_id=old_ps_id)
+            return undo
+        var = next((n for n in ex.param_nodes if n.name == target), None)
+        if var is None:
+            raise PilotError(f"no device-resident param {target!r} to flip")
+        self._flip_allreduce_to_ps(var)
+
+        def undo():
+            p = ex.ps_runtime.params.get(id(var))
+            if p is not None:
+                self._flip_ps_to_allreduce(p)
+        return undo
+
+    def _flip_ps_to_allreduce(self, p) -> None:
+        """Move one dense param's ownership server -> device: pull value +
+        server optimizer slots, rewire the optimizer's grad input from the
+        PS push to an in-program AllReduce, rebuild the subexecutors."""
+        import numpy as np
+
+        ex = self.ex
+        rt = ex.ps_runtime
+        var = p.node
+        value = rt.pull_dense_value(p)
+        slot_host = self._pull_server_slots(p)
+        opt, i = self._find_opt(var)
+        from .graph.ops.comm import allreduceCommunicate_op
+        push = opt.inputs[i]
+        grad = push.inputs[0]
+        opt.inputs[i] = allreduceCommunicate_op(grad, param_node=var)
+        del rt.params[id(var)]
+        placed = ex._place_param(var, value)
+        ex.param_nodes.append(var)
+        ex.state["params"][id(var)] = placed
+        ex.config.placeholder_to_arr_map[var] = placed
+        slots = list(ex.state["slots"][id(opt)])
+        slots[i] = self._host_slot(opt.optimizer, placed, slot_host,
+                                   value.shape, np)
+        ex.state["slots"][id(opt)] = tuple(slots)
+        self._rebuild_subexecutors()
+
+    @staticmethod
+    def _host_slot(optimizer, placed, slot_host, shape, np):
+        """Server shard slots -> this optimizer's host slot pytree. The
+        mapping is explicit per optimizer family (store.h alloc_slots):
+        momentum/nesterov accum -> velocity, adagrad accum -> accum,
+        adam accum/accum2 -> m/v with t from the server step counter."""
+        import jax.numpy as jnp
+        slot = optimizer.slot_init(placed)
+        if slot_host is None or not isinstance(slot, dict):
+            return slot
+        accum = slot_host.get("accum")
+        accum2 = slot_host.get("accum2")
+        step = slot_host.get("step", 0)
+        out = dict(slot)
+        if "velocity" in out and accum is not None and accum.size:
+            out["velocity"] = jnp.asarray(accum.reshape(shape), jnp.float32)
+        if "accum" in out and accum is not None and accum.size:
+            out["accum"] = jnp.asarray(accum.reshape(shape), jnp.float32)
+        if "m" in out and accum is not None and accum.size:
+            out["m"] = jnp.asarray(accum.reshape(shape), jnp.float32)
+        if "v" in out and accum2 is not None and accum2.size:
+            out["v"] = jnp.asarray(accum2.reshape(shape), jnp.float32)
+        if "t" in out:
+            out["t"] = jnp.asarray(float(step), jnp.float32)
+        return out
+
+    def _pull_server_slots(self, p):
+        """Merge one dense param's server-side optimizer slots across
+        shards (v2 shard files as the transfer medium — the migration
+        path's format, so rows keep their state bit-for-bit)."""
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from .elastic import (_query_book, read_v2_shard,
+                              sched_addr_from_env, server_param_save)
+        host, port = sched_addr_from_env()
+        addrs, _ = _query_book(host, port)
+        tmp = tempfile.mkdtemp(prefix="hetupilot_slots_")
+        try:
+            shards = []
+            for rank, addr in enumerate(addrs):
+                server_param_save(addr, p.ps_id, tmp)
+                path = os.path.join(tmp,
+                                    f"param_{p.ps_id}_shard{rank}.bin")
+                if os.path.exists(path):
+                    shards.append(read_v2_shard(path))
+            if not shards:
+                return None
+            return {"accum": np.concatenate([s["accum"] for s in shards])
+                    if shards[0]["accum"].size else np.empty(0, np.float32),
+                    "accum2": np.concatenate([s["accum2"] for s in shards])
+                    if shards[0]["accum2"].size else np.empty(0, np.float32),
+                    "step": max(int(s.get("step", 0)) for s in shards)}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _flip_allreduce_to_ps(self, var, ps_id: Optional[int] = None) -> None:
+        """Move one dense param's ownership device -> server: register the
+        tensor (InitTensor is idempotent), transfer value + host optimizer
+        slots via the v2 shard format (raw assignment — the server
+        optimizer must never see them as gradients), rewire the
+        optimizer's grad input to a PS push, rebuild."""
+        import numpy as np
+
+        ex = self.ex
+        rt = ex.ps_runtime
+        from .graph.ops.ps import parameterServerCommunicate_op
+        from .graph.ps_runtime import PSParam
+        opt, i = self._find_opt(var)
+        ar = opt.inputs[i]
+        grad = ar.inputs[0]
+        # retire the AllReduce op's hetuq marks — it leaves the graph
+        if ar in getattr(ex, "qar_ops", []):
+            ex.qar_ops.remove(ar)
+            ex.state["qresid"].pop(id(ar), None)
+        value = np.asarray(ex.state["params"][id(var)], np.float32)
+        slot = ex.state["slots"][id(opt)][i]
+        if ps_id is None:
+            base = int(os.environ.get("HETU_PS_ID_BASE", "0"))
+            ps_id = max((q.ps_id for q in rt.params.values()),
+                        default=base - 1) + 1
+        sopt = rt._server_opt
+        rows = int(np.prod(value.shape))
+        rt.comm.InitTensor(ps_id, 0, rows, 1, "constant", 0.0, 1.0,
+                           seed=ex.config.seed + ps_id,
+                           opt_type=sopt["otype"], lrs=sopt["lrs"])
+        if sopt["otype"] == "sgd":
+            rt.comm.Assign(ps_id, value.ravel())
+        else:
+            self._push_shards(ps_id, value, slot, sopt)
+        p = PSParam(var, ps_id, False)
+        p.host_value = value.reshape(var.shape)
+        rt.params[id(var)] = p
+        opt.inputs[i] = parameterServerCommunicate_op(
+            grad, ps_id=var.name, optimizer=opt.optimizer)
+        opt.inputs[i].ps_param_node = var
+        ex.param_nodes.remove(var)
+        del ex.state["params"][id(var)]
+        ex.config.placeholder_to_arr_map.pop(var, None)
+        slots = list(ex.state["slots"][id(opt)])
+        slots[i] = ()   # the server owns the optimizer state now
+        ex.state["slots"][id(opt)] = tuple(slots)
+        self._rebuild_subexecutors()
+
+    def _push_shards(self, ps_id: int, value, slot, sopt) -> None:
+        """Host optimizer slots -> server shards: split value/accum/accum2
+        with the worker partitioner's exact formula and kParamLoad each
+        server's shard (Assign would zero the slots)."""
+        import numpy as np
+
+        from .elastic import (_query_book, repartition_key,
+                              sched_addr_from_env, server_param_load,
+                              write_v2_shard)
+        wire_otype = {"sgd": 0, "momentum": 1, "nesterov": 2,
+                      "adagrad": 3, "adam": 4}[sopt["otype"]]
+        flat = value.ravel().astype(np.float32)
+        accum = accum2 = np.empty(0, np.float32)
+        step = 0
+        if isinstance(slot, dict):
+            for k in ("velocity", "accum", "m"):
+                if k in slot:
+                    accum = np.asarray(slot[k], np.float32).ravel()
+                    break
+            if "v" in slot:
+                accum2 = np.asarray(slot["v"], np.float32).ravel()
+            if "t" in slot:
+                step = int(np.asarray(slot["t"]))
+        whole = {"kind": 0, "rows": 0, "len": flat.size, "width": 1,
+                 "otype": wire_otype, "step": step,
+                 "lrs": np.asarray(sopt["lrs"], np.float32),
+                 "data": flat, "accum": accum, "accum2": accum2,
+                 "versions": np.empty(0, np.int64)}
+        host, port = sched_addr_from_env()
+        addrs, _ = _query_book(host, port)
+        shards = repartition_key([whole], len(addrs))
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="hetupilot_push_")
+        try:
+            for rank, (addr, shard) in enumerate(zip(addrs, shards)):
+                path = os.path.join(tmp, f"param_{ps_id}_shard{rank}.bin")
+                write_v2_shard(path, shard)
+                server_param_load(addr, ps_id, tmp)
+        finally:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _apply_remesh(self, delta: dict):
+        """Re-adopt a different data-parallel mesh via Executor.remesh —
+        the arg must be a concrete jax Mesh (API/forced use; the
+        recommendation's mesh STRING is advisory only)."""
+        from jax.sharding import Mesh
+        ex = self.ex
+        mesh = delta.get("arg")
+        if not isinstance(mesh, Mesh):
+            raise PilotError(
+                "remesh actuation needs a concrete jax.sharding.Mesh arg "
+                "(drive it through the Pilot API; the recommendation's "
+                "mesh string is advisory)")
+        old = ex.config.mesh
+        if old is None:
+            raise PilotError("no current mesh to revert to — refusing an "
+                             "irreversible remesh")
+        ex.remesh(mesh)
+
+        def undo():
+            ex.remesh(old)
+        return undo
+
+    def _actuate_grow(self) -> None:
+        """PS tier +1 via the SIGUSR2/ScalePolicy grow path — a REAL
+        resize (the worker side parks in the elastic agent), so the pilot
+        runs no barrier of its own. Irreversible: scale-down is refused
+        by the scheduler, so a regression blacklists instead of
+        reverting."""
+        if getattr(self.ex, "elastic", None) is None:
+            raise PilotError(
+                "ps_server_grow needs the elastic agent (HETU_ELASTIC=1): "
+                "the grow commits through the worker's step-boundary hook")
+        from .elastic import grow_local_cluster_server
+        grow_local_cluster_server()
+
+    def _rebuild_subexecutors(self) -> None:
+        """A rewired graph invalidates every compiled program AND the
+        SubExecutors' cached topo/PS classifications — rebuild them from
+        the same eval_node_dict. Dataloader cursors carry over; the
+        in-flight run() notices the swap and delegates to its
+        replacement."""
+        ex = self.ex
+        old = ex.subexecutors
+        ex.subexecutors = {}
+        for name, sub in old.items():
+            fresh = type(sub)(name, ex.eval_node_dict[name], ex)
+            fresh._dl_cursor.update(sub._dl_cursor)
+            ex.subexecutors[name] = fresh
+
+    # -- small helpers ------------------------------------------------------
+    def _tel_event(self, name: str, **fields) -> None:
+        tel = getattr(self.ex, "telemetry", None)
+        if tel is not None:
+            try:
+                tel.event(name, **fields)
+            except Exception:  # noqa: BLE001 — observability only
+                pass
+
+    @staticmethod
+    def _maybe_kill(phase: str) -> None:
+        """HETU_PILOT_KILL=<phase> (HETU_TEST_MODE-gated): die at an
+        actuation phase — the crash-mid-actuation restore test's
+        instrument, mirroring hetusave's job_kill phases."""
+        if os.environ.get("HETU_TEST_MODE", "") in ("", "0"):
+            return
+        if os.environ.get("HETU_PILOT_KILL", "") == phase:
+            print(f"# hetupilot: armed kill at phase {phase!r}",
+                  file=sys.stderr, flush=True)
+            os._exit(86)
+
+
+def _jsonable(v):
+    """Ledger-safe rendering of cause/arg payloads (a remesh arg may be a
+    live Mesh object)."""
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        if isinstance(v, dict):
+            return {str(k): _jsonable(x) for k, x in v.items()}
+        return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# CLI: report + self-test (jax-free — the bin/hetuwatch contract)
+# ---------------------------------------------------------------------------
+
+def render_report(directory: str, out=sys.stdout) -> int:
+    path = os.path.join(directory, "pilot.jsonl")
+    if not os.path.exists(path):
+        alt = os.path.join(directory, "pilot", "pilot.jsonl")
+        if os.path.exists(alt):
+            path = alt
+        else:
+            print(f"hetupilot: no pilot.jsonl under {directory}",
+                  file=sys.stderr)
+            return 2
+    records = ActuationLedger(path).records()
+    s = ActuationLedger.summarize(records)
+    print(f"hetupilot ledger: {path}", file=out)
+    print(f"  eras {s['eras']} · commits {s['commits']} · rollbacks "
+          f"{s['rollbacks']} · regressed-kept {s['regressed_kept']} · "
+          f"failed {s['failed']} · interrupted {s['interrupted']} · "
+          f"open {s['open']} · abstains {s['abstains']}", file=out)
+    for e in s["history"]:
+        d = e.get("delta") or {}
+        before = e.get("baseline_ms")
+        after = e.get("after_ms")
+        ab = (f" {before}ms -> {after}ms (x{e.get('ratio')})"
+              if before is not None and after is not None else "")
+        print(f"  era {e['era']}: {d.get('kind')}"
+              f"{' ' + str(d.get('target')) if d.get('target') else ''}"
+              f" -> {d.get('arg')} @step {e.get('step')}"
+              f" · {e.get('verdict') or 'OPEN'}{ab}", file=out)
+    return 0
+
+
+def self_check(out=sys.stdout) -> int:
+    """Synthetic event stream -> governor decisions -> ledger round-trip.
+    No jax, no cluster, no executor — everything here is the pure
+    decision/persistence layer the live controller runs on."""
+    import tempfile
+    failures = []
+
+    def expect(cond, what):
+        print(("ok   " if cond else "FAIL ") + what, file=out)
+        if not cond:
+            failures.append(what)
+
+    w = _watch_mod()
+    d = w.make_delta("comm_mode_flip", target="w", arg="AllReduce",
+                     expected_gain=0.4, confidence=0.7)
+    expect(delta_signature(d) == "comm_mode_flip:w:AllReduce",
+           "delta signature is kind:target:arg")
+    try:
+        w.make_delta("full_replan")
+        expect(False, "unknown delta kind raises naming the catalogue")
+    except ValueError as e:
+        expect("comm_quant" in str(e),
+               "unknown delta kind raises naming the catalogue")
+
+    # governor: spacing + budget + blacklist-with-expiry
+    g = Governor(spacing=10, cooldown=50, budget=2)
+    expect(g.consider(d, 100) == "ok", "fresh governor admits a delta")
+    g.note_actuation(100)
+    expect(g.consider(d, 105) == "spacing",
+           "second actuation inside the spacing window is refused")
+    g.ban(delta_signature(d), 110)
+    expect(g.consider(d, 120) == "blacklisted",
+           "a banned signature is refused during its cool-down")
+    expect(g.consider(d, 160) == "ok",
+           "the ban expires after cooldown steps")
+    g.note_actuation(160)
+    expect(g.consider(d, 300) == "budget-exhausted",
+           "the global budget caps total actuations")
+    g2 = Governor()
+    expect(g2.consider(d, 0, n_workers=2) == "multi-worker",
+           "multi-worker jobs are refused (hetusave precedent)")
+    expect(g2.consider(d, 0, resize_pending=True) == "resize-pending",
+           "a pending resize holds the governor")
+    expect(g2.consider(d, 0, chaos_climbing=True) == "chaos-climbing",
+           "climbing chaos counters hold the governor")
+
+    # anti-flap: a plan_flap-shaped stream (the delta looks good on the
+    # "off" half-period, regresses on the "on" half) must not oscillate —
+    # each regression bans the signature, and the budget bounds the total
+    g3 = Governor(spacing=5, cooldown=100, budget=3)
+    actuations = []
+    step = 0
+    while step < 1000:
+        if g3.consider(d, step) == "ok":
+            g3.note_actuation(step)
+            actuations.append(step)
+            g3.ban(delta_signature(d), step + 10)   # measured regression
+        step += 8   # the flap period — every boundary re-offers the delta
+    expect(len(actuations) <= 3,
+           f"flapping recommendation is budget-bounded "
+           f"({len(actuations)} actuations over 1000 steps)")
+    gaps = [b - a for a, b in zip(actuations, actuations[1:])]
+    expect(all(gap >= 100 for gap in gaps),
+           "consecutive identical actuations are cool-down separated")
+
+    # ledger round-trip + interrupted-era detection + summary
+    with tempfile.TemporaryDirectory() as tmp:
+        led = ActuationLedger(os.path.join(tmp, "pilot.jsonl"))
+        led.append(era=1, phase="propose", step=50, delta=d,
+                   cause={"leg": "ps_push"}, baseline_ms=12.5)
+        led.append(era=1, phase="actuate", step=50, delta=d)
+        led.append(era=1, phase="verdict", verdict="commit", step=62,
+                   delta=d, before_ms=12.5, after_ms=9.1, ratio=0.728)
+        led.append(phase="abstain", signature="x", reason="spacing",
+                   step=70)
+        led.append(era=2, phase="propose", step=200, delta=d,
+                   baseline_ms=9.0)
+        led.append(era=2, phase="actuate", step=200, delta=d)
+        # era 2 never reaches a verdict: the crash-mid-actuation shape
+        with open(led.path, "a") as f:
+            f.write('{"torn": ')   # crash mid-write: torn tail line
+        records = led.records()
+        expect(len(records) == 6, "torn tail line is tolerated on read")
+        expect(ActuationLedger.open_eras(records) == [2],
+               "the crashed era is detected as open")
+        s = ActuationLedger.summarize(records)
+        expect(s["eras"] == 2 and s["commits"] == 1 and s["open"] == 1
+               and s["abstains"] == 1,
+               "summary counts eras/commits/open/abstains")
+        expect(s["history"][0]["after_ms"] == 9.1,
+               "summary history carries before/after step time")
+        rc = render_report(tmp, out=out if out is not sys.stdout
+                           else open(os.devnull, "w"))
+        expect(rc == 0, "report renders the ledger")
+
+    # verdict arithmetic
+    expect(median([3.0, 1.0, 2.0]) == 2.0 and median([1.0, 2.0]) == 1.5,
+           "median is exact for odd and even windows")
+
+    print(("hetupilot self-test: PASS" if not failures
+           else f"hetupilot self-test: {len(failures)} FAILURE(S)"),
+          file=out)
+    return 0 if not failures else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="hetupilot",
+        description="bounded self-tuning controller: actuation-ledger "
+                    "report + jax-free self-test "
+                    "(docs/FAULT_TOLERANCE.md 'Self-tuning with "
+                    "guardrails')")
+    ap.add_argument("dir", nargs="?", default=None,
+                    help="pilot directory (or telemetry dir) holding "
+                         "pilot.jsonl")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary")
+    ap.add_argument("--check", action="store_true",
+                    help="run the jax-free self-test and exit")
+    args = ap.parse_args(argv)
+    if args.check:
+        return self_check()
+    if not args.dir:
+        ap.print_usage(sys.stderr)
+        return 2
+    if args.as_json:
+        s = summarize_dir(args.dir) or summarize_dir(
+            os.path.join(args.dir, "pilot"))
+        if s is None:
+            print(f"hetupilot: no pilot.jsonl under {args.dir}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(s, indent=1))
+        return 0
+    return render_report(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

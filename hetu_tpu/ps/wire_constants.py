@@ -130,14 +130,27 @@ SNAPSHOT_NOW_SLOTS = len(SNAPSHOT_NOW_FIELDS)
 LIST_PARAMS_FIELDS = ("key", "kind", "size", "width", "otype")
 LIST_PARAMS_STRIDE = len(LIST_PARAMS_FIELDS)
 
-# scheduler.h kResizeState: int64_t vals[11] (slot 10 is the hetusave
-# suffix extension — older clients reading 10 slots stay valid)
+# scheduler.h kResizeState: int64_t vals[13] (slots 10-12 are suffix
+# extensions — slot 10 hetusave, 11-12 hetupilot — older clients reading
+# a shorter prefix stay valid)
 RESIZE_STATE_FIELDS = (
     "world_version", "pending_version", "num_workers", "num_servers",
     "pending_nw", "pending_ns", "drained", "survivors",
     "new_servers_ready", "members", "snapshot_epochs",
+    "pilot_commit_epochs", "pilot_rollback_epochs",
 )
 RESIZE_STATE_SLOTS = len(RESIZE_STATE_FIELDS)
+
+# scheduler.h kFinishResize second i32 (the actuation tag): WHY an
+# identity-resize barrier era was run, so the kResizeState era counters
+# attribute each era to its cause. 0/absent = a plain resize or an
+# untagged abort (counted nowhere); "snapshot" = a hetusave coordinated
+# epoch (counts snapshot_epochs); "pilot_commit"/"pilot_rollback" = a
+# hetupilot actuation verdict (counts pilot_*_epochs). The legacy bool
+# (snapshot=True) is tag 1 — exactly-once epoch counting is unchanged.
+ACTUATION_TAGS = {
+    "none": 0, "snapshot": 1, "pilot_commit": 2, "pilot_rollback": 3,
+}
 
 # scheduler.h world_reply_locked: int64_t vals[5]
 WORLD_REPLY_FIELDS = ("world_version", "num_workers", "num_servers",

@@ -158,6 +158,18 @@ class FaultInjector:
       ``HETU_PS_SLOW_SERVER`` (default 0); the server-side hook
       (``kTestSlowApply``) is additionally HETU_TEST_MODE-gated in capi
       AND on the server.
+    - ``plan_flap@S[:PERIOD]`` — from step S onward, alternate the
+      injected ``ps_slow`` delay on/off every PERIOD steps (default 8;
+      delay ``HETU_PLAN_FLAP_MS`` ms, default 40, re-armed at every
+      boundary of an "on" half-period since the server hook is one-shot
+      per arming). The ONLY persistent entry in the schedule — it never
+      burns out — and it is deliberately adversarial: the period is
+      chosen to entice a naive controller into oscillating (slow →
+      actuate → fault pauses → "improvement" → commit → fault returns →
+      actuate back...). The hetupilot governor's anti-flap regression
+      test drives it (docs/FAULT_TOLERANCE.md "Self-tuning with
+      guardrails"); a huge PERIOD degenerates to a sustained slow
+      server, the pilot's genuine-improvement fixture.
     - ``ps_partition@S[:SERVER]`` — arm a transient directed partition
       between this worker and PS server ``SERVER`` (default 0) at step S
       via the hetuchaos engine: the next ``HETU_PS_PARTITION_ATTEMPTS``
@@ -271,6 +283,19 @@ class FaultInjector:
             comm.TestSlowApply(
                 server=int(os.environ.get("HETU_PS_SLOW_SERVER", "0")),
                 ms=100 if e["arg"] is None else int(e["arg"]))
+        # plan_flap is the one persistent kind: it re-arms the one-shot
+        # server delay at every boundary of an "on" half-period and never
+        # marks itself fired — take() is deliberately bypassed
+        for e in self.entries:
+            if e["kind"] != "plan_flap" or int(step) < e["step"]:
+                continue
+            period = max(1, int(e["arg"])) if e["arg"] else 8
+            if ((int(step) - e["step"]) // period) % 2 == 0:
+                from . import ps as ps_pkg
+                comm = ps_pkg.get_worker_communicate()
+                comm.TestSlowApply(
+                    server=int(os.environ.get("HETU_PS_SLOW_SERVER", "0")),
+                    ms=int(os.environ.get("HETU_PLAN_FLAP_MS", "40")))
         e = self.take("ps_partition", step)
         if e is not None:
             from . import ps as ps_pkg

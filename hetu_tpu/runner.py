@@ -119,6 +119,16 @@ def _write_telemetry_summary(rc, preempted, num_workers):
         summary["world_versions"] = world_versions
     if plan:
         summary["plan"] = plan
+    # hetupilot actuation history (docs/FAULT_TOLERANCE.md "Self-tuning
+    # with guardrails"): the era ledger rolls up next to the plan it tuned
+    try:
+        from hetu_tpu.pilot import summarize_dir
+        pilot = summarize_dir(os.path.join(_tel_dir, "pilot")) \
+            or summarize_dir(_tel_dir)
+        if pilot is not None:
+            summary["pilot"] = pilot
+    except Exception as e:  # noqa: BLE001 — the summary must still land
+        print(f"# heturun: pilot summary skipped ({e})", file=sys.stderr)
     try:
         with open(os.path.join(_tel_dir, "run_summary.json"), "w") as f:
             json.dump(summary, f, indent=1)
@@ -249,6 +259,19 @@ def main(argv=None):
                              "appends ps_supervisor.jsonl, and the launcher "
                              "writes run_summary.json on exit; inspect with "
                              "bin/hetutop (docs/OBSERVABILITY.md)")
+    parser.add_argument("--pilot", action="store_true",
+                        help="bounded self-tuning (single-host PS mode): "
+                             "workers run with HETU_PILOT=1 (HETU_WATCH "
+                             "defaults on) so the hetupilot controller acts "
+                             "on hetuwatch's plan-divergence/SLO "
+                             "recommendations — each actuation is an era "
+                             "through the elastic two-phase protocol, "
+                             "measured for K windows and rolled back on "
+                             "regression. The actuation ledger "
+                             "(pilot.jsonl) lands under the telemetry dir "
+                             "and is folded into run_summary.json; inspect "
+                             "with bin/hetupilot (docs/FAULT_TOLERANCE.md "
+                             "'Self-tuning with guardrails')")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -278,6 +301,19 @@ def main(argv=None):
                 "1", "true", "yes", "on"):
             env.setdefault("HETU_TRAIL_DIR", _tel_dir)
             os.environ.setdefault("HETU_TRAIL_DIR", _tel_dir)
+    pilot_on = args.pilot and enable_ps and len(hosts) == 1
+    if args.pilot and not pilot_on:
+        # never let an operator believe self-tuning is armed when it is not
+        print("# heturun: --pilot requires single-host PS mode; the "
+              "self-tuning controller is OFF for this cluster",
+              file=sys.stderr)
+    if pilot_on:
+        env["HETU_PILOT"] = "1"
+        # the controller consumes the sentinel's stream: watching defaults
+        # on (explicit HETU_WATCH=0 still wins and disables both)
+        env.setdefault("HETU_WATCH", "1")
+        if _tel_dir:
+            env.setdefault("HETU_PILOT_DIR", os.path.join(_tel_dir, "pilot"))
     ps_ha = enable_ps and args.ps_max_respawns > 0 and len(hosts) == 1
     if enable_ps and args.ps_max_respawns > 0 and len(hosts) > 1:
         # don't let an operator believe HA is armed when it is not: the

@@ -564,6 +564,26 @@ def render_frame(state: dict, peak_tflops: float = DEFAULT_PEAK_TFLOPS
         if slo_evs:
             line += f"  slo breaches {int(slo_evs)}"
         lines.append(line)
+    # hetupilot self-tuning controller (docs/FAULT_TOLERANCE.md
+    # "Self-tuning with guardrails"): actuation/rollback era counts plus
+    # whether a verdict is still measuring, from the controller's gauges.
+    # Absent (no line) when no rank armed the pilot.
+    p_state = p_act = p_rb = None
+    for rk in state["ranks"].values():
+        m = rk["metrics"]
+        if "hetu_pilot_state" not in m:
+            continue
+        p_state = max(p_state or 0.0, _defloat(m.get("hetu_pilot_state"))
+                      or 0.0)
+        p_act = (p_act or 0.0) + (_defloat(
+            m.get("hetu_pilot_actuations_total")) or 0.0)
+        p_rb = (p_rb or 0.0) + (_defloat(
+            m.get("hetu_pilot_rollbacks_total")) or 0.0)
+    if p_state is not None:
+        line = (f"pilot: actuations {int(p_act or 0)}  "
+                f"rollbacks {int(p_rb or 0)}  "
+                + ("MEASURING" if p_state >= 1.0 else "idle"))
+        lines.append(line)
     # hetuchaos transport hardening (docs/FAULT_TOLERANCE.md "Chaos
     # testing & transport hardening"): retry/timeout/CRC health summed
     # across ranks, plus any injected-fault count when a chaos schedule

@@ -428,44 +428,100 @@ class PlanWatch:
 
 
 # ---------------------------------------------------------------------------
-# bounded plan-delta recommendation (advisory — actuation is a later PR)
+# bounded plan-delta recommendation — the PlanDelta registry
 # ---------------------------------------------------------------------------
+
+# The ONE registry of bounded plan deltas the watch may recommend and the
+# pilot (hetu_tpu/pilot.py) may actuate. Exactly the fault-kind-registry
+# discipline (faults.STEP_FAULT_KINDS): both producers and consumers
+# reference this dict, hetucheck's surface lint drift-checks it against
+# the docs catalogue and the pilot's consumer surface, and make_delta()
+# rejects an unknown kind naming this catalogue instead of silently
+# passing it through. "reversible" is load-bearing for the pilot: an
+# irreversible kind (the scheduler rejects server scale-down) is
+# blacklist-on-regression only — there is no revert era.
+DELTA_KINDS = {
+    # arm/disarm wire quantization on the live PS path (HETU_COMM_QUANT)
+    "comm_quant":     {"arg": "mode",  "reversible": True,  "scope": "wire"},
+    # flip ONE dense param's comm decision PS<->AllReduce (arg = new mode)
+    "comm_mode_flip": {"arg": "mode",  "reversible": True,  "scope": "param"},
+    # grow the PS server tier by one (the SIGUSR2/ScalePolicy path)
+    "ps_server_grow": {"arg": "count", "reversible": False, "scope": "cluster"},
+    # re-adopt a different device mesh via Executor.remesh
+    "remesh":         {"arg": "mesh",  "reversible": True,  "scope": "program"},
+}
+
+
+def make_delta(kind: str, target=None, arg=None,
+               expected_gain: float = 0.0, confidence: float = 0.0) -> dict:
+    """Build one machine-readable ``PlanDelta``: ``kind`` (registry key),
+    ``target`` (param name / server index / None), ``arg`` (the new value,
+    typed per the registry's ``arg`` field), ``expected_gain`` (fraction
+    of the diverging leg the delta should recover) and ``confidence``.
+    Unknown kinds raise naming the catalogue — the fault-parser
+    convention."""
+    if kind not in DELTA_KINDS:
+        raise ValueError(
+            f"unknown plan-delta kind {kind!r}; known: "
+            + ", ".join(sorted(DELTA_KINDS)))
+    return {"kind": kind, "target": target, "arg": arg,
+            "expected_gain": round(float(expected_gain), 4),
+            "confidence": round(float(confidence), 4)}
+
 
 def recommend(plan: dict, leg: str, ratio: float) -> dict:
     """The bounded delta hetuplan would now choose for a diverging leg —
     comm-mode flip, comm_quant toggle, or PS server count; never a full
     re-plan. Returned in the hetulint finding shape (suppressible id
     ``watch-divergence``, warn severity) so every renderer treats it like
-    any other finding."""
+    any other finding, plus a machine-readable ``delta`` (``make_delta``
+    schema; ``None`` for host legs, which no bounded delta reaches) the
+    pilot actuates."""
     params = plan.get("params") or []
     ps_params = [p for p in params if p.get("mode") == "PS"]
     dense_ps = [p for p in ps_params if not p.get("sparse")]
+    # expected gain: the fraction of the diverging leg above its
+    # prediction — what a perfect delta would claw back
+    gain = max(0.0, 1.0 - 1.0 / ratio) if ratio > 1.0 else 0.0
+    delta = None
     if leg in ("ps_pull", "ps_push"):
         if ps_params and (plan.get("comm_quant") or "off") == "off":
             msg = (f"PS {leg} leg at {ratio:.2f}x its prediction — bounded "
                    "delta: arm comm_quant=int8 (HETU_COMM_QUANT=int8); the "
                    "planner's wire algebra cuts PS bytes ~4x before any "
                    "re-layout")
+            delta = make_delta("comm_quant", arg="int8",
+                               expected_gain=min(gain, 0.75),
+                               confidence=0.8)
         elif dense_ps:
             names = ", ".join(p.get("param", "?") for p in dense_ps[:3])
             msg = (f"PS {leg} leg at {ratio:.2f}x its prediction with "
                    f"dense PS param(s) ({names}) — bounded delta: flip the "
                    "dense decisions PS->AllReduce (in-program collective "
                    "beats a slow boundary RPC)")
+            delta = make_delta("comm_mode_flip",
+                               target=dense_ps[0].get("param"),
+                               arg="AllReduce", expected_gain=gain,
+                               confidence=0.7)
         else:
             msg = (f"PS {leg} leg at {ratio:.2f}x its prediction — bounded "
                    "delta: raise the PS server count (heturun SIGUSR2 grows "
                    "one live; re-shards hot tables across more appliers)")
+            delta = make_delta("ps_server_grow", arg="+1",
+                               expected_gain=gain * 0.5, confidence=0.5)
     elif leg == "compute":
         msg = (f"compute leg at {ratio:.2f}x its prediction — recalibrate "
                "(hetulint --plan --calibrate TELEMETRY_DIR now reads this "
                "watch stream) and re-evaluate the dp/tp split; if the gap "
                "is HBM pressure, arm remat")
+        delta = make_delta("remesh", arg=plan.get("mesh"),
+                           expected_gain=gain * 0.3, confidence=0.3)
     else:
         msg = (f"host leg {leg} at {ratio:.2f}x its prediction — the plan "
                "treats host time as layout-invariant; enable prefetch / "
                "dataloader workers or move feed staging off the step path")
-    return {"lint": "watch-divergence", "severity": "warn", "message": msg}
+    return {"lint": "watch-divergence", "severity": "warn", "message": msg,
+            "delta": delta}
 
 
 # ---------------------------------------------------------------------------
@@ -792,6 +848,30 @@ def self_check(out=sys.stdout) -> int:
             {"comm_quant": "int8",
              "params": [{"param": "w", "mode": "PS", "sparse": False}]},
             "ps_pull", 2.0)["message"]
+
+        # PlanDelta schema: machine-readable, registry-validated
+        assert rec["delta"]["kind"] == "comm_quant" \
+            and rec["delta"]["arg"] == "int8", rec["delta"]
+        flip = recommend(
+            {"comm_quant": "int8",
+             "params": [{"param": "w", "mode": "PS", "sparse": False}]},
+            "ps_push", 2.0)["delta"]
+        assert flip == make_delta("comm_mode_flip", target="w",
+                                  arg="AllReduce",
+                                  expected_gain=flip["expected_gain"],
+                                  confidence=0.7), flip
+        assert 0.0 < flip["expected_gain"] <= 1.0, flip
+        grow = recommend({"comm_quant": "int8", "params": [
+            {"param": "e", "mode": "PS", "sparse": True}]},
+            "ps_pull", 3.0)["delta"]
+        assert grow["kind"] == "ps_server_grow", grow
+        assert recommend({}, "feed", 2.0)["delta"] is None
+        assert recommend({}, "compute", 2.0)["delta"]["kind"] == "remesh"
+        try:
+            make_delta("full_replan")
+            raise AssertionError("make_delta accepted an unknown kind")
+        except ValueError as ve:
+            assert "comm_mode_flip" in str(ve), ve
 
         # dir round-trip: stamp + rows + events -> report + gate cells
         with tempfile.TemporaryDirectory(prefix="hetuwatch_check_") as d:

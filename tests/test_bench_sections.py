@@ -20,7 +20,7 @@ SECTIONS = ["probe", "resnet:128:bf16", "resnet:128:f32", "bert",
             "transformer", "transformer350", "twin", "decode", "flash4k",
             "vit", "pipeline", "wdl", "comm_quant_ps", "comm_quant_dp",
             "introspect", "trail", "chaos", "kernels", "planner",
-            "snapshot"]
+            "snapshot", "pilot"]
 
 
 # sections whose cells must carry their own diagnosis fields: a
@@ -45,6 +45,9 @@ EXPECTED_KEYS = {
     # hetusave: the stall A/B must have actually taken snapshots, and the
     # cell carries the per-epoch wall cost behind the stall headline
     "snapshot": ("snapshot_stall_pct", "snapshot_wall_ms", "snapshots"),
+    # hetupilot: the armed-idle A/B must carry the direct boundary-walk
+    # stopwatch behind the headline, and prove no era ever opened
+    "pilot": ("pilot_overhead_pct", "pilot_boundary_ms", "eras"),
 }
 
 

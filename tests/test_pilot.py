@@ -1,0 +1,683 @@
+"""hetupilot — bounded self-tuning controller (docs/FAULT_TOLERANCE.md
+"Self-tuning with guardrails").
+
+The acceptance proofs live here: a seeded sustained-slow cluster run
+where the watch's plan-divergence recommendation drives EXACTLY ONE
+actuation era through the elastic two-phase barrier and commits on a
+real measured improvement; a deliberately-regressing forced delta that
+rolls back within K windows with the PS param AND its server optimizer
+slots restored bit-for-bit, then blacklisted; a crash mid-actuation
+whose next incarnation seals the open era as ``interrupted`` and keeps
+training from the pre-actuation world; and a plan_flap anti-oscillation
+run (5-seed soak in the slow tier) where the hysteretic governor keeps
+the controller budget-bounded with exactly-once push accounting and a
+final loss within tolerance of a never-actuated twin. The rest are the
+satellites: governor refusal strings, ledger round-trip + torn-tail
+tolerance, the FORCE/KILL test-mode gates, the jax-free CLI, and the
+heturun run_summary fold.
+"""
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from test_ps import run_cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_telemetry(tmp_path, monkeypatch):
+    from hetu_tpu import telemetry
+    telemetry.shutdown()
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_WATCH", raising=False)
+    monkeypatch.delenv("HETU_SLO_SPEC", raising=False)
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path / "tel"))
+    yield str(tmp_path / "tel")
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# governor: the hysteretic gate's exact refusal strings
+# ---------------------------------------------------------------------------
+
+def test_delta_signature_shapes():
+    from hetu_tpu.pilot import delta_signature
+    assert delta_signature({"kind": "comm_mode_flip", "target": "w1",
+                            "arg": "AllReduce"}) \
+        == "comm_mode_flip:w1:AllReduce"
+    # None target/arg render as empty segments (the FORCE grammar inverse)
+    assert delta_signature({"kind": "comm_quant", "target": None,
+                            "arg": "int8"}) == "comm_quant::int8"
+
+
+def test_governor_refusals_are_the_ledger_vocabulary():
+    from hetu_tpu.pilot import Governor, delta_signature
+    d = {"kind": "comm_quant", "target": None, "arg": "int8"}
+    g = Governor(spacing=10, cooldown=100, budget=1)
+    assert g.consider(d, 0) == "ok"
+    assert g.consider(d, 0, n_workers=2) == "multi-worker"
+    assert g.consider(d, 0, resize_pending=True) == "resize-pending"
+    assert g.consider(d, 0, chaos_climbing=True) == "chaos-climbing"
+    g.ban(delta_signature(d), 0)
+    assert g.consider(d, 50) == "blacklisted"
+    assert g.consider(d, 100) == "ok"        # cool-down expired
+    g.note_actuation(100)
+    assert g.consider(d, 105) == "budget-exhausted"   # budget=1 wins
+    g2 = Governor(spacing=10, cooldown=0, budget=5)
+    g2.note_actuation(100)
+    assert g2.consider(d, 105) == "spacing"
+    for r in ("budget-exhausted", "spacing", "blacklisted", "multi-worker",
+              "resize-pending", "chaos-climbing"):
+        assert r in Governor.REFUSALS
+
+
+# ---------------------------------------------------------------------------
+# ledger: crash-ordered persistence
+# ---------------------------------------------------------------------------
+
+def test_ledger_roundtrip_open_eras_and_torn_tail(tmp_path):
+    from hetu_tpu.pilot import ActuationLedger
+    d = {"kind": "comm_quant", "target": None, "arg": "int8"}
+    led = ActuationLedger(str(tmp_path / "pilot.jsonl"))
+    led.append(era=1, phase="propose", step=10, delta=d, baseline_ms=20.0)
+    led.append(era=1, phase="actuate", step=10, delta=d)
+    led.append(era=1, phase="verdict", verdict="rollback", step=18, delta=d,
+               before_ms=20.0, after_ms=30.0, ratio=1.5)
+    led.append(phase="abstain", signature="x", reason="spacing", step=19)
+    led.append(era=2, phase="propose", step=40, delta=d, baseline_ms=21.0)
+    led.append(era=2, phase="actuate", step=40, delta=d)
+    with open(led.path, "a") as f:
+        f.write('{"torn": tr')     # crash mid-write
+    recs = led.records()
+    assert len(recs) == 6          # torn tail tolerated
+    assert led.last_era() == 2
+    assert ActuationLedger.open_eras(recs) == [2]
+    s = ActuationLedger.summarize(recs)
+    assert (s["eras"], s["rollbacks"], s["open"], s["abstains"]) \
+        == (2, 1, 1, 1)
+    assert s["history"][0]["after_ms"] == 30.0
+    assert s["history"][0]["baseline_ms"] == 20.0
+
+
+def test_summarize_dir_absent_is_none(tmp_path):
+    from hetu_tpu.pilot import summarize_dir
+    assert summarize_dir(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# FORCE grammar + test-mode gates
+# ---------------------------------------------------------------------------
+
+def test_force_requires_test_mode(monkeypatch):
+    from hetu_tpu.pilot import Pilot, PilotError
+    monkeypatch.delenv("HETU_TEST_MODE", raising=False)
+    with pytest.raises(PilotError, match="HETU_TEST_MODE"):
+        Pilot._parse_force("comm_quant::int8@5")
+
+
+def test_force_grammar(monkeypatch):
+    from hetu_tpu.pilot import Pilot, PilotError
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    delta, at = Pilot._parse_force("comm_mode_flip:w1:AllReduce@12")
+    assert at == 12
+    assert (delta["kind"], delta["target"], delta["arg"]) \
+        == ("comm_mode_flip", "w1", "AllReduce")
+    delta, at = Pilot._parse_force("comm_quant::int8@3")
+    assert delta["target"] is None and delta["arg"] == "int8"
+    with pytest.raises(PilotError, match="@step"):
+        Pilot._parse_force("comm_quant::int8")     # no @step
+    with pytest.raises(ValueError, match="comm_quant"):
+        Pilot._parse_force("full_replan@5")        # unknown kind names
+    assert Pilot._parse_force(None) is None        # the catalogue
+
+
+# ---------------------------------------------------------------------------
+# interrupted-era sealing + the allow gate (no cluster, stub executor)
+# ---------------------------------------------------------------------------
+
+def test_interrupted_era_sealed_on_construction(tmp_path):
+    from hetu_tpu.pilot import ActuationLedger, Pilot
+    d = {"kind": "comm_mode_flip", "target": "w1", "arg": "AllReduce"}
+    led = ActuationLedger(str(tmp_path / "pilot.jsonl"))
+    led.append(era=1, phase="propose", step=30, delta=d, baseline_ms=15.0)
+    led.append(era=1, phase="actuate", step=30, delta=d)
+    # crash: no verdict. The next incarnation's state came from config
+    # (+ restore), i.e. the PRE-actuation era — sealing, not reverting
+    pil = Pilot(SimpleNamespace(), directory=str(tmp_path))
+    recs = pil.ledger.records()
+    v = [r for r in recs if r.get("phase") == "verdict"]
+    assert len(v) == 1 and v[0]["verdict"] == "interrupted"
+    assert v[0]["era"] == 1
+    assert pil.governor.spent == 1                 # counts the budget
+    assert pil.governor.banned_until("comm_mode_flip:w1:AllReduce") \
+        is not None
+    # idempotent: a second incarnation must not double-seal
+    pil2 = Pilot(SimpleNamespace(), directory=str(tmp_path))
+    assert len([r for r in pil2.ledger.records()
+                if r.get("phase") == "verdict"]) == 1
+
+
+def test_allow_gate_refuses_unlisted_kinds(tmp_path):
+    from hetu_tpu.pilot import Pilot
+    pil = Pilot(SimpleNamespace(), directory=str(tmp_path),
+                allow="comm_quant")
+    pil.feed_recommendation(
+        {"kind": "comm_mode_flip", "target": "w1", "arg": "AllReduce"},
+        {"step": 7})
+    assert pil._pending is None
+    abst = [r for r in pil.ledger.records() if r.get("phase") == "abstain"]
+    assert len(abst) == 1 and abst[0]["reason"] == "kind-not-allowed"
+    # an allowed kind is kept pending for the next step boundary
+    pil.feed_recommendation({"kind": "comm_quant", "target": None,
+                             "arg": "int8"}, {"step": 8})
+    assert pil._pending is not None
+    # a second recommendation while one is pending is dropped
+    pil.feed_recommendation({"kind": "comm_quant", "target": None,
+                             "arg": "off"}, {"step": 9})
+    assert pil._pending[0]["arg"] == "int8"
+
+
+def test_from_env_resolution(tmp_path, monkeypatch):
+    from hetu_tpu.pilot import Pilot
+    monkeypatch.delenv("HETU_PILOT_DIR", raising=False)
+    monkeypatch.delenv("HETU_PILOT_FORCE", raising=False)
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path / "tel"))
+    monkeypatch.setenv("HETU_PILOT_K", "4")
+    monkeypatch.setenv("HETU_PILOT_SPACING", "9")
+    monkeypatch.setenv("HETU_PILOT_BUDGET", "2")
+    monkeypatch.setenv("HETU_PILOT_ALLOW", "comm_quant, remesh")
+    pil = Pilot.from_env(SimpleNamespace())
+    assert pil.dir == os.path.join(str(tmp_path / "tel"), "pilot")
+    assert pil.k == 4 and pil.governor.spacing == 9
+    assert pil.governor.budget == 2
+    assert pil.allow == ("comm_quant", "remesh")
+
+
+# ---------------------------------------------------------------------------
+# jax-free CLI + run_summary fold
+# ---------------------------------------------------------------------------
+
+def test_hetupilot_check_cli():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetupilot"),
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "hetupilot self-test: PASS" in out.stdout, out.stdout
+
+
+def _write_commit_ledger(directory):
+    from hetu_tpu.pilot import ActuationLedger
+    d = {"kind": "comm_mode_flip", "target": "w1", "arg": "AllReduce"}
+    led = ActuationLedger(os.path.join(directory, "pilot.jsonl"))
+    led.append(era=1, phase="propose", step=12, delta=d,
+               cause={"leg": "ps_pull"}, baseline_ms=180.0)
+    led.append(era=1, phase="actuate", step=12, delta=d)
+    led.append(era=1, phase="verdict", verdict="commit", step=20, delta=d,
+               before_ms=180.0, after_ms=6.0, ratio=0.0333)
+
+
+def test_hetupilot_report_cli(tmp_path):
+    _write_commit_ledger(str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetupilot"),
+         str(tmp_path)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "commits 1" in out.stdout, out.stdout
+    assert "comm_mode_flip w1 -> AllReduce" in out.stdout, out.stdout
+    outj = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetupilot"),
+         str(tmp_path), "--json"], capture_output=True, text=True)
+    rep = json.loads(outj.stdout)
+    assert rep["commits"] == 1 and rep["eras"] == 1
+    assert rep["history"][0]["after_ms"] == 6.0
+    # no ledger -> usage error, not a crash
+    empty = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetupilot"),
+         str(tmp_path / "nowhere")], capture_output=True, text=True)
+    assert empty.returncode == 2
+
+
+def test_run_summary_records_pilot(tmp_path):
+    from hetu_tpu import runner
+    with open(tmp_path / "metrics-r0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "run_info", "rank": 0,
+                            "comm_mode": "PS"}) + "\n")
+        f.write(json.dumps({"kind": "step", "rank": 0, "step": 20,
+                            "step_ms": 6.0}) + "\n")
+    _write_commit_ledger(os.path.join(str(tmp_path), "pilot"))
+    runner._tel_dir = str(tmp_path)
+    try:
+        runner._write_telemetry_summary(0, False, 1)
+    finally:
+        runner._tel_dir = None
+    summary = json.load(open(tmp_path / "run_summary.json"))
+    assert summary["pilot"]["commits"] == 1
+    assert summary["pilot"]["history"][0]["delta"]["kind"] \
+        == "comm_mode_flip"
+
+
+# ---------------------------------------------------------------------------
+# live cluster proofs — worker bodies (module level: spawn pickles by ref)
+# ---------------------------------------------------------------------------
+
+def _dense_ps_build(ht, tag, sub, plan=None, watch=0, slo=None,
+                    opt=None):
+    """One dense softmax job whose single fc weight lives on the PS
+    (comm_mode='PS'): the flip target. Disjoint server tensor ids per
+    executor (the bench_wdl_ps convention)."""
+    os.environ["HETU_PS_ID_BASE"] = str(tag * 1000)
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    w = ht.init.xavier_uniform((8, 2), name=f"w{tag}")
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+    train_op = (opt or ht.optim.SGDOptimizer(0.1)).minimize(loss)
+    ex = ht.Executor({sub: [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="PS", bsp=True, prefetch=False,
+                     telemetry="metrics", seed=0, plan=plan, watch=watch,
+                     slo=slo)
+    return ex, x, y_
+
+
+def _drive(ex, sub, x, y_, steps, rng):
+    losses = []
+    for _ in range(steps):
+        bx = rng.randn(16, 8).astype(np.float32)
+        by = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        out = ex.run(sub, feed_dict={x: bx, y_: by})
+        losses.append(float(out[0].asnumpy()))
+    return losses
+
+
+def _calibrated_plan(ht, comm_quant, params, sub="calib"):
+    """Measure the clean job's steady-state legs and wrap them in a Plan
+    (the test_watch calibration shape) — what hetuplan WOULD have
+    promised had it planned this exact job."""
+    from hetu_tpu import telemetry
+    from hetu_tpu.analysis.planner import ParamDecision, Plan
+    from hetu_tpu.telemetry import trail
+    ex0, x0, y0 = _dense_ps_build(ht, 0, sub)
+    assert ex0.pilot is None     # HETU_PILOT set but the watch is unarmed
+    _drive(ex0, sub, x0, y0, 8, np.random.RandomState(0))
+    telemetry.get().flush()
+    legs_seen = []
+    with open(os.path.join(os.environ["HETU_TELEMETRY_DIR"],
+                           "metrics-r0.jsonl")) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("kind") == "step" and r.get("sub") == sub \
+                    and "compile_ms" not in (r.get("phases") or {}):
+                legs_seen.append(trail.step_legs(r["phases"]))
+    assert len(legs_seen) >= 5, len(legs_seen)
+    mean = {leg: sum(l[leg] for l in legs_seen) / len(legs_seen)
+            for leg in trail.LEGS}
+    ex0.close()
+    bd = {"compute_ms": mean["compute"], "allreduce_ms": 0.0,
+          "ps_ms": mean["ps_pull"] + mean["ps_push"],
+          "host_ms": mean["feed"] + mean["poststep"], "bubble_frac": 0.0}
+    decisions = [ParamDecision(
+        name=p["param"], size_elems=16, nbytes=64, dim=2,
+        sparse=p["sparse"], density=1.0, touched_rows=0.0,
+        mode=p["mode"], reason=p.get("reason", "")) for p in params]
+    return Plan(devices=1, mesh={"dp": 1, "tp": 1, "pp": 1},
+                comm_mode="PS", comm_quant=comm_quant, zero1=False,
+                remat=False,
+                predicted_step_ms=sum(v for k, v in bd.items()
+                                      if k.endswith("_ms")),
+                breakdown=bd, memory={}, params=decisions, candidates=[])
+
+
+def _pilot_commit_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu import ps as ps_pkg
+    from hetu_tpu import telemetry
+    from hetu_tpu.elastic import resize_state, sched_addr_from_env
+    from hetu_tpu.pilot import ActuationLedger
+    from hetu_tpu.resilience import FaultInjector, Supervisor
+
+    # comm_quant "int8" in the plan so recommend() skips its first branch
+    # and names the dense PS param — the comm_mode_flip delta under test
+    plan = _calibrated_plan(ht, "int8",
+                            [{"param": "w1", "mode": "PS", "sparse": False,
+                              "reason": "dense fc"}])
+    ex, x, y_ = _dense_ps_build(ht, 1, "train", plan=plan, watch=1)
+    pil = ex.pilot
+    assert pil is not None and ex.plan_watch is not None
+    # a sustained slow half-period: plan_flap with a huge period re-arms
+    # the one-shot server apply delay at EVERY boundary
+    ex.attach_supervisor(Supervisor(
+        fault_injector=FaultInjector("plan_flap@1:1000000")))
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(40):
+        losses += _drive(ex, "train", x, y_, 1, rng)
+        if ActuationLedger.summarize(
+                pil.ledger.records())["commits"] >= 1:
+            break
+    s = ActuationLedger.summarize(pil.ledger.records())
+    assert s["commits"] == 1, s
+    assert s["eras"] == 1 and s["rollbacks"] == 0, s   # exactly one era
+    h = s["history"][0]
+    assert h["delta"]["kind"] == "comm_mode_flip", h
+    assert h["delta"]["target"] == "w1", h
+    # REAL measured improvement: the flip removed the slowed PS pushes
+    assert h["ratio"] < 1.0 and h["after_ms"] < h["baseline_ms"], h
+    assert h["baseline_ms"] >= 100.0, h     # the 150 ms flap dominated
+    # the flip really happened: w1 is device-resident now
+    assert all(q.node.name != "w1"
+               for q in ex.ps_runtime.params.values())
+    assert "w1" in [n.name for n in ex.param_nodes]
+    # era attribution: the scheduler counted ONE pilot_commit epoch
+    st = resize_state(*sched_addr_from_env())
+    assert st["pilot_commit_epochs"] == 1, st
+    assert st["pilot_rollback_epochs"] == 0, st
+    # training stayed healthy through the actuation
+    assert np.isfinite(losses).all()
+    assert np.isfinite(_drive(ex, "train", x, y_, 2, rng)).all()
+    # exactly-once accounting survived capture + flip + commit barrier
+    ex.ps_runtime.drain()
+    comm = ps_pkg.get_worker_communicate()
+    cs = comm.ClientStats()
+    applied = sum(
+        int(comm.ServerStats(srv)["updates"])
+        - max(int(comm.ServerStats(srv)["restored_updates"]), 0)
+        for srv in range(1))
+    assert int(cs["pushes_ok"]) == applied, (cs["pushes_ok"], applied)
+    ex.close()
+    telemetry.shutdown()
+
+
+def test_pilot_live_commit_on_seeded_divergence(tmp_path, monkeypatch):
+    """Acceptance: seeded sustained PS slowness -> the watch's
+    recommendation -> EXACTLY ONE actuation era through the two-phase
+    barrier -> measured after/before improvement -> commit, all in the
+    ledger and the scheduler's era counters."""
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_WATCH", raising=False)
+    monkeypatch.delenv("HETU_SLO_SPEC", raising=False)
+    monkeypatch.setenv("HETU_WATCH_MIN_MS", "5")
+    monkeypatch.setenv("HETU_PLAN_FLAP_MS", "150")
+    monkeypatch.setenv("HETU_PILOT", "1")
+    monkeypatch.setenv("HETU_PILOT_DIR", str(tmp_path / "pilot"))
+    monkeypatch.setenv("HETU_PILOT_SPACING", "0")
+    monkeypatch.setenv("HETU_PILOT_BASELINE", "3")
+    monkeypatch.setenv("HETU_PILOT_K", "3")
+    monkeypatch.setenv("HETU_PILOT_WARMUP", "1")
+    monkeypatch.setenv("HETU_PILOT_BUDGET", "1")
+    monkeypatch.delenv("HETU_PILOT_FORCE", raising=False)
+    monkeypatch.delenv("HETU_PILOT_KILL", raising=False)
+    run_cluster(_pilot_commit_worker, tmp_path, n_workers=1, n_servers=1)
+
+    # the ledger tells the whole story, phase-ordered
+    recs = [json.loads(l) for l in
+            open(tmp_path / "pilot" / "pilot.jsonl")]
+    phases = [r["phase"] for r in recs if r.get("era") == 1]
+    assert phases == ["propose", "actuate", "verdict"], recs
+    assert [r for r in recs if r.get("phase") == "verdict"][0]["verdict"] \
+        == "commit"
+    # the jax-free CLI renders it
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetupilot"),
+         str(tmp_path / "pilot")], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "commits 1" in out.stdout and "rollbacks 0" in out.stdout
+    # the gauges rode the final telemetry snapshot
+    mrecs = [json.loads(l) for l in
+             open(tmp_path / "metrics-r0.jsonl")]
+    final = [r for r in mrecs if r.get("kind") == "final"][-1]["metrics"]
+    assert final.get("hetu_pilot_actuations_total") == 1, final
+    assert final.get("hetu_pilot_rollbacks_total", 0) == 0
+    assert final.get("hetu_pilot_state") == 0.0     # idle after commit
+
+
+def _pilot_rollback_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry
+    from hetu_tpu.elastic import resize_state, sched_addr_from_env
+
+    # momentum makes the bit-identity claim sharp: the server-side
+    # velocity shard evolves every step, so a sloppy restore cannot pass
+    ex, x, y_ = _dense_ps_build(
+        ht, 0, "train", watch=1, slo="step_ms<100000",
+        opt=ht.optim.MomentumOptimizer(0.1, momentum=0.9))
+    pil = ex.pilot
+    assert pil is not None
+    rng = np.random.RandomState(1)
+    _drive(ex, "train", x, y_, 6, rng)       # steps 0..5; FORCE is @6
+    p = next(q for q in ex.ps_runtime.params.values()
+             if q.node.name == "w0")
+    pre_w = np.array(ex.ps_runtime.pull_dense_value(p), copy=True)
+    pre_slots = pil._pull_server_slots(p)
+    assert pre_slots is not None and pre_slots["accum"].size == pre_w.size
+    assert np.abs(pre_slots["accum"]).max() > 0   # nontrivial velocity
+    _drive(ex, "train", x, y_, 1, rng)       # boundary 6 actuates the flip
+    assert pil.state == "measuring" and pil._era is not None
+    assert all(q.node.name != "w0"
+               for q in ex.ps_runtime.params.values())
+    _drive(ex, "train", x, y_, 2, rng)       # steps 7,8 -> K=2 windows
+    # the verdict boundary with NO ensuing training step: what it
+    # restores is exactly what we can observe
+    pil.step_boundary(ex.subexecutors["train"], 9)
+    assert pil.state == "idle", "verdict never fired"
+    v = [r for r in pil.ledger.records() if r.get("phase") == "verdict"]
+    assert len(v) == 1 and v[0]["verdict"] == "rollback", v
+    assert v[0]["ratio"] > 0.0               # REGRESS_RATIO=0.0 forced it
+    # bit-identical: the param is back on the server with its captured
+    # bits, and so is the server-side optimizer slot
+    p2 = next(q for q in ex.ps_runtime.params.values()
+              if q.node.name == "w0")
+    post_w = np.array(ex.ps_runtime.pull_dense_value(p2), copy=True)
+    assert np.array_equal(pre_w, post_w), \
+        float(np.abs(pre_w - post_w).max())
+    post_slots = pil._pull_server_slots(p2)
+    assert np.array_equal(pre_slots["accum"], post_slots["accum"]), \
+        float(np.abs(pre_slots["accum"] - post_slots["accum"]).max())
+    # blacklisted for the cool-down + attributed in the era counters
+    assert pil.governor.banned_until("comm_mode_flip:w0:AllReduce") \
+        is not None
+    st = resize_state(*sched_addr_from_env())
+    assert st["pilot_rollback_epochs"] == 1, st
+    assert st["pilot_commit_epochs"] == 0, st
+    # training continues from the restored world
+    assert np.isfinite(_drive(ex, "train", x, y_, 2, rng)).all()
+    ex.close()
+    telemetry.shutdown()
+
+
+def test_pilot_rollback_is_bit_identical_and_blacklisted(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: a deliberately-regressing delta (REGRESS_RATIO=0.0
+    makes ANY measured ratio a regression) rolls back within K windows
+    — param and server optimizer slots restored bit-for-bit through the
+    pilot_rollback-tagged barrier — and its signature is blacklisted."""
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_WATCH", raising=False)
+    monkeypatch.delenv("HETU_SLO_SPEC", raising=False)
+    monkeypatch.setenv("HETU_PILOT", "1")
+    monkeypatch.setenv("HETU_PILOT_DIR", str(tmp_path / "pilot"))
+    monkeypatch.setenv("HETU_PILOT_FORCE", "comm_mode_flip:w0:AllReduce@6")
+    monkeypatch.setenv("HETU_PILOT_REGRESS_RATIO", "0.0")
+    monkeypatch.setenv("HETU_PILOT_SPACING", "0")
+    monkeypatch.setenv("HETU_PILOT_BASELINE", "2")
+    monkeypatch.setenv("HETU_PILOT_K", "2")
+    monkeypatch.setenv("HETU_PILOT_WARMUP", "0")
+    monkeypatch.setenv("HETU_PILOT_BUDGET", "1")
+    monkeypatch.setenv("HETU_PILOT_COOLDOWN", "10000")
+    monkeypatch.delenv("HETU_PILOT_KILL", raising=False)
+    run_cluster(_pilot_rollback_worker, tmp_path, n_workers=1, n_servers=1)
+    mrecs = [json.loads(l) for l in open(tmp_path / "metrics-r0.jsonl")]
+    final = [r for r in mrecs if r.get("kind") == "final"][-1]["metrics"]
+    assert final.get("hetu_pilot_rollbacks_total") == 1, final
+
+
+def _pilot_crash_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    ex, x, y_ = _dense_ps_build(ht, 0, "train", watch=1,
+                                slo="step_ms<100000")
+    assert ex.pilot is not None
+    _drive(ex, "train", x, y_, 10, np.random.RandomState(2))
+    raise AssertionError("unreachable: the armed kill never fired")
+
+
+def _pilot_recover_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry
+    ex, x, y_ = _dense_ps_build(ht, 0, "train", watch=1,
+                                slo="step_ms<100000")
+    pil = ex.pilot
+    assert pil is not None
+    # __init__ already sealed the crashed incarnation's open era: this
+    # incarnation's state was rebuilt from config, i.e. the
+    # PRE-actuation plan — a known era, nothing to revert
+    v = [r for r in pil.ledger.records() if r.get("phase") == "verdict"]
+    assert len(v) == 1, v
+    assert v[0]["verdict"] == "interrupted" and v[0]["era"] == 1, v
+    assert pil.governor.spent == 1           # the era consumed the budget
+    assert pil.governor.banned_until("comm_quant::int8") is not None
+    # training proceeds from the pre-actuation world
+    assert np.isfinite(
+        _drive(ex, "train", x, y_, 4, np.random.RandomState(3))).all()
+    assert pil.state == "idle"
+    ex.close()
+    telemetry.shutdown()
+
+
+def test_pilot_crash_mid_actuation_restores_to_known_era(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: HETU_PILOT_KILL=actuate dies INSIDE the barrier (after
+    capture, before the delta applied); the untagged abort never counts
+    the era, the ledger holds an open era, and the next incarnation
+    seals it ``interrupted``, spends its budget, blacklists the delta and
+    keeps training."""
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_WATCH", raising=False)
+    monkeypatch.delenv("HETU_SLO_SPEC", raising=False)
+    monkeypatch.setenv("HETU_PILOT", "1")
+    monkeypatch.setenv("HETU_PILOT_DIR", str(tmp_path / "pilot"))
+    monkeypatch.setenv("HETU_PILOT_FORCE", "comm_quant::int8@4")
+    monkeypatch.setenv("HETU_PILOT_KILL", "actuate")
+    monkeypatch.setenv("HETU_PILOT_SPACING", "0")
+    monkeypatch.setenv("HETU_PILOT_BASELINE", "2")
+    with pytest.raises(RuntimeError, match="died without reporting"):
+        run_cluster(_pilot_crash_worker, tmp_path, n_workers=1,
+                    n_servers=1)
+    from hetu_tpu.pilot import ActuationLedger
+    led = ActuationLedger(str(tmp_path / "pilot" / "pilot.jsonl"))
+    recs = led.records()
+    assert ActuationLedger.open_eras(recs) == [1], recs
+    assert not [r for r in recs if r.get("phase") == "verdict"]
+    # incarnation 2: fresh cluster, same ledger, kill and force disarmed
+    monkeypatch.delenv("HETU_PILOT_KILL")
+    monkeypatch.delenv("HETU_PILOT_FORCE")
+    run_cluster(_pilot_recover_worker, tmp_path, n_workers=1, n_servers=1)
+    s = ActuationLedger.summarize(led.records())
+    assert s["interrupted"] == 1 and s["open"] == 0, s
+
+
+# ---------------------------------------------------------------------------
+# anti-oscillation: plan_flap chaos must leave the controller bounded
+# ---------------------------------------------------------------------------
+
+def _pilot_flap_worker(client, rank, tmpdir):
+    import hetu_tpu as ht
+    from hetu_tpu import ps as ps_pkg
+    from hetu_tpu import telemetry
+    from hetu_tpu.pilot import ActuationLedger
+    from hetu_tpu.resilience import FaultInjector, Supervisor
+
+    seed = int(os.environ["HETU_PILOT_TEST_SEED"])
+    # comm_quant "off" + a dense PS param: the first recommendation is
+    # the cheap wire-level comm_quant delta — the flap's favourite bait
+    plan = _calibrated_plan(ht, "off",
+                            [{"param": "w1", "mode": "PS", "sparse": False,
+                              "reason": "dense fc"}])
+    flap = f"plan_flap@{1 + seed % 3}:4"
+    ex, x, y_ = _dense_ps_build(ht, 1, "train", plan=plan, watch=1)
+    pil = ex.pilot
+    assert pil is not None
+    ex.attach_supervisor(Supervisor(fault_injector=FaultInjector(flap)))
+    rng = np.random.RandomState(seed)
+    losses = _drive(ex, "train", x, y_, 36, rng)
+    s = ActuationLedger.summarize(pil.ledger.records())
+    # budget-bounded, and NO oscillation: under a flapping signal the
+    # same delta must never actuate twice (cool-down > run length)
+    assert s["eras"] <= 2, s
+    sigs = [f'{r["delta"]["kind"]}:{r["delta"].get("target") or ""}'
+            f':{r["delta"].get("arg") or ""}'
+            for r in pil.ledger.records() if r.get("phase") == "actuate"]
+    assert len(sigs) == len(set(sigs)), f"oscillated: {sigs}"
+    ex.close()
+
+    # the never-actuated twin: same data, same chaos, no controller
+    ex2, x2, y2 = _dense_ps_build(ht, 2, "twin")
+    assert ex2.pilot is None            # watch unarmed -> no controller
+    ex2.attach_supervisor(Supervisor(fault_injector=FaultInjector(flap)))
+    rng2 = np.random.RandomState(seed)
+    twin = _drive(ex2, "twin", x2, y2, 36, rng2)
+    assert np.isfinite(losses).all() and np.isfinite(twin).all()
+    # a rollback forfeits at most its K measuring windows of training —
+    # the final loss stays within tolerance of the twin's
+    assert abs(np.mean(losses[-5:]) - np.mean(twin[-5:])) < 0.35, \
+        (np.mean(losses[-5:]), np.mean(twin[-5:]))
+    # exactly-once accounting across every actuation/rollback barrier
+    ex2.ps_runtime.drain()
+    comm = ps_pkg.get_worker_communicate()
+    cs = comm.ClientStats()
+    applied = sum(
+        int(comm.ServerStats(srv)["updates"])
+        - max(int(comm.ServerStats(srv)["restored_updates"]), 0)
+        for srv in range(1))
+    assert int(cs["pushes_ok"]) == applied, (cs["pushes_ok"], applied)
+    ex2.close()
+    telemetry.shutdown()
+
+
+def _flap_env(monkeypatch, tmp_path, seed):
+    monkeypatch.setenv("HETU_TEST_MODE", "1")
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("HETU_TELEMETRY", raising=False)
+    monkeypatch.delenv("HETU_WATCH", raising=False)
+    monkeypatch.delenv("HETU_SLO_SPEC", raising=False)
+    monkeypatch.delenv("HETU_PILOT_FORCE", raising=False)
+    monkeypatch.delenv("HETU_PILOT_KILL", raising=False)
+    monkeypatch.setenv("HETU_WATCH_MIN_MS", "5")
+    monkeypatch.setenv("HETU_PLAN_FLAP_MS", "60")
+    monkeypatch.setenv("HETU_PILOT", "1")
+    monkeypatch.setenv("HETU_PILOT_DIR", str(tmp_path / "pilot"))
+    monkeypatch.setenv("HETU_PILOT_SPACING", "2")
+    monkeypatch.setenv("HETU_PILOT_BASELINE", "2")
+    monkeypatch.setenv("HETU_PILOT_K", "2")
+    monkeypatch.setenv("HETU_PILOT_WARMUP", "0")
+    monkeypatch.setenv("HETU_PILOT_BUDGET", "2")
+    monkeypatch.setenv("HETU_PILOT_COOLDOWN", "50")
+    monkeypatch.setenv("HETU_PILOT_TEST_SEED", str(seed))
+
+
+def test_pilot_flap_never_oscillates(tmp_path, monkeypatch):
+    """plan_flap alternates slow/fast half-periods every 4 steps: the
+    hysteretic governor must keep the controller budget-bounded with no
+    repeat actuation of the same signature, exactly-once accounting and
+    a final loss within tolerance of the never-actuated twin."""
+    _flap_env(monkeypatch, tmp_path, seed=1)
+    run_cluster(_pilot_flap_worker, tmp_path, n_workers=1, n_servers=1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [2, 3, 4, 5, 6])
+def test_pilot_flap_soak_5seed(tmp_path, monkeypatch, seed):
+    """The 5-seed acceptance soak: different data + flap phases, same
+    zero-oscillation and exactly-once guarantees every time."""
+    _flap_env(monkeypatch, tmp_path, seed=seed)
+    run_cluster(_pilot_flap_worker, tmp_path, n_workers=1, n_servers=1)
