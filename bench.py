@@ -503,6 +503,96 @@ def bench_pilot_overhead(width=64, batch=128, iters=60, warmup=10,
         shutil.rmtree(tdir, ignore_errors=True)
 
 
+def bench_story_overhead(width=64, batch=128, iters=4000, warmup=400,
+                         windows=6, step_iters=40, step_warmup=8):
+    """hetustory run-identity stamping cost (docs/OBSERVABILITY.md pillar
+    7 acceptance: < 0.5%/step): every JSONL row a heturun job writes now
+    carries (run_id, inc). The pair is merged into the sink's
+    PRESERIALIZED base-field prefix at Telemetry construction, so the
+    per-record cost is writing ~30 extra bytes, not serializing two extra
+    fields per step. A/B on the hot step-record path itself — two
+    Telemetry instances, stamped vs not, interleaved best-of-N windows
+    (the watch/pilot cell discipline: the cost sits far below container
+    noise, so headline the direct per-record reading) — then amortized
+    against a real dense training step measured in-process."""
+    import shutil
+    import tempfile
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry as tel_mod
+    tdir = tempfile.mkdtemp(prefix="hetu_story_bench_")
+    saved = {k: os.environ.get(k)
+             for k in ("HETU_RUN_ID", "HETU_RUN_INCARNATION")}
+    phases = {"compute": 1.1, "ps_pull": 0.2, "ps_push": 0.2}
+    try:
+        os.environ.pop("HETU_RUN_ID", None)
+        tel_off = tel_mod.Telemetry(
+            "metrics", os.path.join(tdir, "off"), 0)
+        os.environ["HETU_RUN_ID"] = "bench-20260101-000000-1"
+        os.environ["HETU_RUN_INCARNATION"] = "1"
+        tel_on = tel_mod.Telemetry(
+            "metrics", os.path.join(tdir, "on"), 0)
+
+        def window(tel, base):
+            for i in range(warmup):
+                tel.step_record("train", base + i, 1.234, phases=phases)
+            tel.sink.flush()
+            t0 = time.time()
+            for i in range(iters):
+                tel.step_record("train", base + warmup + i, 1.234,
+                                phases=phases)
+            tel.sink.flush()
+            return (time.time() - t0) / iters * 1e6   # us/record
+
+        off_w, on_w = [], []
+        for k in range(windows):   # interleaved: drift hits both arms
+            base = k * (warmup + iters)
+            off_w.append(window(tel_off, base))
+            on_w.append(window(tel_on, base))
+        us_off, us_on = min(off_w), min(on_w)
+        with open(os.path.join(tdir, "off", "metrics-r0.jsonl")) as f:
+            row_off = len(f.readline())
+        with open(os.path.join(tdir, "on", "metrics-r0.jsonl")) as f:
+            row_on = len(f.readline())
+        tel_off.close()
+        tel_on.close()
+
+        # amortize against a real dense training step on this host
+        x = ht.Variable(name="x", trainable=False)
+        y_ = ht.Variable(name="y_", trainable=False)
+        w = ht.init.random_normal((width, 8), stddev=0.05, name="w_story")
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(x, w), y_), [0])
+        train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                         seed=0)
+        rng = np.random.RandomState(0)
+        feeds = {x: rng.randn(batch, width).astype(np.float32),
+                 y_: np.eye(8, dtype=np.float32)[
+                     rng.randint(0, 8, batch)]}
+        for _ in range(step_warmup):
+            ex.run("train", feed_dict=feeds)
+        t0 = time.time()
+        for _ in range(step_iters):
+            ex.run("train", feed_dict=feeds)
+        ref_step_ms = (time.time() - t0) / step_iters * 1000
+        ex.close()
+        return {"record_us_off": round(us_off, 3),
+                "record_us_on": round(us_on, 3),
+                "row_bytes_off": row_off, "row_bytes_on": row_on,
+                "ref_step_ms": round(ref_step_ms, 4),
+                "story_overhead_pct": round(
+                    max(0.0, us_on - us_off) / 1000 / ref_step_ms * 100,
+                    4),
+                "windows": windows}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 def bench_chaos_hardening(batch_size=128, iters=60, rows=5000, width=16,
                           warmup=10, windows=8):
     """hetuchaos transport-hardening cost (docs/FAULT_TOLERANCE.md
@@ -1536,6 +1626,12 @@ def _run_section(name):
               if smoke else {})
         out = bench_pilot_overhead(**kw)
         out["servers"] = 1
+    elif name == "story":
+        # hetustory run-identity stamping cell (docs/OBSERVABILITY.md
+        # pillar 7): the <0.5%/step claim is MEASURED here, not asserted
+        kw = (dict(iters=500, warmup=50, windows=2, step_iters=8,
+                   step_warmup=2) if smoke else {})
+        out = bench_story_overhead(**kw)
     elif name == "probe":
         import jax
         import jax.numpy as jnp
@@ -1629,6 +1725,9 @@ SECTION_ENV = {
     # hetupilot armed-idle A/B: the boundary walk being measured is
     # host-side dict arithmetic, far below tunnel jitter
     "pilot": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    # hetustory base-field stamping A/B: pure host-side serialization,
+    # far below tunnel jitter
+    "story": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
     # hetuchaos CRC-hardening A/B: same reasoning as trail — the checksum
     # cost being measured is host-side and far below tunnel jitter
     "chaos": {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
@@ -1803,6 +1902,8 @@ class _Ledger:
                       "watch_amortized_pct", "observations",
                       "pilot_overhead_pct", "pilot_boundary_ms",
                       "pilot_amortized_pct",
+                      "story_overhead_pct", "record_us_off",
+                      "record_us_on",
                       "client_spans", "step_ms_off",
                       "step_ms_on", "bytes_wire_ratio", "auc_off",
                       "auc_int8", "auc_delta", "final_loss_off",
@@ -1981,6 +2082,7 @@ def main():
                      ("trail_overhead", "trail", 600),
                      ("watch_overhead", "watch", 420),
                      ("pilot_overhead", "pilot", 420),
+                     ("story_overhead", "story", 420),
                      ("chaos_overhead", "chaos", 600),
                      ("snapshot_overhead", "snapshot", 600),
                      ("kernels_tier", "kernels", 600),

@@ -170,6 +170,28 @@ def self_check(root: str) -> int:
     expect(bool(drifted), "seeded undocumented plan-delta kind yields a "
                           "delta-kind-undocumented error")
 
+    # 3d. seeded ledger-kind drift, both directions: a producer emitting a
+    # kind the story registry never heard of, and a registry kind the
+    # docs/OBSERVABILITY.md ledger catalogue does not list
+    exec_rel = "hetu_tpu/graph/executor.py"
+    with open(os.path.join(root, exec_rel), "r", encoding="utf-8") as f:
+        etext = f.read()
+    overlay = {exec_rel: etext + '\n_ROGUE = {"kind": "rogue_kind"}\n'}
+    drifted = [f for f in analyze_surface(root, overlay=overlay)
+               if f.lint == "ledger-kind-drift"
+               and f.op_name == "rogue_kind"]
+    expect(bool(drifted), "seeded unregistered record kind yields a "
+                          "ledger-kind-drift error")
+    obs_rel = "docs/OBSERVABILITY.md"
+    with open(os.path.join(root, obs_rel), "r", encoding="utf-8") as f:
+        otext = f.read()
+    drifted = [f for f in analyze_surface(
+                   root, overlay={obs_rel: otext.replace("`finding`", "")})
+               if f.lint == "ledger-kind-drift"]
+    expect(bool(drifted), "record kind dropped from the OBSERVABILITY.md "
+                          "ledger catalogue yields a ledger-kind-drift "
+                          "error")
+
     # 4. gutting the fault catalogue doc must trip the surface lint
     gutted = [f for f in analyze_surface(
                   root, overlay={"docs/FAULT_TOLERANCE.md": "# empty\n"})

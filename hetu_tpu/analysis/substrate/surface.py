@@ -26,6 +26,14 @@ Three promise surfaces, each diffed in both directions:
   (``delta-parser-drift``, error) — the same discipline as fault kinds: a
   kind the recommender emits but the actuator or docs never heard of is a
   recommendation that silently goes nowhere.
+- **Ledger record kinds** — every ``kind`` a JSONL producer emits (Python
+  dict literals, hot-path raw-JSON fragments, C++ escaped rows,
+  ``tel.record(...)`` call sites) must be registered in
+  ``story.LEDGER_KINDS`` and catalogued in the docs/OBSERVABILITY.md
+  ledger table, and vice versa (``ledger-kind-drift``, error both
+  directions; a registered-but-never-emitted kind is a warn) — a row
+  hetustory cannot classify is invisible to every timeline, audit, and
+  incident report built on the unified ledger.
 
 Pure text analysis over the working tree; ``overlay`` maps repo-relative
 paths to replacement text so the seeded-defect tests and ``--check`` can
@@ -93,6 +101,22 @@ _CHAOS_HDR = "hetu_tpu/csrc/ps/chaos.h"
 _DELTA_REGISTRY = "hetu_tpu/telemetry/watch.py"
 _DELTA_CONSUMER = "hetu_tpu/pilot.py"
 _RE_DELTA_KIND = re.compile(r"^\s*\"([a-z_]+)\":\s*\{\"arg\":", re.M)
+
+# the hetustory ledger-kind registry (story.LEDGER_KINDS) — the contract
+# every JSONL producer and the docs/OBSERVABILITY.md ledger catalogue must
+# agree with. The registry file (and its jax-free bin loader) is excluded
+# from the emission scan: it quotes every kind as data, plus fixtures.
+_LEDGER_REGISTRY = "hetu_tpu/telemetry/story.py"
+_LEDGER_SCAN_EXCLUDE = (_LEDGER_REGISTRY, "bin/hetustory")
+# emission sites: Python dict literals ({"kind": "step"}), the hot-path
+# raw-JSON fragments ('"kind":"step"'), C++ escaped JSON (\"kind\":\"srv\"),
+# and the tel.record("<kind>", ...) free-form API
+_RE_KIND_EMITS = (
+    re.compile(r"\"kind\"\s*:\s*\"([a-z_0-9]+)\""),
+    re.compile(r"\"kind\":\"([a-z_0-9]+)\""),
+    re.compile(r"\\\"kind\\\":\\\"([a-z_0-9]+)"),
+    re.compile(r"\.record\(\s*\"([a-z_0-9]+)\""),
+)
 
 
 def _read(root: str, rel: str, overlay: Optional[Dict[str, str]]) -> str:
@@ -402,6 +426,140 @@ def _check_deltas(root: str, overlay) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# ledger record kinds (hetustory)
+
+def _ledger_kinds(text: str) -> Dict[str, Set[str]]:
+    """Family -> kinds from the ``LEDGER_KINDS = {...}`` literal (text
+    parse, same discipline as :func:`_delta_kinds`)."""
+    m = re.search(r"^LEDGER_KINDS\s*=\s*\{", text, re.M)
+    if not m:
+        return {}
+    block = text[m.end():]
+    end = block.find("\n}")
+    if end >= 0:
+        block = block[:end]
+    out: Dict[str, Set[str]] = {}
+    for fam, inner in re.findall(r"\"([a-z_]+)\":\s*\(([^)]*)\)", block,
+                                 re.S):
+        out[fam] = set(re.findall(r"\"([a-z_0-9]+)\"", inner))
+    return out
+
+
+def _doc_ledger_rows(doc: str) -> Dict[str, Set[str]]:
+    """Family -> kinds from the docs/OBSERVABILITY.md ledger catalogue
+    table (the section under the "Ledger catalogue" heading)."""
+    m = re.search(r"^#+.*Ledger catalogue.*$", doc, re.M)
+    if not m:
+        return {}
+    section = doc[m.end():]
+    nxt = re.search(r"^#+ ", section, re.M)
+    if nxt:
+        section = section[:nxt.start()]
+    out: Dict[str, Set[str]] = {}
+    for line in section.splitlines():
+        mm = re.match(r"^\|\s*`([a-z_]+)`\s*\|", line)
+        if not mm:
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        # first cell = family; record kinds are the backticked lowercase
+        # tokens of the THIRD cell (family | files | kinds | ...)
+        kinds = set(re.findall(r"`([a-z_0-9]+)`", cells[2])) \
+            if len(cells) >= 3 else set()
+        kinds.discard("none")
+        out[mm.group(1)] = kinds
+    return out
+
+
+def _check_ledgers(root: str, files: List[str], overlay) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_text = _read(root, _LEDGER_REGISTRY, overlay)
+    if not reg_text:
+        return findings
+    registry = _ledger_kinds(reg_text)
+    if not registry:
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=ERROR,
+            message=(f"{_LEDGER_REGISTRY} has no parseable LEDGER_KINDS "
+                     "registry literal — the run-ledger surface lint lost "
+                     "its source of truth"),
+            op_name=_LEDGER_REGISTRY, pass_name=PASS))
+        return findings
+    known: Set[str] = set()
+    for kinds in registry.values():
+        known |= kinds
+
+    # code -> registry: every emitted kind must be one hetustory's
+    # timeline/audit can classify; a kind the registry never heard of is
+    # invisible to every post-mortem built on the ledger
+    emitted: Dict[str, Set[str]] = {}
+    for rel in files:
+        if rel in _LEDGER_SCAN_EXCLUDE:
+            continue
+        text = _read(root, rel, overlay)
+        for rx in _RE_KIND_EMITS:
+            for kind in rx.findall(text):
+                emitted.setdefault(kind, set()).add(rel)
+    for kind in sorted(set(emitted) - known):
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=ERROR,
+            message=(f"record kind {kind!r} is emitted by "
+                     f"{sorted(emitted[kind])[0]} but story.LEDGER_KINDS "
+                     "has no entry for it — hetustory's timeline and "
+                     "audit cannot classify the row"),
+            op_name=kind, pass_name=PASS))
+    # registry -> code: a registered kind nothing emits is a stale row
+    for kind in sorted(known - set(emitted)):
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=WARN,
+            message=(f"record kind {kind!r} is in story.LEDGER_KINDS but "
+                     "no code path emits it — stale registry entry"),
+            op_name=kind, pass_name=PASS))
+
+    # registry <-> docs: the OBSERVABILITY.md ledger catalogue must list
+    # every family with exactly the registry's kinds, both directions
+    doc = _read(root, "docs/OBSERVABILITY.md", overlay)
+    doc_rows = _doc_ledger_rows(doc)
+    if not doc_rows:
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=ERROR,
+            message=("docs/OBSERVABILITY.md has no parseable ledger "
+                     "catalogue table (\"Ledger catalogue\" heading) — "
+                     "the ledger contract is undocumented"),
+            op_name="docs/OBSERVABILITY.md", pass_name=PASS))
+        return findings
+    for fam in sorted(set(registry) - set(doc_rows)):
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=ERROR,
+            message=(f"ledger family {fam!r} is in story.LEDGER_KINDS but "
+                     "the docs/OBSERVABILITY.md ledger catalogue has no "
+                     f"`{fam}` row"),
+            op_name=fam, pass_name=PASS))
+    for fam in sorted(set(doc_rows) - set(registry)):
+        findings.append(Finding(
+            lint="ledger-kind-drift", severity=ERROR,
+            message=(f"the docs/OBSERVABILITY.md ledger catalogue lists "
+                     f"family {fam!r} that story.LEDGER_KINDS does not "
+                     "register — doc row outlived the code"),
+            op_name=fam, pass_name=PASS))
+    for fam in sorted(set(registry) & set(doc_rows)):
+        for kind in sorted(registry[fam] - doc_rows[fam]):
+            findings.append(Finding(
+                lint="ledger-kind-drift", severity=ERROR,
+                message=(f"record kind {kind!r} of family {fam!r} is "
+                         "registered but missing from its "
+                         "docs/OBSERVABILITY.md catalogue row"),
+                op_name=f"{fam}.{kind}", pass_name=PASS))
+        for kind in sorted(doc_rows[fam] - registry[fam]):
+            findings.append(Finding(
+                lint="ledger-kind-drift", severity=ERROR,
+                message=(f"the docs/OBSERVABILITY.md catalogue row for "
+                         f"{fam!r} lists kind {kind!r} that "
+                         "story.LEDGER_KINDS does not register"),
+                op_name=f"{fam}.{kind}", pass_name=PASS))
+    return findings
+
+
+# --------------------------------------------------------------------------
 
 def analyze_surface(root: str = ".",
                     overlay: Optional[Dict[str, str]] = None
@@ -413,4 +571,5 @@ def analyze_surface(root: str = ".",
     findings += _check_gauges(root, files, overlay)
     findings += _check_faults(root, overlay)
     findings += _check_deltas(root, overlay)
+    findings += _check_ledgers(root, files, overlay)
     return findings

@@ -1054,7 +1054,7 @@ class SubExecutor:
         back), and route any latched events through the resilience bus.
         Runs on the watch cadence only; never raises — the sentinel must
         not take the step down with it."""
-        from ..resilience import _flight_flush, _tel_event
+        from ..resilience import _flight_flush, _incident, _tel_event
         from ..telemetry import trail as _trail_mod
         from ..telemetry import watch as _watch_mod
         try:
@@ -1128,6 +1128,8 @@ class SubExecutor:
                     # the flight ring holds the steps AROUND the breach —
                     # flush it while they are still in the window
                     _flight_flush(f"slo_breach:{e.get('slo')}")
+                    _incident("slo_breach", step=step, slo=e.get("slo"),
+                              value=e.get("value"))
         except Exception:  # noqa: BLE001 — sentinel must never kill a step
             pass
 

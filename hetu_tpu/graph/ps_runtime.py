@@ -694,6 +694,9 @@ class PSRuntime:
             reg.gauge("hetu_ps_rpcs_total").set(cs["rpcs"])
             reg.gauge("hetu_ps_retries_total").set(cs["retries"])
             reg.gauge("hetu_ps_failovers_total").set(cs["failovers"])
+            # acknowledged pushes: the client-side half of hetustory's
+            # push-accounting audit (== Σ server updates − restored)
+            reg.gauge("hetu_ps_pushes_ok_total").set(cs.get("pushes_ok", 0))
             # hetuchaos transport hardening (docs/FAULT_TOLERANCE.md):
             # recv/deadline timeouts, total retry backoff slept, CRC
             # rejects observed (server + response-leg), and faults an

@@ -88,6 +88,27 @@ def _watch_mod():
         return mod
 
 
+def _story_mod():
+    """The shared ledger reader's home (telemetry/story.py), resolved the
+    same two-context way as :func:`_watch_mod` — story.py is stdlib-only,
+    so the standalone fallback never drags jax in."""
+    try:
+        from .telemetry import story
+        return story
+    except ImportError:
+        import importlib.util
+        mod = sys.modules.get("_hetustory")
+        if mod is not None:
+            return mod
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "telemetry", "story.py")
+        spec = importlib.util.spec_from_file_location("_hetustory", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hetustory"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
 def delta_signature(delta: dict) -> str:
     """Blacklist identity of one PlanDelta: kind + target + arg — two
     recommendations proposing the same change share one cool-down."""
@@ -178,29 +199,25 @@ class ActuationLedger:
 
     def append(self, **rec) -> None:
         rec.setdefault("ts", round(time.time(), 3))
+        # run identity (heturun-generated, env-inherited): restarted-run
+        # rows in the same directory disambiguate instead of interleaving
+        run_id = os.environ.get("HETU_RUN_ID")
+        if run_id:
+            rec.setdefault("run_id", run_id)
+            try:
+                rec.setdefault("inc", int(os.environ.get(
+                    "HETU_RUN_INCARNATION", "0")))
+            except ValueError:
+                pass
         with open(self.path, "a", encoding="utf-8") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
             f.flush()
             os.fsync(f.fileno())
 
     def records(self) -> list:
-        out = []
-        try:
-            f = open(self.path, "r", encoding="utf-8")
-        except OSError:
-            return out
-        with f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue   # torn tail line from a crash mid-write
-                if isinstance(rec, dict):
-                    out.append(rec)
-        return out
+        """Object rows, torn tail from a crash mid-write tolerated (the
+        shared hetustory reader)."""
+        return _story_mod().read_jsonl(self.path)
 
     def last_era(self) -> int:
         return max((int(r["era"]) for r in self.records()
@@ -396,6 +413,14 @@ class Pilot:
             if delta:
                 self.governor.ban(sig, step)
             self.governor.spent += 1
+            # hetustory post-mortem: the previous incarnation died
+            # mid-actuation — freeze the window around the interrupted era
+            try:
+                from .resilience import _incident
+                _incident("pilot_interrupted", step=step, era=int(era),
+                          delta_signature=sig)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- feeds (called from SubExecutor._watch_observe) ---------------------
     def feed_row(self, row: dict) -> None:

@@ -105,6 +105,31 @@ def _flight_flush(reason: str) -> None:
         pass
 
 
+def _incident(reason: str, step=None, **extra) -> None:
+    """Freeze a hetustory incident report (telemetry/story.py): the ±K-step
+    window from EVERY ledger family in the telemetry dir, one JSON doc,
+    rendered offline by ``hetustory --incident``. Called AFTER the event /
+    flight flush of the same abort path so the window includes them. Gated
+    by HETU_STORY_INCIDENT (default on); no-op when telemetry is off; never
+    raises — post-mortem capture must not take the abort path down."""
+    try:
+        from . import telemetry as _telemetry
+        from .telemetry import story as _story
+        tel = _telemetry.get()
+        if tel is None or not _story.incident_enabled():
+            return
+        # the snapshot reads the ledgers from disk: push any buffered rows
+        # (the triggering event itself) out first
+        try:
+            tel.sink.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        _story.write_incident(tel.dir, reason, step=step, rank=tel.rank,
+                              extra=extra or None)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Fault injection
 # ---------------------------------------------------------------------------
@@ -415,6 +440,8 @@ class Watchdog:
             _tel_event("watchdog_fire", flush=True, phase=phase, step=step,
                        elapsed_s=round(elapsed, 1))
             _flight_flush("watchdog")
+            _incident("watchdog", step=step, phase=phase,
+                      elapsed_s=round(elapsed, 1))
             try:
                 stream.flush()
             except Exception:  # noqa: BLE001 — never let flush mask the abort
@@ -784,6 +811,10 @@ class Supervisor:
             _tel_event("anomaly", step=step, action=action,
                        streak=self.anomaly.streak, **extra)
         if action == "rollback":
+            # freeze the incident BEFORE rolling back: the window must show
+            # the poisoned steps, not the restored state overwriting them
+            _incident("anomaly", step=step,
+                      streak=self.anomaly.streak)
             self._rollback(ex)
         elif action == "ok" and self.ckptr is not None and self.ckpt_every \
                 and (step + 1) % self.ckpt_every == 0:
@@ -835,6 +866,8 @@ class Supervisor:
                        signum=self.preemption.signum,
                        durable_step=self.last_saved_step)
             _flight_flush("preempted")
+            _incident("preempted", step=step,
+                      durable_step=self.last_saved_step)
             raise Preempted(step)
 
     # -- checkpoint plumbing ------------------------------------------------
@@ -926,9 +959,12 @@ def supervise(loop_fn, ckptr=None, *, max_restarts: int = 3,
             _flight_flush("crash")
             restarts += 1
             if restarts > max_restarts:
+                _incident("crash", error=type(e).__name__,
+                          restarts=restarts - 1)
                 raise
             _tel_event("restart", flush=True, attempt=restarts,
                        max_restarts=max_restarts, error=type(e).__name__)
+            _incident("crash", error=type(e).__name__, attempt=restarts)
             print(f"# hetu supervise: {type(e).__name__}: {e} — restart "
                   f"{restarts}/{max_restarts} after {delay:.1f}s backoff",
                   file=sys.stderr)

@@ -34,61 +34,64 @@ _shells: list = []
 _tel_dir: str = ""   # --telemetry-dir (run summary written at every exit)
 
 
+def _story_mod():
+    """The shared ledger reader (hetu_tpu/telemetry/story.py), loaded by
+    file path: the launcher parent must stay jax-free, and importing the
+    hetu_tpu package would pay the jax import (story.py is stdlib-only)."""
+    mod = (sys.modules.get("hetu_tpu.telemetry.story")
+           or sys.modules.get("_hetustory"))
+    if mod is not None:
+        return mod
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "telemetry", "story.py")
+    spec = importlib.util.spec_from_file_location("_hetustory", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_hetustory"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _scan_rank_jsonl(tel_dir):
     """Per-rank final step + the elastic world/resize history from the
-    rank JSONL files (including rotated ``.1`` backups): the post-mortem
-    of an elastic run should start from run_summary.json, not from
-    re-deriving the membership timeline by hand."""
-    import glob
-    import json
+    rank JSONL files (via the shared hetustory reader, which orders each
+    file's rotated ``.1`` backup before its live generation): the
+    post-mortem of an elastic run should start from run_summary.json, not
+    from re-deriving the membership timeline by hand."""
+    story = _story_mod()
     final_steps = {}
     resizes = []
     world_versions = set()
     plan = None
-    paths = sorted(glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl"))
-                   + glob.glob(os.path.join(tel_dir, "metrics-r*.jsonl.1")))
-    for path in paths:
-        try:
-            f = open(path)
-        except OSError:
-            continue
+    for path in story.ledger_files("metrics", tel_dir):
         # iterate, never slurp: an uncapped (HETU_TELEMETRY_MAX_MB unset)
         # long-run rank file can be huge, and this runs in the launcher
-        with f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if not isinstance(rec, dict):
-                    continue
-                rank = rec.get("rank")
-                if rec.get("kind") == "step" and "step" in rec:
-                    key = str(rank if rank is not None else "?")
-                    final_steps[key] = max(final_steps.get(key, -1),
-                                           int(rec["step"]))
-                elif rec.get("kind") == "event" and \
-                        str(rec.get("name", "")).startswith("resize"):
-                    ev = {k: rec.get(k) for k in
-                          ("ts", "name", "rank", "step", "world_version",
-                           "n_workers", "n_servers", "duration_ms")
-                          if rec.get(k) is not None}
-                    resizes.append(ev)
-                    if rec.get("world_version") is not None:
-                        world_versions.add(int(rec["world_version"]))
-                elif rec.get("kind") == "plan" and plan is None:
-                    # the hetuwatch plan stamp (docs/OBSERVABILITY.md
-                    # pillar 6): the adopted layout, per-param comm
-                    # decisions and predicted step — rank 0 stamps first;
-                    # every rank adopts the same plan, so first wins
-                    plan = {k: rec.get(k) for k in
-                            ("mesh", "comm_mode", "comm_quant", "zero1",
-                             "remat", "predicted_step_ms",
-                             "predicted_legs", "params")
-                            if rec.get(k) is not None}
+        for row in story.iter_rows(path):
+            rec = row.rec
+            rank = rec.get("rank")
+            if rec.get("kind") == "step" and "step" in rec:
+                key = str(rank if rank is not None else "?")
+                final_steps[key] = max(final_steps.get(key, -1),
+                                       int(rec["step"]))
+            elif rec.get("kind") == "event" and \
+                    str(rec.get("name", "")).startswith("resize"):
+                ev = {k: rec.get(k) for k in
+                      ("ts", "name", "rank", "step", "world_version",
+                       "n_workers", "n_servers", "duration_ms")
+                      if rec.get(k) is not None}
+                resizes.append(ev)
+                if rec.get("world_version") is not None:
+                    world_versions.add(int(rec["world_version"]))
+            elif rec.get("kind") == "plan" and plan is None:
+                # the hetuwatch plan stamp (docs/OBSERVABILITY.md
+                # pillar 6): the adopted layout, per-param comm
+                # decisions and predicted step — rank 0 stamps first;
+                # every rank adopts the same plan, so first wins
+                plan = {k: rec.get(k) for k in
+                        ("mesh", "comm_mode", "comm_quant", "zero1",
+                         "remat", "predicted_step_ms",
+                         "predicted_legs", "params")
+                        if rec.get(k) is not None}
     resizes.sort(key=lambda e: e.get("ts", 0))
     return final_steps, resizes, sorted(world_versions), plan
 
@@ -286,6 +289,24 @@ def main(argv=None):
           f"workers({num_workers}): {workers} }}")
 
     env = dict(os.environ)
+    # Run identity (docs/OBSERVABILITY.md pillar 7): every JSONL row,
+    # pilot ledger line and flight ring this job writes carries
+    # (run_id, inc). A fresh launch mints the id; a relaunch that inherited
+    # HETU_RUN_ID (an outer supervisor / k8s restart) keeps it and bumps
+    # the incarnation — so a reused telemetry dir disambiguates runs
+    # instead of silently interleaving them.
+    if env.get("HETU_RUN_ID"):
+        try:
+            run_inc = int(env.get("HETU_RUN_INCARNATION", "-1")) + 1
+        except ValueError:
+            run_inc = 1
+    else:
+        env["HETU_RUN_ID"] = (time.strftime("%Y%m%d-%H%M%S")
+                              + f"-{os.getpid()}")
+        run_inc = 0
+    env["HETU_RUN_INCARNATION"] = str(run_inc)
+    os.environ["HETU_RUN_ID"] = env["HETU_RUN_ID"]
+    os.environ["HETU_RUN_INCARNATION"] = env["HETU_RUN_INCARNATION"]
     if args.telemetry_dir:
         global _tel_dir
         _tel_dir = os.path.abspath(args.telemetry_dir)
@@ -391,9 +412,18 @@ def main(argv=None):
                 ps_sup = start_mp_supervisor(
                     ctx, _server_entry, env, server_procs, _procs.append,
                     max_respawns=args.ps_max_respawns)
-        def spawn_worker(w, join=False):
+        def spawn_worker(w, join=False, incarnation=0):
             wenv = dict(env)
             wenv["WORKER_ID"] = str(w)
+            if incarnation:
+                # an auto-resume respawn is a new incarnation of the same
+                # run: its telemetry rows must not be indistinguishable
+                # from its dead predecessor's
+                try:
+                    base_inc = int(env.get("HETU_RUN_INCARNATION", "0"))
+                except ValueError:
+                    base_inc = 0
+                wenv["HETU_RUN_INCARNATION"] = str(base_inc + incarnation)
             if enable_ps:
                 wenv["DMLC_ROLE"] = "worker"
             if join:
@@ -539,6 +569,7 @@ def main(argv=None):
 
         running = {w: spawn_worker(w) for w in range(num_workers)}
         respawn_at = {}   # worker id -> monotonic deadline (backoff pending)
+        worker_respawns = {}   # worker id -> incarnation bump count
         restarts, delay = 0, 2.0
         rc_final, preempted = 0, False
         teardown_at = None
@@ -651,7 +682,9 @@ def main(argv=None):
             for w, when in list(respawn_at.items()):
                 if now >= when:
                     del respawn_at[w]
-                    running[w] = spawn_worker(w)
+                    worker_respawns[w] = worker_respawns.get(w, 0) + 1
+                    running[w] = spawn_worker(
+                        w, incarnation=worker_respawns[w])
             if skew_mon is not None and now >= skew_next_poll:
                 skew_next_poll = now + 2.0
                 try:

@@ -77,6 +77,24 @@ def default_rank() -> int:
         return 0
 
 
+def run_identity():
+    """``(run_id, incarnation)`` for this process, or ``(None, 0)``.
+
+    heturun mints ``HETU_RUN_ID`` and every role inherits it; a process
+    started outside heturun (tests, notebooks) simply has no run identity —
+    nothing is fabricated, so rows stay byte-stable for such runs. The
+    incarnation counts supervisor restarts (heturun bumps it per respawned
+    worker and per inherited relaunch)."""
+    run_id = os.environ.get("HETU_RUN_ID") or None
+    inc = 0
+    if run_id:
+        try:
+            inc = int(os.environ.get("HETU_RUN_INCARNATION", "0"))
+        except ValueError:
+            inc = 0
+    return run_id, inc
+
+
 class Telemetry:
     """One per process: registry + sinks + (in trace mode) the tracer."""
 
@@ -85,9 +103,16 @@ class Telemetry:
         self.dir = out_dir
         self.rank = int(rank)
         self.metrics = MetricsRegistry()
+        base_fields = {"rank": self.rank, "pid": os.getpid()}
+        run_id, inc = run_identity()
+        if run_id:
+            # preserialized with the rest of the base fields: the hot-path
+            # step record pays zero extra serialization for run identity
+            base_fields["run_id"] = run_id
+            base_fields["inc"] = inc
         self.sink = JsonlSink(
             os.path.join(out_dir, f"metrics-r{self.rank}.jsonl"),
-            base_fields={"rank": self.rank, "pid": os.getpid()})
+            base_fields=base_fields)
         self.tracer: Optional[Tracer] = (
             Tracer(os.path.join(out_dir, f"trace-r{self.rank}.json"),
                    rank=self.rank) if mode == "trace" else None)

@@ -20,6 +20,7 @@ import sys
 import time
 from typing import Optional
 
+from . import story as _story      # shared ledger readers (stdlib-only)
 from .profiler import attn_flops   # stdlib-only module (shared w/ bench.py)
 
 # MFU denominator when no peak rides in the records: same default as
@@ -36,24 +37,17 @@ def metrics_files(dir_path: str) -> list[str]:
     return sorted(glob.glob(os.path.join(dir_path, "metrics-r*.jsonl")))
 
 
-def load_records(path: str, errors: Optional[list] = None) -> list[dict]:
-    out = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                if errors is not None:
-                    errors.append(f"{path}:{i}: invalid JSON ({e})")
-                continue
-            if not isinstance(rec, dict):
-                if errors is not None:
-                    errors.append(f"{path}:{i}: record is not an object")
-                continue
-            out.append(rec)
+def load_records(path: str, errors: Optional[list] = None,
+                 rotated: bool = False) -> list[dict]:
+    """One metrics file's records via the shared hetustory reader —
+    --check stays strict (every classified line, torn tails included,
+    formats into ``errors``); ``rotated=True`` prepends the ``.1`` backup
+    so rotation can't hide records from the validator."""
+    errs: Optional[list] = [] if errors is not None else None
+    reader = _story.read_rows_rotated if rotated else _story.read_rows
+    out = [r.rec for r in reader(path, errs)]
+    if errors is not None:
+        errors.extend(_story.format_error(e) for e in errs)
     return out
 
 
@@ -75,7 +69,7 @@ def check_dir(dir_path: str, out=sys.stdout) -> int:
     last_metrics: Optional[dict] = None   # None = no snapshot seen at all
     ps_last: dict = {}
     for path in files:
-        for rec in load_records(path, errors):
+        for rec in load_records(path, errors, rotated=True):
             kind = rec.get("kind")
             if kind == "step":
                 missing = [k for k in STEP_REQUIRED if k not in rec]
@@ -160,7 +154,8 @@ def gather(dir_path: str) -> dict:
     """One dashboard frame's worth of state from the directory (full
     parse — one-shot use: ``--once``, tests). The live loop uses
     :class:`Follower`, which tails incrementally."""
-    return _aggregate({p: load_records(p) for p in metrics_files(dir_path)})
+    return _aggregate({p: load_records(p, rotated=True)
+                       for p in metrics_files(dir_path)})
 
 
 class Follower:
@@ -174,7 +169,10 @@ class Follower:
 
     def __init__(self, dir_path: str):
         self.dir = dir_path
-        self._offsets: dict = {}
+        # shared rotation-aware tailer (hetustory): on rotation the old
+        # generation's unread tail is drained from the .1 backup instead of
+        # dropped, and an existing backup seeds the dashboard's history
+        self._follow = _story.LedgerFollower(backlog=True)
         self._recs: dict = {}
         # once-per-run records (run_info/model_info) and slow-cadence rows
         # (ps_server, hetuscope scope) must survive eviction from the
@@ -189,39 +187,7 @@ class Follower:
         if buf is None:
             buf = self._recs[path] = collections.deque(
                 maxlen=self.BUFFER)
-        try:
-            st = os.stat(path)
-        except OSError:
-            return buf
-        size = st.st_size
-        off, ino = self._offsets.get(path, (0, None))
-        # rotation (HETU_TELEMETRY_MAX_MB) is detected by inode change —
-        # size-only detection misses a fresh file refilled past the stale
-        # offset between frames
-        if (ino is not None and st.st_ino != ino) or size < off:
-            off = 0
-            buf.clear()
-        if size == off:
-            self._offsets[path] = (off, st.st_ino)
-            return buf
-        with open(path, "rb") as f:
-            f.seek(off)
-            chunk = f.read()
-        last_nl = chunk.rfind(b"\n")
-        if last_nl < 0:           # partial tail line: retry next frame
-            self._offsets[path] = (off, st.st_ino)
-            return buf
-        self._offsets[path] = (off + last_nl + 1, st.st_ino)
-        for raw in chunk[:last_nl].split(b"\n"):
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except json.JSONDecodeError:
-                continue          # torn/garbage line: skip, stay live
-            if isinstance(rec, dict):
-                buf.append(rec)
+        buf.extend(self._follow.poll(path))
         return buf
 
     def poll(self) -> dict:

@@ -301,6 +301,14 @@ class FlightRecorder:
                    "flushed_ts": round(time.time(), 3),
                    "flushes": self.flushes,
                    "records": json_safe(recs)}
+            run_id = os.environ.get("HETU_RUN_ID")
+            if run_id:
+                doc["run_id"] = run_id
+                try:
+                    doc["inc"] = int(
+                        os.environ.get("HETU_RUN_INCARNATION", "0"))
+                except ValueError:
+                    doc["inc"] = 0
             if provenance is not None:
                 doc["provenance"] = json_safe(provenance)
             tmp = self.path + ".tmp"

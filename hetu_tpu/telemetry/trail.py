@@ -79,6 +79,35 @@ def _active_telemetry():
     return _tel_get()
 
 
+def _story_mod():
+    """The shared ledger reader's home (telemetry/story.py), importable
+    from BOTH contexts: inside the hetu_tpu package, or standalone when
+    bin/hetutrail loaded this file by path (story.py is stdlib-only at
+    module level, so the fallback never drags jax in)."""
+    try:
+        from . import story
+        return story
+    except ImportError:
+        import importlib.util
+        mod = sys.modules.get("_hetustory")
+        if mod is not None:
+            return mod
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "story.py")
+        spec = importlib.util.spec_from_file_location("_hetustory", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hetustory"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+try:
+    with open("/proc/sys/kernel/random/boot_id") as _f:
+        _BOOT_ID = _f.read().strip()
+except OSError:  # non-Linux: anchors stay comparable only within a process
+    _BOOT_ID = ""
+
+
 def armed() -> Optional[str]:
     """The trail output directory, or None when trail is off (the single
     gate every Python-side call site checks)."""
@@ -126,10 +155,21 @@ class TrailWriter:
         self._write_anchor()
 
     def _write_anchor(self) -> None:
-        line = json.dumps(
-            {"kind": "anchor", "rank": self.rank, "mono_us": mono_us(),
-             "wall_s": round(time.time(), 3)},
-            separators=(",", ":")) + "\n"
+        # boot_id makes the anchor the cross-process ordering proof
+        # hetustory's timeline needs (same condition as hetutrace: one
+        # boot_id = one shared CLOCK_MONOTONIC); run_id/inc disambiguate
+        # generations from restarted or interleaved runs
+        rec = {"kind": "anchor", "rank": self.rank, "mono_us": mono_us(),
+               "wall_s": round(time.time(), 3), "boot_id": _BOOT_ID}
+        run_id = os.environ.get("HETU_RUN_ID")
+        if run_id:
+            rec["run_id"] = run_id
+            try:
+                rec["inc"] = int(os.environ.get("HETU_RUN_INCARNATION",
+                                                "0"))
+            except ValueError:
+                pass
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
         self._f.write(line)
         self._nbytes += len(line)
         self._f.flush()
@@ -219,33 +259,23 @@ def drain_client_spans(comm, writer: TrailWriter, batch: int = 4096) -> int:
 
 
 def _read_jsonl(path: str) -> list:
-    out = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail line of a live run
-                if isinstance(rec, dict):
-                    out.append(rec)
-    except OSError:
-        pass
-    return out
+    """One JSONL file's object rows, torn tail tolerated — now the shared
+    hetustory reader (the classification, not the behavior, changed)."""
+    return _story_mod().read_jsonl(path)
 
 
 def load_dir(dir_path: str) -> dict:
     """Everything hetutrail needs from one directory: client spans, server
     spans, anchors, drop counters, and the per-step metrics records (the
-    phases the critical path decomposes)."""
+    phases the critical path decomposes). Reads each file's rotated ``.1``
+    backup first (the PR 20 fix: a span drained just before rotation used
+    to vanish from every report)."""
+    _read = _story_mod().read_jsonl_rotated
     client, server, anchors = [], [], []
     dropped = dropped_client = 0
     for p in sorted(glob.glob(os.path.join(dir_path,
                                            "trail-client-r*.jsonl"))):
-        for rec in _read_jsonl(p):
+        for rec in _read(p):
             if rec.get("kind") == "rpc":
                 client.append(rec)
             elif rec.get("kind") == "anchor":
@@ -254,7 +284,7 @@ def load_dir(dir_path: str) -> dict:
                 dropped_client += int(rec.get("n", 0))
     for p in sorted(glob.glob(os.path.join(dir_path,
                                            "trail-server-s*.jsonl"))):
-        for rec in _read_jsonl(p):
+        for rec in _read(p):
             if rec.get("kind") == "srv":
                 server.append(rec)
             elif rec.get("kind") == "anchor":
@@ -263,7 +293,7 @@ def load_dir(dir_path: str) -> dict:
                 dropped += int(rec.get("n", 0))
     steps: dict = {}
     for p in sorted(glob.glob(os.path.join(dir_path, "metrics-r*.jsonl"))):
-        for rec in _read_jsonl(p):
+        for rec in _read(p):
             if rec.get("kind") == "step" and "step" in rec:
                 steps[(int(rec.get("rank", 0)), int(rec["step"]))] = rec
     return {"client": client, "server": server, "anchors": anchors,
@@ -476,7 +506,9 @@ class SkewMonitor:
         self.detector = detector or StragglerDetector()
         self.on_event = on_event
         self.write_events = write_events
-        self._offsets: dict = {}
+        # shared rotation-aware tailer (hetustory): records written between
+        # a poll and a rotation are drained from the .1 backup, not lost
+        self._follow = _story_mod().LedgerFollower(backlog=True)
         self._pending: dict = {}    # step -> {rank: step_ms}
         self._phases: dict = {}     # (step, rank) -> phases (bounded below)
         self._spans: dict = {}      # rank -> deque[(step, server, dur_us)]
@@ -487,42 +519,7 @@ class SkewMonitor:
         self.events: list = []
 
     def _tail(self, path: str) -> list:
-        try:
-            st = os.stat(path)
-        except OSError:
-            return []
-        size = st.st_size
-        off, ino = self._offsets.get(path, (0, None))
-        # rotation detection must be by inode, not just size < offset: a
-        # hot writer can refill the fresh file past the stale offset
-        # between polls, which would silently skip its head
-        if ino is not None and st.st_ino != ino:
-            off = 0
-        if size < off:          # truncated in place: restart
-            off = 0
-        if size == off:
-            self._offsets[path] = (off, st.st_ino)
-            return []
-        with open(path, "rb") as f:
-            f.seek(off)
-            chunk = f.read()
-        last_nl = chunk.rfind(b"\n")
-        if last_nl < 0:
-            self._offsets[path] = (off, st.st_ino)
-            return []
-        self._offsets[path] = (off + last_nl + 1, st.st_ino)
-        out = []
-        for raw in chunk[:last_nl].split(b"\n"):
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict):
-                out.append(rec)
-        return out
+        return self._follow.poll(path)
 
     def poll(self) -> list:
         """Ingest new records; returns the straggler events fired by this

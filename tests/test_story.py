@@ -1,0 +1,438 @@
+"""hetustory — unified run ledger (docs/OBSERVABILITY.md pillar 7).
+
+The acceptance proofs live here: a real local_cluster training run whose
+telemetry dir passes ``hetustory --audit`` (exit 0) and fails it (exit 1,
+naming the invariant and both rows) after one seeded row corruption; an
+anomaly-guard rollback that freezes an incident report drawing on >= 4
+distinct ledger families; and ``--diff`` surfacing a seeded step-time
+regression with plan context. The rest are the reader satellites: the
+torn-tail-vs-mid-file classification contract, the rotation-under-reader
+regression test (records that land between a poll and the rename must be
+recovered from the ``.1`` backup — the ad-hoc readers this PR retired
+silently lost them), one crash-truncated fixture per ledger family, the
+run_id/incarnation base-field stamp, and the jax-free CLI self-test.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HETUSTORY = os.path.join(REPO, "bin", "hetustory")
+
+
+def _story():
+    from hetu_tpu.telemetry import story
+    return story
+
+
+def _cli(*args):
+    return subprocess.run([sys.executable, HETUSTORY, *map(str, args)],
+                          capture_output=True, text=True)
+
+
+# ---------------------------------------------------------------------------
+# reader: torn-tail classification + rotation recovery
+# ---------------------------------------------------------------------------
+
+def test_torn_tail_tolerated_midfile_is_error(tmp_path):
+    story = _story()
+    p = tmp_path / "metrics-r0.jsonl"
+    p.write_text('{"kind": "step", "step": 1}\n'
+                 'not json at all\n'
+                 '{"kind": "step", "step": 2}\n'
+                 '[1, 2]\n'
+                 '{"kind": "step", "step": 3}\n'
+                 '{"kind": "step", "step": 4, "trun')
+    errors = []
+    rows = story.read_rows(str(p), errors=errors)
+    assert [r.rec["step"] for r in rows] == [1, 2, 3]
+    reasons = [e["reason"] for e in errors]
+    # mid-file garbage and non-objects are real errors; the torn LAST
+    # line is the crash signature every ledger family tolerates
+    assert reasons == ["invalid-json", "not-object", "torn-tail"]
+    assert errors[0]["line"] == 2 and errors[-1]["line"] == 6
+    # format_error keeps hetutop --check's historical strings
+    assert "invalid JSON" in story.format_error(errors[0])
+    assert "not an object" in story.format_error(errors[1])
+
+
+def test_rotation_under_reader_recovers_backup_records(tmp_path):
+    """The regression this PR fixes: records appended between a poll and
+    the rotation rename used to be LOST by every offset-based reader
+    (they re-read the new generation from the stale offset). The shared
+    LedgerFollower drains the ``.1`` backup from the stored offset when
+    the inode flips."""
+    story = _story()
+    p = str(tmp_path / "metrics-r0.jsonl")
+
+    def w(path, recs, mode="a"):
+        with open(path, mode) as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    fol = story.LedgerFollower()
+    w(p, [{"step": 1}, {"step": 2}], mode="w")
+    assert [r["step"] for r in fol.poll(p)] == [1, 2]
+    # records 3 lands AFTER the poll, then the writer rotates
+    w(p, [{"step": 3}])
+    os.replace(p, p + ".1")
+    w(p, [{"step": 4}], mode="w")
+    assert [r["step"] for r in fol.poll(p)] == [3, 4]
+    # in-place truncation (a fresh run reusing the path, now smaller)
+    # restarts at 0
+    with open(p, "w") as f:
+        f.write('{"step":9}\n')
+    assert [r["step"] for r in fol.poll(p)] == [9]
+    # a partial line (no newline yet) is retried, not consumed
+    with open(p, "a") as f:
+        f.write('{"step": 10')
+    assert fol.poll(p) == []
+    with open(p, "a") as f:
+        f.write(', "ok": true}\n')
+    assert [r["step"] for r in fol.poll(p)] == [10]
+
+
+def test_ledger_files_orders_backup_first_and_skips_tmp(tmp_path):
+    story = _story()
+    (tmp_path / "metrics-r0.jsonl").write_text('{"kind":"step","step":2}\n')
+    (tmp_path / "metrics-r0.jsonl.1").write_text(
+        '{"kind":"step","step":1}\n')
+    (tmp_path / "metrics-r0.jsonl.tmp").write_text("{...torn")
+    files = story.ledger_files("metrics", str(tmp_path))
+    assert [os.path.basename(f) for f in files] \
+        == ["metrics-r0.jsonl.1", "metrics-r0.jsonl"]
+    rows = story.read_jsonl_rotated(str(tmp_path / "metrics-r0.jsonl"))
+    assert [r["step"] for r in rows] == [1, 2]
+
+
+def test_runner_scan_reads_rotated_pair(tmp_path):
+    """heturun's exit scan rides the shared reader: the final step must
+    come from the LIVE generation even when a ``.1`` backup exists."""
+    from hetu_tpu import runner
+    (tmp_path / "metrics-r0.jsonl.1").write_text(
+        json.dumps({"kind": "step", "rank": 0, "step": 5}) + "\n")
+    (tmp_path / "metrics-r0.jsonl").write_text(
+        json.dumps({"kind": "step", "rank": 0, "step": 11}) + "\n"
+        + '{"kind": "step", "torn')
+    final_steps, resizes, world_versions, plan = \
+        runner._scan_rank_jsonl(str(tmp_path))
+    assert final_steps == {"0": 11}
+    assert resizes == [] and plan is None
+
+
+# ---------------------------------------------------------------------------
+# crash-truncated fixture per family
+# ---------------------------------------------------------------------------
+
+def test_every_family_tolerates_its_crash_signature(tmp_path):
+    """One artifact per ledger family, each cut off the way a crash cuts
+    it: jsonl families get a torn tail (+ the metrics family a rotated
+    pair), doc families a torn ``.tmp`` that must never be read."""
+    story = _story()
+    d = str(tmp_path)
+
+    def jl(name, recs, torn=True):
+        with open(os.path.join(d, name), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            if torn:
+                f.write('{"kind": "step", "cut')
+
+    jl("metrics-r0.jsonl.1", [{"kind": "step", "step": 1, "rank": 0}],
+       torn=False)
+    jl("metrics-r0.jsonl", [{"kind": "step", "step": 2, "rank": 0}])
+    jl("trail-client-r0.jsonl", [{"kind": "rpc", "rank": 0, "step": 2}])
+    jl("trail-server-s0.jsonl", [{"kind": "srv", "step": 2}])
+    jl("trail-events.jsonl", [{"kind": "straggler", "rank": 0, "step": 2}])
+    jl("pilot.jsonl", [{"era": 1, "phase": "propose", "step": 2}])
+    jl("ps_supervisor.jsonl", [{"kind": "event", "name": "ps_supervisor"}])
+    doc = {"schema": 1, "reason": "crash", "rank": 0, "k": 4,
+           "records": []}
+    with open(os.path.join(d, "flight-r0.json"), "w") as f:
+        json.dump(doc, f)
+    with open(os.path.join(d, "flight-r0.json.tmp"), "w") as f:
+        f.write('{"schema": 1, "cut')      # crash mid-rename: never read
+    with open(os.path.join(d, "job_epoch_000007.json"), "w") as f:
+        json.dump({"format": 1, "epoch": 7, "servers": [], "workers": []},
+                  f)
+    with open(os.path.join(d, "run_summary.json"), "w") as f:
+        f.write('{"final_steps": {"0": 2}, "cut')   # torn doc, classified
+    errors = {}
+    led = story.load_ledgers(d, errors=errors)
+    assert [r.rec["step"] for r in led["metrics"]] == [1, 2]
+    for fam in ("trail_client", "trail_server", "trail_events", "pilot",
+                "ps_supervisor", "flight", "job_manifest"):
+        assert len(led[fam]) == 1, fam
+    assert led["run_summary"] == []                 # torn doc: no row
+    flat = [e for errs in errors.values() for e in errs]
+    assert {e["reason"] for e in flat} == {"torn-tail", "torn-doc"}, flat
+    assert not any(e["path"].endswith(".tmp") for e in flat)
+
+
+# ---------------------------------------------------------------------------
+# run identity base fields
+# ---------------------------------------------------------------------------
+
+def test_run_identity_stamps_every_row(tmp_path, monkeypatch):
+    from hetu_tpu import telemetry
+    telemetry.shutdown()
+    monkeypatch.setenv("HETU_RUN_ID", "20260807-120000-42")
+    monkeypatch.setenv("HETU_RUN_INCARNATION", "2")
+    tel = telemetry.Telemetry("metrics", str(tmp_path), rank=0)
+    tel.step_record("train", 0, 1.0)                  # hot path
+    tel.step_record("train", 1, 1.0, extra_field=1)   # dict path
+    tel.event("anomaly", step=1)
+    tel.close()
+    recs = [json.loads(l)
+            for l in open(tmp_path / "metrics-r0.jsonl")]
+    assert len(recs) >= 3
+    for r in recs:
+        assert r["run_id"] == "20260807-120000-42", r
+        assert r["inc"] == 2, r
+
+
+def test_run_identity_absent_outside_heturun(tmp_path, monkeypatch):
+    from hetu_tpu import telemetry
+    telemetry.shutdown()
+    monkeypatch.delenv("HETU_RUN_ID", raising=False)
+    assert telemetry.run_identity() == (None, 0)
+    tel = telemetry.Telemetry("metrics", str(tmp_path), rank=0)
+    tel.step_record("train", 0, 1.0)
+    tel.close()
+    recs = [json.loads(l) for l in open(tmp_path / "metrics-r0.jsonl")]
+    assert all("run_id" not in r and "inc" not in r for r in recs)
+
+
+def test_run_identity_parses_defensively(monkeypatch):
+    from hetu_tpu import telemetry
+    monkeypatch.setenv("HETU_RUN_ID", "r1")
+    monkeypatch.setenv("HETU_RUN_INCARNATION", "3")
+    assert telemetry.run_identity() == ("r1", 3)
+    monkeypatch.setenv("HETU_RUN_INCARNATION", "not-a-number")
+    assert telemetry.run_identity() == ("r1", 0)
+    monkeypatch.setenv("HETU_RUN_ID", "")
+    assert telemetry.run_identity() == (None, 0)
+
+
+# ---------------------------------------------------------------------------
+# timeline + audit + incident + diff over the deterministic fixture
+# ---------------------------------------------------------------------------
+
+def test_timeline_merges_sources_and_step_range(tmp_path):
+    story = _story()
+    story._fixture_run(str(tmp_path))
+    tl = story.load_timeline(str(tmp_path))
+    assert tl["clock"]["comparable"] is True
+    srcs = {e["src"] for e in tl["entries"]}
+    assert {"metrics", "pilot", "flight"} <= srcs, srcs
+    # merged entries are time-ordered
+    ts = [e["t"] for e in tl["entries"] if e.get("t") is not None]
+    assert ts == sorted(ts)
+    narrow = story.load_timeline(str(tmp_path), step_range=(3, 4))
+    steps = {e["rec"].get("step") for e in narrow["entries"]
+             if e["rec"].get("kind") == "step"}
+    assert steps and steps <= {1, 2, 3, 4, 5, 6}   # window +/- context
+    out = _cli(str(tmp_path), "--step", "3:4", "--json")
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["entries"]
+
+
+def test_audit_clean_fixture_exits_zero(tmp_path):
+    story = _story()
+    story._fixture_run(str(tmp_path))
+    violations, _notes = story.audit(str(tmp_path))
+    assert violations == [], violations
+    out = _cli(str(tmp_path), "--audit")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_audit_seeded_corruption_names_invariant_and_rows(tmp_path):
+    story = _story()
+    story._fixture_run(str(tmp_path), corrupt=True)
+    violations, _ = story.audit(str(tmp_path))
+    assert [v["invariant"] for v in violations] == ["push-accounting"]
+    assert len(violations[0]["rows"]) == 2          # both ledger rows
+    out = _cli(str(tmp_path), "--audit")
+    assert out.returncode == 1
+    assert "push-accounting" in out.stdout
+    assert "metrics-r0.jsonl" in out.stdout         # row locations shown
+
+
+def test_diff_surfaces_seeded_regression_with_plan_context(tmp_path):
+    story = _story()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    os.makedirs(a), os.makedirs(b)
+    story._fixture_run(a, step_ms=10.0)
+    story._fixture_run(b, step_ms=14.0)
+    rep = story.diff_runs(a, b)
+    assert rep["gate"]["status"] == 1               # regressed
+    assert any("step_ms" in r["metric"] for r in rep["gate"]["regressions"])
+    assert "predicted_step_ms" in rep["plan_delta"]
+    # the fixtures act identically, so the episode context reports no
+    # structural delta — the step-time shift is purely a perf regression
+    assert rep["episode_delta"] == {}
+    out = _cli("--diff", a, b)
+    assert out.returncode == 1
+    ident = story.diff_runs(a, a)
+    assert ident["gate"]["status"] == 0
+
+
+def test_story_check_cli_is_jaxfree_and_passes():
+    out = subprocess.run(
+        [sys.executable, HETUSTORY, "--check"], capture_output=True,
+        text=True, env={**os.environ, "JAX_PLATFORMS": "dont_exist"})
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# incident: the anomaly-guard abort freezes a multi-source window
+# ---------------------------------------------------------------------------
+
+def test_anomaly_rollback_freezes_multisource_incident(tmp_path,
+                                                       monkeypatch):
+    """Acceptance: an anomaly-guard rollback writes one incident report
+    whose window draws on >= 4 distinct ledger families."""
+    import hetu_tpu as ht
+    from hetu_tpu import resilience as rs
+    from hetu_tpu import telemetry
+    from hetu_tpu.checkpoint import TrainCheckpointer
+    story = _story()
+    telemetry.shutdown()
+    tel_dir = tmp_path / "tel"
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tel_dir))
+    monkeypatch.setenv("HETU_TELEMETRY", "metrics")
+    # pre-existing artifacts from the same run's other subsystems: the
+    # incident window must cut across them, not just the metrics stream
+    os.makedirs(tel_dir)
+    with open(tel_dir / "trail-client-r0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "anchor", "rank": 0,
+                            "mono_us": 0, "wall_s": 0.0}) + "\n")
+    with open(tel_dir / "pilot.jsonl", "w") as f:
+        f.write(json.dumps({"era": 1, "phase": "propose", "step": 1,
+                            "delta": {}}) + "\n")
+    with open(tel_dir / "flight-r0.json", "w") as f:
+        json.dump({"schema": 1, "reason": "anomaly", "rank": 0, "k": 4,
+                   "records": []}, f)
+
+    rng = np.random.RandomState(7)
+    data_x = rng.randn(64, 6).astype(np.float32)
+    data_y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 64)]
+    x = ht.dataloader_op([ht.Dataloader(data_x, 16, "train", seed=11)])
+    y_ = ht.dataloader_op([ht.Dataloader(data_y, 16, "train", seed=11)])
+    w = ht.init.random_normal((6, 3), stddev=0.5, name="w")
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0), seed=0,
+                     anomaly_guard=True, telemetry="metrics")
+    try:
+        with TrainCheckpointer(tmp_path / "ck", keep=2) as ck:
+            sup = ex.attach_supervisor(rs.Supervisor(
+                ckptr=ck, ckpt_every=1,
+                anomaly=rs.AnomalyPolicy(max_consecutive=2),
+                fault_injector=rs.FaultInjector(
+                    "nan_grads@2,nan_grads@3")))
+            with sup:
+                for _ in range(4):
+                    ex.run("train")
+            assert sup.anomaly.rollbacks == 1
+        inc = story.incident_files(str(tel_dir))
+        assert len(inc) == 1, inc
+        doc = json.load(open(inc[0]))
+        assert doc["reason"] == "anomaly"
+        populated = [f for f, rows in doc["sources"].items() if rows]
+        assert len(populated) >= 4, doc["counts"]
+        assert "metrics" in populated
+        # the triggering anomaly event itself made it into the window
+        assert any(r["rec"].get("kind") == "event"
+                   and r["rec"].get("name") == "anomaly"
+                   for r in doc["sources"]["metrics"])
+        out = _cli(str(tel_dir), "--incident")
+        assert out.returncode == 0, out.stderr
+        assert "anomaly" in out.stdout
+    finally:
+        ex.close()
+        telemetry.shutdown()
+
+
+def test_incident_capture_can_be_disabled(tmp_path, monkeypatch):
+    from hetu_tpu import resilience as rs
+    from hetu_tpu import telemetry
+    story = _story()
+    telemetry.shutdown()
+    monkeypatch.setenv("HETU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("HETU_STORY_INCIDENT", "0")
+    telemetry.activate("metrics", str(tmp_path), rank=0)
+    try:
+        rs._incident("watchdog", step=5)
+        assert story.incident_files(str(tmp_path)) == []
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# live cluster: the audit over a REAL run
+# ---------------------------------------------------------------------------
+
+def _story_audit_worker(client, rank, tmpdir):
+    import os
+    tel_dir = os.path.join(tmpdir, "tel")
+    os.environ["HETU_TELEMETRY_DIR"] = tel_dir
+    os.environ["HETU_TELEMETRY_PS_EVERY"] = "1"
+    os.environ["HETU_RUN_ID"] = "testrun-1"
+    os.environ["HETU_RUN_INCARNATION"] = "0"
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu import telemetry
+    x = ht.Variable(name="x", trainable=False)
+    y_ = ht.Variable(name="y_", trainable=False)
+    w = ht.init.zeros((8, 1), name="w")
+    err = ht.matmul_op(x, w) - y_
+    loss = ht.reduce_mean_op(ht.mul_op(err, err), [0])
+    train_op = ht.optim.SGDOptimizer(0.05).minimize(loss)
+    ex = ht.Executor({"train": [loss, train_op]}, ctx=ht.cpu(0),
+                     comm_mode="PS", bsp=True, prefetch=False,
+                     telemetry="metrics")
+    rng = np.random.RandomState(3)
+    for _ in range(6):
+        xv = rng.randn(8, 8).astype(np.float32)
+        yv = (xv.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        ex.run("train", feed_dict={x: xv, y_: yv})
+    # quiesce, then write one final aligned ps_server/ClientStats poll:
+    # the audit equality is exact only at a drained endpoint
+    ex.ps_runtime.drain()
+    tel = telemetry.get()
+    for row in ex.ps_runtime.telemetry_stats():
+        tel.record(**row)
+    ex.close()
+    telemetry.shutdown()
+
+
+def test_live_cluster_audit_clean_then_seeded_corruption(tmp_path):
+    from test_ps import run_cluster
+    run_cluster(_story_audit_worker, tmp_path, n_workers=1, n_servers=1)
+    tel_dir = tmp_path / "tel"
+    # run identity rode every row of the real run
+    recs = [json.loads(l) for l in open(tel_dir / "metrics-r0.jsonl")]
+    assert recs and all(r.get("run_id") == "testrun-1" for r in recs)
+    assert any(r.get("kind") == "ps_server" for r in recs)
+    out = _cli(str(tel_dir), "--audit")
+    assert out.returncode == 0, out.stdout + out.stderr
+    # seed ONE corrupted row: the last ps_server row under-counts by one
+    # update — exactly the silent-lost-write the audit exists to catch
+    idx = max(i for i, r in enumerate(recs)
+              if r.get("kind") == "ps_server")
+    recs[idx]["updates"] = int(recs[idx]["updates"]) - 1
+    with open(tel_dir / "metrics-r0.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    out = _cli(str(tel_dir), "--audit")
+    assert out.returncode == 1, out.stdout
+    assert "push-accounting" in out.stdout
+    # the timeline renders the same dir (smoke over real artifacts)
+    out = _cli(str(tel_dir))
+    assert out.returncode == 0, out.stderr
